@@ -1,0 +1,22 @@
+"""armada_tpu: a TPU-native batch-scheduling framework.
+
+A ground-up re-design of the capabilities of armadaproject/armada
+(multi-cluster job queueing, DRF fair-share scheduling, gang placement,
+priority-class preemption, event-sourced control plane) where the per-round
+scheduling loop is a pure, jit-compiled JAX solve over dense job x node
+tensors, sharded over TPU chips.
+
+Package layout:
+  core/      resource vocabulary, quantities, priority classes, config
+  snapshot/  columnar job/node/queue encodings -> device tensors
+  solver/    the scheduling round: python oracle + vectorized JAX kernel
+  ops/       low-level tensor ops (bitset matching, segment reductions, pallas)
+  parallel/  device mesh, shardings, multi-chip solve
+  jobdb/     host-side columnar job store with MVCC-style transactions
+  events/    event-sourced state transitions (EventSequence equivalent)
+  sim/       discrete-event simulator (test oracle + benchmark harness)
+  services/  control-plane services: submit API, scheduler daemon, executors
+  clients/   client libraries and CLI
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,199 @@
+"""Scheduling configuration.
+
+A faithful-but-reduced equivalent of the reference's master scheduling config
+(/root/reference/internal/scheduler/configuration/configuration.go, defaults in
+config/scheduler/config.yaml). Only knobs that affect placement semantics are
+modeled; transport/infra settings (pulsar, postgres, grpc) live with the
+services that use them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .priorities import PriorityClass
+from .resources import ResourceListFactory
+
+
+@dataclass(frozen=True)
+class ResourceType:
+    name: str
+    resolution: str = "1"
+
+
+@dataclass(frozen=True)
+class FloatingResource:
+    """Resource not attached to any node, capped per pool
+    (docs/floating_resources.md in the reference)."""
+
+    name: str
+    resolution: str = "1"
+    pools: dict[str, dict[str, str]] = field(default_factory=dict)  # pool -> {name: qty}
+
+
+@dataclass(frozen=True)
+class PoolConfig:
+    name: str
+    away_pools: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class RateLimits:
+    """Token-bucket limits on newly scheduled jobs per round
+    (config.yaml:105-108; enforced by constraints, not the solver core)."""
+
+    maximum_scheduling_rate: float = 100.0
+    maximum_scheduling_burst: int = 1000
+    maximum_per_queue_scheduling_rate: float = 50.0
+    maximum_per_queue_scheduling_burst: int = 1000
+
+
+@dataclass(frozen=True)
+class SchedulingConfig:
+    pools: tuple[PoolConfig, ...] = (PoolConfig(name="default"),)
+    supported_resource_types: tuple[ResourceType, ...] = (
+        ResourceType("memory", "1"),
+        ResourceType("cpu", "1m"),
+        ResourceType("ephemeral-storage", "1"),
+        ResourceType("nvidia.com/gpu", "1"),
+    )
+    floating_resources: tuple[FloatingResource, ...] = ()
+    priority_classes: dict[str, PriorityClass] = field(
+        default_factory=lambda: {
+            "armada-default": PriorityClass("armada-default", 1000, preemptible=False),
+            "armada-preemptible": PriorityClass(
+                "armada-preemptible", 1000, preemptible=True
+            ),
+        }
+    )
+    default_priority_class: str = "armada-default"
+    # DRF: resources considered when computing dominant-share cost, with
+    # multipliers (fairness.go:34-105). name -> multiplier.
+    dominant_resource_fairness_resources: dict[str, float] = field(
+        default_factory=lambda: {
+            "cpu": 1.0,
+            "memory": 1.0,
+            "nvidia.com/gpu": 1.0,
+            "ephemeral-storage": 1.0,
+        }
+    )
+    # Resources indexed for node selection order (config.yaml:116-124);
+    # name -> resolution used to round allocatable when ordering candidates.
+    indexed_resources: dict[str, str] = field(
+        default_factory=lambda: {
+            "nvidia.com/gpu": "1",
+            "cpu": "100m",
+            "memory": "100Mi",
+            "ephemeral-storage": "1Gi",
+        }
+    )
+    indexed_taints: tuple[str, ...] = ()
+    indexed_node_labels: tuple[str, ...] = ()
+    protected_fraction_of_fair_share: float = 1.0
+    max_queue_lookback: int = 100_000
+    maximum_resource_fraction_to_schedule: dict[str, float] = field(
+        default_factory=lambda: {"memory": 1.0, "cpu": 1.0}
+    )
+    rate_limits: RateLimits = field(default_factory=RateLimits)
+    max_retries: int = 3
+    node_id_label: str = "kubernetes.io/hostname"
+    gang_id_annotation: str = "armadaproject.io/gangId"
+    gang_cardinality_annotation: str = "armadaproject.io/gangCardinality"
+    gang_uniformity_label_annotation: str = "armadaproject.io/gangNodeUniformityLabel"
+    enable_prefer_large_job_ordering: bool = False
+    consider_priority_class_priority: bool = True
+    executor_timeout_s: float = 600.0
+    max_unacknowledged_jobs_per_executor: int = 2500
+
+    def resource_factory(self) -> ResourceListFactory:
+        return ResourceListFactory.create(
+            [(t.name, t.resolution) for t in self.supported_resource_types],
+            [(t.name, t.resolution) for t in self.floating_resources],
+        )
+
+    def priority_class(self, name: str | None) -> PriorityClass:
+        """Resolve a priority-class name, falling back to the default class
+        for unknown names (submission-side validation rejects those upstream;
+        the scheduler must not crash on one malformed job)."""
+        if not name:
+            name = self.default_priority_class
+        pc = self.priority_classes.get(name)
+        if pc is None:
+            pc = self.priority_classes[self.default_priority_class]
+        return pc
+
+    @staticmethod
+    def from_dict(d: dict) -> "SchedulingConfig":
+        """Build from a YAML-style dict using the reference's key names."""
+        kwargs = {}
+        if "pools" in d:
+            kwargs["pools"] = tuple(
+                PoolConfig(p["name"], tuple(p.get("awayPools", ()))) for p in d["pools"]
+            )
+        if "supportedResourceTypes" in d:
+            kwargs["supported_resource_types"] = tuple(
+                ResourceType(t["name"], str(t.get("resolution", "1")))
+                for t in d["supportedResourceTypes"]
+            )
+        if "floatingResources" in d:
+            kwargs["floating_resources"] = tuple(
+                FloatingResource(
+                    t["name"],
+                    str(t.get("resolution", "1")),
+                    {
+                        p["name"]: dict(p.get("quantity", {}))
+                        for p in t.get("pools", [])
+                    },
+                )
+                for t in d["floatingResources"]
+            )
+        if "priorityClasses" in d:
+            kwargs["priority_classes"] = {
+                name: PriorityClass(
+                    name,
+                    int(pc["priority"]),
+                    bool(pc.get("preemptible", False)),
+                    dict(pc.get("maximumResourceFractionPerQueue", {})),
+                )
+                for name, pc in d["priorityClasses"].items()
+            }
+        if "defaultPriorityClassName" in d:
+            kwargs["default_priority_class"] = d["defaultPriorityClassName"]
+        if "dominantResourceFairnessResourcesToConsider" in d:
+            kwargs["dominant_resource_fairness_resources"] = {
+                name: 1.0 for name in d["dominantResourceFairnessResourcesToConsider"]
+            }
+        if "indexedResources" in d:
+            kwargs["indexed_resources"] = {
+                t["name"]: str(t.get("resolution", "1")) for t in d["indexedResources"]
+            }
+        if "indexedTaints" in d:
+            kwargs["indexed_taints"] = tuple(d["indexedTaints"])
+        if "indexedNodeLabels" in d:
+            kwargs["indexed_node_labels"] = tuple(d["indexedNodeLabels"])
+        if "protectedFractionOfFairShare" in d:
+            kwargs["protected_fraction_of_fair_share"] = float(
+                d["protectedFractionOfFairShare"]
+            )
+        if "maxQueueLookback" in d:
+            kwargs["max_queue_lookback"] = int(d["maxQueueLookback"])
+        if "maximumResourceFractionToSchedule" in d:
+            kwargs["maximum_resource_fraction_to_schedule"] = dict(
+                d["maximumResourceFractionToSchedule"]
+            )
+        if "maxRetries" in d:
+            kwargs["max_retries"] = int(d["maxRetries"])
+        if "nodeIdLabel" in d:
+            kwargs["node_id_label"] = d["nodeIdLabel"]
+        rl = {}
+        for yaml_key, attr in [
+            ("maximumSchedulingRate", "maximum_scheduling_rate"),
+            ("maximumSchedulingBurst", "maximum_scheduling_burst"),
+            ("maximumPerQueueSchedulingRate", "maximum_per_queue_scheduling_rate"),
+            ("maximumPerQueueSchedulingBurst", "maximum_per_queue_scheduling_burst"),
+        ]:
+            if yaml_key in d:
+                rl[attr] = d[yaml_key]
+        if rl:
+            kwargs["rate_limits"] = RateLimits(**rl)
+        return SchedulingConfig(**kwargs)

@@ -1,0 +1,39 @@
+"""Priority classes and the priority axis of the allocatable tensor.
+
+Mirrors the semantics of the reference's PriorityClass config type
+(/root/reference/internal/common/types/ and config/scheduler/config.yaml:89-100)
+and the EvictedPriority convention (-1: the row of the allocatable tensor that
+counts *everything* bound, including evicted jobs, so that a fit at
+EvictedPriority means "schedulable without preempting anyone").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+EVICTED_PRIORITY: int = -1
+MIN_PRIORITY: int = -(2**31)
+
+
+@dataclass(frozen=True)
+class PriorityClass:
+    name: str
+    priority: int
+    preemptible: bool = False
+    # Per-queue resource-fraction caps for jobs of this class
+    # (maximumResourceFractionPerQueue in the reference config).
+    maximum_resource_fraction_per_queue: dict[str, float] = field(default_factory=dict)
+    # Per-pool overrides of the above.
+    maximum_resource_fraction_per_queue_by_pool: dict[str, dict[str, float]] = field(
+        default_factory=dict
+    )
+
+
+def priority_levels(priority_classes: dict[str, PriorityClass]) -> list[int]:
+    """Distinct scheduling priorities, ascending, prefixed by EvictedPriority.
+
+    This is the P axis of the allocatable[P, N, R] tensor; mirrors
+    nodeDbPriorities in the reference nodedb.
+    """
+    levels = sorted({pc.priority for pc in priority_classes.values()})
+    return [EVICTED_PRIORITY] + levels

@@ -1,0 +1,185 @@
+"""Resource vocabulary and exact quantity arithmetic.
+
+The design mirrors the role of the reference's resource factory
+(/root/reference/internal/scheduler/internaltypes/resource_list_factory.go:20)
+but is column-oriented from the start: a ResourceList here is a numpy int64
+vector (or a batch of them), not a per-object struct. The factory fixes the
+resource-name -> index mapping and, like the reference, converts Kubernetes
+quantities to int64 at a per-resource power-of-ten scale derived from the
+configured resolution (resource_list_factory.go:61-71). Node quantities round
+down, job-request quantities round up, so scheduling stays conservative.
+
+A second, coarser per-resource scale ("device scale") maps the exact int64
+host values onto int32 device lanes for the TPU solve. int64 arithmetic is
+slow on TPU; int32 with e.g. memory in MiB covers 2 PiB per node, far beyond
+any real machine. Requests are ceil-scaled and allocatable floor-scaled so a
+device-side "fits" never overstates capacity.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from fractions import Fraction
+
+import numpy as np
+
+# Binary and decimal suffixes accepted by Kubernetes resource quantities.
+_BINARY = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60}
+_DECIMAL = {
+    "n": Fraction(1, 10**9),
+    "u": Fraction(1, 10**6),
+    "m": Fraction(1, 10**3),
+    "": Fraction(1),
+    "k": Fraction(10**3),
+    "M": Fraction(10**6),
+    "G": Fraction(10**9),
+    "T": Fraction(10**12),
+    "P": Fraction(10**15),
+    "E": Fraction(10**18),
+}
+
+
+def parse_quantity(value) -> Fraction:
+    """Parse a Kubernetes-style resource quantity into an exact Fraction.
+
+    Accepts ints/floats ("1", 0.5) and strings ("100m", "1.5Gi", "2e3").
+    """
+    if isinstance(value, Fraction):
+        return value
+    if isinstance(value, (int, np.integer)):
+        return Fraction(int(value))
+    if isinstance(value, float):
+        return Fraction(str(value))
+    s = str(value).strip()
+    if not s:
+        raise ValueError("empty quantity")
+    for suffix, mult in _BINARY.items():
+        if s.endswith(suffix):
+            return Fraction(s[: -len(suffix)]) * mult
+    # Suffix check must precede scientific notation: "5E" is 5 exa,
+    # while "5e3"/"5E3" (digit last) is scientific.
+    if s[-1] in _DECIMAL and not s[-1].isdigit():
+        return Fraction(s[:-1]) * _DECIMAL[s[-1]]
+    if "e" in s or "E" in s:
+        head, _, exp = s.partition("e" if "e" in s else "E")
+        return Fraction(head) * Fraction(10) ** int(exp)
+    return Fraction(s)
+
+
+def _resolution_to_scale(resolution) -> int:
+    """Power-of-ten scale for a resolution, as in resource_list_factory.go:66.
+
+    "1m"/0.001 -> -3 (store millis), "1" -> 0, "100Mi" -> 8 (1e8 ~ 100Mi).
+    Non-positive resolutions default to milli.
+    """
+    r = parse_quantity(resolution)
+    if r <= 0:
+        return -3
+    return math.floor(math.log10(float(r)))
+
+
+@dataclass(frozen=True)
+class ResourceListFactory:
+    """Fixed resource-name vocabulary with exact int64 host encoding.
+
+    names[i] is the canonical resource at index i; host int64 values are the
+    quantity divided by 10^scale[i]. device_scale[i] further divides host
+    values for the int32 device tensors.
+    """
+
+    names: tuple[str, ...]
+    scales: tuple[int, ...]  # power-of-ten per resource (host encoding)
+    device_divisor: tuple[int, ...]  # host units per device unit (int32 lanes)
+    name_to_index: dict[str, int] = field(default_factory=dict)
+
+    @staticmethod
+    def create(
+        supported: list[tuple[str, object]],
+        floating: list[tuple[str, object]] = (),
+        device_divisors: dict[str, int] | None = None,
+    ) -> "ResourceListFactory":
+        """supported/floating: [(name, resolution)], mirroring
+        supportedResourceTypes + floatingResourceTypes config."""
+        names, scales = [], []
+        for name, resolution in list(supported) + list(floating):
+            if name in names:
+                raise ValueError(f"duplicate resource type {name!r}")
+            names.append(name)
+            scales.append(_resolution_to_scale(resolution))
+        divisors = []
+        device_divisors = device_divisors or {}
+        for name, scale in zip(names, scales):
+            if name in device_divisors:
+                divisors.append(int(device_divisors[name]))
+            else:
+                # Default: keep cpu-like milli resources as-is; compress
+                # byte-like resources (scale 0 with huge ranges) to ~Mi.
+                divisors.append(1 if scale != 0 else _default_divisor(name))
+        factory = ResourceListFactory(
+            names=tuple(names),
+            scales=tuple(scales),
+            device_divisor=tuple(divisors),
+        )
+        factory.name_to_index.update({n: i for i, n in enumerate(names)})
+        return factory
+
+    @property
+    def num_resources(self) -> int:
+        return len(self.names)
+
+    def index_of(self, name: str) -> int:
+        return self.name_to_index[name]
+
+    # ---- host encoding (exact int64) ----
+
+    def from_map(self, resources: dict, *, ceil: bool, strict: bool = False) -> np.ndarray:
+        """Encode {name: quantity} into an int64 vector.
+
+        ceil=True for job requests (round up), False for node allocatable
+        (round down), mirroring FromJobResourceListFailOnUnknown vs
+        FromNodeProto (resource_list_factory.go:87-120). Unknown resources are
+        ignored unless strict.
+        """
+        out = np.zeros(self.num_resources, dtype=np.int64)
+        for name, quantity in (resources or {}).items():
+            i = self.name_to_index.get(name)
+            if i is None:
+                if strict:
+                    raise KeyError(f"unknown resource {name!r}")
+                continue
+            scaled = parse_quantity(quantity) / (Fraction(10) ** self.scales[i])
+            out[i] = int(math.ceil(scaled) if ceil else math.floor(scaled))
+        return out
+
+    def to_map(self, vec: np.ndarray) -> dict[str, Fraction]:
+        """Decode an int64 vector back to {name: exact quantity}."""
+        return {
+            name: Fraction(int(vec[i])) * Fraction(10) ** self.scales[i]
+            for i, name in enumerate(self.names)
+            if vec[i] != 0
+        }
+
+    def zeros(self, *batch: int) -> np.ndarray:
+        return np.zeros((*batch, self.num_resources), dtype=np.int64)
+
+    # ---- device encoding (int32 lanes) ----
+
+    def to_device(self, host_vals: np.ndarray, *, ceil: bool) -> np.ndarray:
+        """Scale host int64 values to int32 device units.
+
+        Requests ceil, allocatable floor: a device-side fit check is then
+        always at least as strict as the exact host check.
+        """
+        div = np.asarray(self.device_divisor, dtype=np.int64)
+        v = np.asarray(host_vals, dtype=np.int64)
+        scaled = -((-v) // div) if ceil else v // div
+        lo, hi = np.iinfo(np.int32).min, np.iinfo(np.int32).max
+        return np.clip(scaled, lo, hi).astype(np.int32)
+
+
+def _default_divisor(name: str) -> int:
+    byte_like = ("memory", "storage", "disk", "ephemeral")
+    if any(t in name for t in byte_like):
+        return 2**20  # Mi
+    return 1

@@ -1,0 +1,121 @@
+"""Host-side domain objects: jobs, nodes, queues, taints/tolerations.
+
+These are the API-level records that flow in from submissions and executor
+snapshots; the snapshot package flattens batches of them into dense tensors.
+They mirror the information content of the reference's jobdb.Job
+(/root/reference/internal/scheduler/jobdb/job.go:23), internaltypes.Node
+(internaltypes/node.go:26) and the queue API type, without the Go-specific
+immutability machinery (columnar stores handle that here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+NO_SCHEDULE = "NoSchedule"
+NO_EXECUTE = "NoExecute"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = NO_SCHEDULE
+
+    @property
+    def blocks_scheduling(self) -> bool:
+        # PreferNoSchedule never blocks placement (soft preference).
+        return self.effect in (NO_SCHEDULE, NO_EXECUTE)
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # "Equal" | "Exists"
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        """Kubernetes toleration semantics (core/v1 Toleration.ToleratesTaint)."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key == "":
+            # Empty key with Exists tolerates everything.
+            return self.operator == "Exists"
+        if self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+@dataclass(frozen=True)
+class Gang:
+    """Gang (all-or-nothing) membership, from job annotations in the
+    reference (gangId/gangCardinality/gangNodeUniformityLabel)."""
+
+    id: str
+    cardinality: int
+    node_uniformity_label: str = ""
+
+
+@dataclass(frozen=True)
+class JobSpec:
+    """A schedulable job. requests: {resource: quantity}."""
+
+    id: str
+    queue: str
+    jobset: str = ""
+    priority: int = 0  # within-queue ordering: lower schedules first
+    priority_class: str = ""
+    requests: dict = field(default_factory=dict)
+    node_selector: dict = field(default_factory=dict)  # label -> required value
+    tolerations: tuple[Toleration, ...] = ()
+    gang: Gang | None = None
+    submitted_ts: float = 0.0
+    annotations: dict = field(default_factory=dict)
+
+    def with_(self, **kw) -> "JobSpec":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class NodeSpec:
+    """A worker node as reported by an executor."""
+
+    id: str
+    name: str = ""
+    executor: str = ""
+    pool: str = "default"
+    taints: tuple[Taint, ...] = ()
+    labels: dict = field(default_factory=dict)
+    total_resources: dict = field(default_factory=dict)
+    # Resources already used by pods outside the scheduler's control,
+    # per priority level: {priority: {resource: qty}}.
+    unallocatable_by_priority: dict = field(default_factory=dict)
+    unschedulable: bool = False
+
+    def label_value(self, key: str):
+        return self.labels.get(key)
+
+
+@dataclass(frozen=True)
+class QueueSpec:
+    name: str
+    priority_factor: float = 1.0
+
+    @property
+    def weight(self) -> float:
+        # weight = 1 / priorityFactor, as in the reference scheduling context
+        # construction (scheduling_algo.go:411+).
+        return 1.0 / max(self.priority_factor, 1e-9)
+
+
+@dataclass(frozen=True)
+class RunningJob:
+    """A job currently bound to a node (input to round snapshots)."""
+
+    job: JobSpec
+    node_id: str
+    scheduled_at_priority: int

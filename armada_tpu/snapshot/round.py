@@ -1,0 +1,338 @@
+"""Dense per-round snapshot: the input to the scheduling solve.
+
+One RoundSnapshot holds everything a pool's scheduling round needs, flattened
+into numpy arrays (exact int64 on host; `device()` converts to int32/uint32
+lanes for the TPU kernel). It corresponds to what the reference assembles in
+newFairSchedulingAlgoContext + populateNodeDb
+(/root/reference/internal/scheduler/scheduling/scheduling_algo.go:411,920):
+node allocatable-by-priority, per-queue allocation/demand, and the queued
+work, but column-oriented instead of object graphs.
+
+Allocatable model (mirrors internaltypes AllocatableByPriority semantics):
+  allocatable[p, n] = total[n] - sum(requests of jobs bound on n whose
+                       effective priority >= priorities[p])
+A job "fits at priority p" iff its request <= allocatable[p]. Binding at
+priority q subtracts the request from every row with priorities[p] <= q;
+evicting moves a job's effective priority to EVICTED_PRIORITY (-1), i.e. adds
+the request back to every row above it (nodedb.go:902-1096).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+
+import numpy as np
+
+from ..core.config import SchedulingConfig
+from ..core.priorities import EVICTED_PRIORITY, priority_levels
+from ..core.resources import ResourceListFactory, parse_quantity
+from ..core.types import JobSpec, NodeSpec, QueueSpec, RunningJob
+from .vocab import LabelVocab, TaintVocab, referenced_label_keys
+
+NO_NODE = -1
+NO_GANG = -1
+
+
+@dataclass
+class RoundSnapshot:
+    config: SchedulingConfig
+    factory: ResourceListFactory
+    pool: str
+
+    # --- priority axis ---
+    priorities: np.ndarray  # int32[P], ascending, priorities[0] == -1
+
+    # --- nodes ---
+    node_ids: list  # index -> node id (str)
+    allocatable: np.ndarray  # int64[P, N, R], after binding running jobs
+    node_total: np.ndarray  # int64[N, R]
+    node_taint_bits: np.ndarray  # uint32[N, Wt]
+    node_label_bits: np.ndarray  # uint32[N, Wl]
+    node_id_rank: np.ndarray  # int32[N]: rank of node id (lexicographic)
+    node_unschedulable: np.ndarray  # bool[N]
+
+    # --- candidate ordering over indexed resources ---
+    order_res_idx: np.ndarray  # int32[K] resource column per order position
+    order_res_resolution: np.ndarray  # int64[K] rounding, host units
+
+    # --- queues ---
+    queue_names: list
+    queue_weight: np.ndarray  # float64[Q]
+    queue_allocated: np.ndarray  # int64[Q, R] (running jobs in this pool)
+    queue_demand: np.ndarray  # int64[Q, R] (running + queued)
+
+    # --- jobs (running + queued, one table) ---
+    job_ids: list
+    job_req: np.ndarray  # int64[J, R]
+    job_tolerated: np.ndarray  # uint32[J, Wt]
+    job_selector: np.ndarray  # uint32[J, Wl]
+    job_possible: np.ndarray  # bool[J]: selector satisfiable at all
+    job_queue: np.ndarray  # int32[J]
+    job_priority: np.ndarray  # int32[J]: scheduled-at (running) or PC priority
+    job_preemptible: np.ndarray  # bool[J]
+    job_is_running: np.ndarray  # bool[J]
+    job_node: np.ndarray  # int32[J]: bound node (running) or NO_NODE
+    job_order: np.ndarray  # int64[J]: within-queue order rank (lower first)
+    job_gang: np.ndarray  # int32[J] -> gang table index
+
+    # --- gangs (every job belongs to exactly one; singletons common) ---
+    gang_queue: np.ndarray  # int32[G]
+    gang_card: np.ndarray  # int32[G] declared cardinality
+    gang_member_offsets: np.ndarray  # int32[G+1]
+    gang_members: np.ndarray  # int32[sum members] job indices, queue order
+    gang_total_req: np.ndarray  # int64[G, R]
+    gang_order: np.ndarray  # int64[G]: queue position (last member's rank)
+    gang_complete: np.ndarray  # bool[G] all declared members present
+    gang_uniformity_key: list  # per gang: uniformity label key or ""
+
+    # --- vocabularies (host-side, for decoding/reporting) ---
+    taint_vocab: TaintVocab
+    label_vocab: LabelVocab
+
+    # --- totals ---
+    total_resources: np.ndarray  # int64[R] sum over nodes (+floating later)
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_ids)
+
+    @property
+    def num_jobs(self) -> int:
+        return len(self.job_ids)
+
+    @property
+    def num_queues(self) -> int:
+        return len(self.queue_names)
+
+    @property
+    def num_gangs(self) -> int:
+        return len(self.gang_card)
+
+    @property
+    def num_priorities(self) -> int:
+        return len(self.priorities)
+
+    def priority_row(self, priority: int) -> int:
+        """Row index of an exact priority level."""
+        idx = np.searchsorted(self.priorities, priority)
+        if idx >= len(self.priorities) or self.priorities[idx] != priority:
+            raise KeyError(f"priority {priority} not in {self.priorities}")
+        return int(idx)
+
+    def drf_multipliers(self) -> np.ndarray:
+        """float64[R] fairness multiplier per resource (0 = ignored)."""
+        mult = np.zeros(self.factory.num_resources, dtype=np.float64)
+        for name, m in self.config.dominant_resource_fairness_resources.items():
+            i = self.factory.name_to_index.get(name)
+            if i is not None:
+                mult[i] = m if m > 0 else 1.0
+        return mult
+
+
+def build_round_snapshot(
+    config: SchedulingConfig,
+    pool: str,
+    nodes: list[NodeSpec],
+    queues: list[QueueSpec],
+    running: list[RunningJob],
+    queued: list[JobSpec],
+) -> RoundSnapshot:
+    factory = config.resource_factory()
+    R = factory.num_resources
+    priorities = np.asarray(priority_levels(config.priority_classes), dtype=np.int32)
+    P = len(priorities)
+
+    nodes = [n for n in nodes if n.pool == pool]
+    node_index = {n.id: i for i, n in enumerate(nodes)}
+    N = len(nodes)
+
+    # One job table: running first, then queued. Built once so the label
+    # vocabulary and the per-job tensors can never diverge.
+    jobs: list[JobSpec] = [r.job for r in running] + list(queued)
+
+    # Vocabularies over this snapshot's population.
+    taint_vocab = TaintVocab.build(nodes)
+    label_vocab = LabelVocab.build(
+        nodes, referenced_label_keys(jobs, config.node_id_label)
+    )
+
+    # --- node tensors ---
+    node_total = np.zeros((N, R), dtype=np.int64)
+    node_taint_bits = np.zeros((N, taint_vocab.n_words), dtype=np.uint32)
+    node_label_bits = np.zeros((N, label_vocab.n_words), dtype=np.uint32)
+    node_unschedulable = np.zeros(N, dtype=bool)
+    for i, node in enumerate(nodes):
+        node_total[i] = factory.from_map(node.total_resources, ceil=False)
+        node_taint_bits[i] = taint_vocab.node_bits(node)
+        node_label_bits[i] = label_vocab.node_bits(node)
+        node_unschedulable[i] = node.unschedulable
+    node_id_rank = np.argsort(np.argsort([n.id for n in nodes])).astype(np.int32)
+
+    allocatable = np.broadcast_to(node_total, (P, N, R)).copy()
+    for i, node in enumerate(nodes):
+        for prio, res in (node.unallocatable_by_priority or {}).items():
+            req = factory.from_map(res, ceil=True)
+            allocatable[priorities <= int(prio), i, :] -= req
+
+    # --- job table ---
+    J = len(jobs)
+    job_req = np.zeros((J, R), dtype=np.int64)
+    job_tolerated = np.zeros((J, taint_vocab.n_words), dtype=np.uint32)
+    job_selector = np.zeros((J, label_vocab.n_words), dtype=np.uint32)
+    job_possible = np.ones(J, dtype=bool)
+    job_queue = np.full(J, -1, dtype=np.int32)
+    job_priority = np.zeros(J, dtype=np.int32)
+    job_preemptible = np.zeros(J, dtype=bool)
+    job_is_running = np.zeros(J, dtype=bool)
+    job_node = np.full(J, NO_NODE, dtype=np.int32)
+
+    queue_index = {q.name: i for i, q in enumerate(queues)}
+    Q = len(queues)
+
+    for j, job in enumerate(jobs):
+        job_req[j] = factory.from_map(job.requests, ceil=True)
+        job_tolerated[j] = taint_vocab.tolerated_bits(job.tolerations)
+        bits, possible = label_vocab.selector_bits(job.node_selector)
+        job_selector[j] = bits
+        job_possible[j] = possible
+        job_queue[j] = queue_index.get(job.queue, -1)
+        pc = config.priority_class(job.priority_class)
+        job_priority[j] = pc.priority
+        job_preemptible[j] = pc.preemptible
+
+    for j, run in enumerate(running):
+        job_is_running[j] = True
+        job_node[j] = node_index.get(run.node_id, NO_NODE)
+        job_priority[j] = run.scheduled_at_priority
+
+    # Within-queue order: (job priority number asc, submitted ts asc, id asc),
+    # the jobdb FairShareOrder (jobdb/jobdb.go:27-31). Encoded as a dense rank
+    # so both oracle and kernel sort identically.
+    order_tuples = sorted(
+        range(J), key=lambda j: (jobs[j].priority, jobs[j].submitted_ts, jobs[j].id)
+    )
+    job_order = np.zeros(J, dtype=np.int64)
+    for rank, j in enumerate(order_tuples):
+        job_order[j] = rank
+
+    # --- bind running jobs ---
+    for j, run in enumerate(running):
+        n = job_node[j]
+        if n >= 0:
+            allocatable[priorities <= job_priority[j], n, :] -= job_req[j]
+
+    # --- queue accounting ---
+    queue_weight = np.asarray([q.weight for q in queues], dtype=np.float64)
+    queue_allocated = np.zeros((Q, R), dtype=np.int64)
+    queue_demand = np.zeros((Q, R), dtype=np.int64)
+    for j in range(J):
+        q = job_queue[j]
+        if q < 0:
+            continue
+        if job_is_running[j]:
+            queue_allocated[q] += job_req[j]
+        queue_demand[q] += job_req[j]
+
+    # --- gangs ---
+    gang_key_to_idx: dict = {}
+    gang_rows: list[dict] = []
+    job_gang = np.full(J, NO_GANG, dtype=np.int32)
+    for j, job in enumerate(jobs):
+        if job.gang is not None and job.gang.cardinality > 1 and not job_is_running[j]:
+            # Only queued jobs group into gang rows: the queue iterator in the
+            # reference sees gangs among queued work only
+            # (queue_scheduler.go:277); running gang members are handled by
+            # the gang-aware eviction pass, not re-grouped here.
+            key = (job.queue, job.gang.id)
+            card = job.gang.cardinality
+            uniformity = job.gang.node_uniformity_label
+        else:
+            key = ("", f"__single__{j}")
+            card = 1
+            uniformity = ""
+        g = gang_key_to_idx.get(key)
+        if g is None:
+            g = len(gang_rows)
+            gang_key_to_idx[key] = g
+            gang_rows.append(
+                {"queue": int(job_queue[j]), "card": card, "members": [],
+                 "uniformity": uniformity}
+            )
+        gang_rows[g]["members"].append(j)
+        job_gang[j] = g
+
+    G = len(gang_rows)
+    gang_queue = np.asarray([g["queue"] for g in gang_rows], dtype=np.int32)
+    gang_card = np.asarray([g["card"] for g in gang_rows], dtype=np.int32)
+    gang_uniformity_key = [g["uniformity"] for g in gang_rows]
+    gang_member_offsets = np.zeros(G + 1, dtype=np.int32)
+    members_flat: list[int] = []
+    gang_total_req = np.zeros((G, R), dtype=np.int64)
+    gang_order = np.zeros(G, dtype=np.int64)
+    gang_complete = np.zeros(G, dtype=bool)
+    for g, row in enumerate(gang_rows):
+        # Members in queue order; a gang becomes schedulable when its last
+        # member is reached (QueuedGangIterator, queue_scheduler.go:277).
+        members = sorted(row["members"], key=lambda j: job_order[j])
+        members_flat.extend(members)
+        gang_member_offsets[g + 1] = len(members_flat)
+        gang_total_req[g] = job_req[members].sum(axis=0)
+        gang_order[g] = max(job_order[m] for m in members)
+        gang_complete[g] = len(members) == row["card"]
+    gang_members = np.asarray(members_flat, dtype=np.int32)
+
+    # --- candidate ordering key (indexed resources) ---
+    order_idx, order_res = [], []
+    for name, resolution in config.indexed_resources.items():
+        i = factory.name_to_index.get(name)
+        if i is None:
+            continue
+        host_res = int(parse_quantity(resolution) / (Fraction(10) ** factory.scales[i]))
+        order_idx.append(i)
+        order_res.append(max(1, host_res))
+    order_res_idx = np.asarray(order_idx, dtype=np.int32)
+    order_res_resolution = np.asarray(order_res, dtype=np.int64)
+
+    return RoundSnapshot(
+        config=config,
+        factory=factory,
+        pool=pool,
+        priorities=priorities,
+        node_ids=[n.id for n in nodes],
+        allocatable=allocatable,
+        node_total=node_total,
+        node_taint_bits=node_taint_bits,
+        node_label_bits=node_label_bits,
+        node_id_rank=node_id_rank,
+        node_unschedulable=node_unschedulable,
+        order_res_idx=order_res_idx,
+        order_res_resolution=order_res_resolution,
+        queue_names=[q.name for q in queues],
+        queue_weight=queue_weight,
+        queue_allocated=queue_allocated,
+        queue_demand=queue_demand,
+        job_ids=[job.id for job in jobs],
+        job_req=job_req,
+        job_tolerated=job_tolerated,
+        job_selector=job_selector,
+        job_possible=job_possible,
+        job_queue=job_queue,
+        job_priority=job_priority,
+        job_preemptible=job_preemptible,
+        job_is_running=job_is_running,
+        job_node=job_node,
+        job_order=job_order,
+        job_gang=job_gang,
+        gang_queue=gang_queue,
+        gang_card=gang_card,
+        gang_member_offsets=gang_member_offsets,
+        gang_members=gang_members,
+        gang_total_req=gang_total_req,
+        gang_order=gang_order,
+        gang_complete=gang_complete,
+        gang_uniformity_key=gang_uniformity_key,
+        taint_vocab=taint_vocab,
+        label_vocab=label_vocab,
+        total_resources=node_total.sum(axis=0),
+    )

@@ -1,0 +1,70 @@
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.resources import ResourceListFactory, parse_quantity
+
+
+def test_parse_quantity_forms():
+    assert parse_quantity("100m") == Fraction(1, 10)
+    assert parse_quantity("1") == 1
+    assert parse_quantity("1.5Gi") == 3 * 2**29
+    assert parse_quantity("2Ki") == 2048
+    assert parse_quantity("2e3") == 2000
+    assert parse_quantity(0.5) == Fraction(1, 2)
+    assert parse_quantity(3) == 3
+    assert parse_quantity("250M") == 250_000_000
+
+
+def default_factory():
+    return ResourceListFactory.create(
+        [("memory", "1"), ("cpu", "1m"), ("ephemeral-storage", "1"), ("nvidia.com/gpu", "1")]
+    )
+
+
+def test_factory_scales():
+    f = default_factory()
+    # cpu resolution 1m -> scale -3 (store millicores); memory scale 0 (bytes)
+    assert f.scales[f.index_of("cpu")] == -3
+    assert f.scales[f.index_of("memory")] == 0
+
+
+def test_from_map_rounding():
+    f = default_factory()
+    req = f.from_map({"cpu": "1500m", "memory": "1Gi"}, ceil=True)
+    assert req[f.index_of("cpu")] == 1500
+    assert req[f.index_of("memory")] == 2**30
+    # sub-resolution quantities: requests round up, allocatable rounds down
+    up = f.from_map({"cpu": "0.0001"}, ceil=True)
+    down = f.from_map({"cpu": "0.0001"}, ceil=False)
+    assert up[f.index_of("cpu")] == 1
+    assert down[f.index_of("cpu")] == 0
+
+
+def test_unknown_resource():
+    f = default_factory()
+    assert f.from_map({"fancy.io/widget": 3}, ceil=True).sum() == 0
+    with pytest.raises(KeyError):
+        f.from_map({"fancy.io/widget": 3}, ceil=True, strict=True)
+
+
+def test_device_scaling_conservative():
+    f = default_factory()
+    mem = f.index_of("memory")
+    # memory device lane is Mi by default
+    host = np.zeros((2, f.num_resources), dtype=np.int64)
+    host[0, mem] = 2**20 + 1  # just over 1Mi
+    host[1, mem] = 2**21  # exactly 2Mi
+    req = f.to_device(host, ceil=True)
+    alloc = f.to_device(host, ceil=False)
+    assert req[0, mem] == 2 and alloc[0, mem] == 1
+    assert req[1, mem] == 2 and alloc[1, mem] == 2
+
+
+def test_roundtrip_to_map():
+    f = default_factory()
+    vec = f.from_map({"cpu": "2", "memory": "1Ki"}, ceil=True)
+    decoded = f.to_map(vec)
+    assert decoded["cpu"] == 2
+    assert decoded["memory"] == 1024

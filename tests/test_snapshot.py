@@ -1,0 +1,152 @@
+import numpy as np
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import (
+    Gang,
+    JobSpec,
+    NodeSpec,
+    QueueSpec,
+    RunningJob,
+    Taint,
+    Toleration,
+)
+from armada_tpu.snapshot.round import NO_NODE, build_round_snapshot
+
+
+def mk_nodes(n=4, cpu="32", mem="256Gi", **kw):
+    return [
+        NodeSpec(
+            id=f"node-{i}",
+            pool="default",
+            total_resources={"cpu": cpu, "memory": mem},
+            **kw,
+        )
+        for i in range(n)
+    ]
+
+
+def mk_job(i, queue="q", cpu="1", mem="1Gi", **kw):
+    return JobSpec(
+        id=f"job-{i:04d}",
+        queue=queue,
+        requests={"cpu": cpu, "memory": mem},
+        submitted_ts=float(i),
+        **kw,
+    )
+
+
+def test_snapshot_shapes_and_totals():
+    cfg = SchedulingConfig()
+    nodes = mk_nodes(4)
+    queued = [mk_job(i) for i in range(10)]
+    snap = build_round_snapshot(
+        cfg, "default", nodes, [QueueSpec("q")], [], queued
+    )
+    assert snap.num_nodes == 4 and snap.num_jobs == 10 and snap.num_queues == 1
+    # priorities: evicted row + 1000 (both default classes share priority 1000)
+    assert list(snap.priorities) == [-1, 1000]
+    cpu = snap.factory.index_of("cpu")
+    assert snap.total_resources[cpu] == 4 * 32_000
+    # no running jobs: allocatable == total on every priority row
+    assert (snap.allocatable == snap.node_total[None]).all()
+
+
+def test_running_job_binding():
+    cfg = SchedulingConfig()
+    nodes = mk_nodes(2)
+    job = mk_job(0, priority_class="armada-preemptible")
+    running = [RunningJob(job=job, node_id="node-1", scheduled_at_priority=1000)]
+    snap = build_round_snapshot(
+        cfg, "default", nodes, [QueueSpec("q")], running, []
+    )
+    cpu = snap.factory.index_of("cpu")
+    n1 = snap.node_ids.index("node-1")
+    evicted_row = snap.priority_row(-1)
+    prio_row = snap.priority_row(1000)
+    # bound at priority 1000: subtracted from rows <= 1000, i.e. both rows
+    assert snap.allocatable[evicted_row, n1, cpu] == 32_000 - 1000
+    assert snap.allocatable[prio_row, n1, cpu] == 32_000 - 1000
+    assert snap.queue_allocated[0, cpu] == 1000
+    assert snap.job_is_running[0] and snap.job_node[0] == n1
+
+
+def test_taints_and_selectors():
+    cfg = SchedulingConfig()
+    tainted = NodeSpec(
+        id="gpu-node",
+        pool="default",
+        taints=(Taint("gpu", "true", "NoSchedule"),),
+        labels={"zone": "a"},
+        total_resources={"cpu": "8", "memory": "32Gi"},
+    )
+    plain = NodeSpec(
+        id="cpu-node",
+        pool="default",
+        labels={"zone": "b"},
+        total_resources={"cpu": "8", "memory": "32Gi"},
+    )
+    tolerant = mk_job(0, tolerations=(Toleration(key="gpu", value="true"),))
+    selective = mk_job(1, node_selector={"zone": "a"})
+    impossible = mk_job(2, node_selector={"zone": "nowhere"})
+    snap = build_round_snapshot(
+        cfg, "default", [tainted, plain], [QueueSpec("q")], [],
+        [tolerant, selective, impossible],
+    )
+    gpu_i = snap.node_ids.index("gpu-node")
+    cpu_i = snap.node_ids.index("cpu-node")
+    # taint bits: gpu node has the taint bit, job 0 tolerates it
+    assert snap.node_taint_bits[gpu_i].any()
+    assert not snap.node_taint_bits[cpu_i].any()
+    assert (snap.job_tolerated[0] & snap.node_taint_bits[gpu_i]).any()
+    # untolerated: job 1 on gpu node blocked
+    assert (snap.node_taint_bits[gpu_i] & ~snap.job_tolerated[1]).any()
+    # selector bits: job 1 requires zone=a which only gpu node carries
+    sel = snap.job_selector[1]
+    assert (sel & ~snap.node_label_bits[gpu_i]).sum() == 0
+    assert (sel & ~snap.node_label_bits[cpu_i]).sum() != 0
+    # unsatisfiable selector flagged
+    assert not snap.job_possible[2]
+    assert snap.job_possible[0] and snap.job_possible[1]
+
+
+def test_gang_grouping():
+    cfg = SchedulingConfig()
+    gang = Gang(id="g1", cardinality=3)
+    jobs = [mk_job(i, gang=gang) for i in range(3)] + [mk_job(3)]
+    snap = build_round_snapshot(
+        cfg, "default", mk_nodes(2), [QueueSpec("q")], [], jobs
+    )
+    assert snap.num_gangs == 2
+    gang_sizes = np.diff(snap.gang_member_offsets)
+    assert sorted(gang_sizes.tolist()) == [1, 3]
+    g3 = int(np.argmax(gang_sizes == 3))
+    assert snap.gang_complete[g3]
+    cpu = snap.factory.index_of("cpu")
+    assert snap.gang_total_req[g3, cpu] == 3000
+    # gang becomes schedulable at its last member's rank
+    members = snap.gang_members[
+        snap.gang_member_offsets[g3] : snap.gang_member_offsets[g3 + 1]
+    ]
+    assert snap.gang_order[g3] == max(snap.job_order[m] for m in members)
+
+
+def test_incomplete_gang_flagged():
+    cfg = SchedulingConfig()
+    gang = Gang(id="g1", cardinality=4)
+    jobs = [mk_job(i, gang=gang) for i in range(2)]
+    snap = build_round_snapshot(
+        cfg, "default", mk_nodes(1), [QueueSpec("q")], [], jobs
+    )
+    g = int(snap.job_gang[0])
+    assert not snap.gang_complete[g]
+
+
+def test_queue_order_priority_then_time():
+    cfg = SchedulingConfig()
+    early_low = mk_job(0)  # priority 0, ts 0
+    late_urgent = mk_job(1).with_(priority=-5)
+    snap = build_round_snapshot(
+        cfg, "default", mk_nodes(1), [QueueSpec("q")], [], [early_low, late_urgent]
+    )
+    # lower priority number schedules first
+    assert snap.job_order[1] < snap.job_order[0]
