@@ -75,6 +75,11 @@ class RoundSnapshot:
     job_node: np.ndarray  # int32[J]: bound node (running) or NO_NODE
     job_order: np.ndarray  # int64[J]: within-queue order rank (lower first)
     job_gang: np.ndarray  # int32[J] -> gang table index
+    # Raw gang identity per job ("" if none), for gang-aware eviction of
+    # running jobs (which do not get gang table rows).
+    job_gang_id: list
+    # Resolved priority-class name per job (after defaulting).
+    job_pc_name: list
 
     # --- gangs (every job belongs to exactly one; singletons common) ---
     gang_queue: np.ndarray  # int32[G]
@@ -217,10 +222,17 @@ def build_round_snapshot(
         job_order[j] = rank
 
     # --- bind running jobs ---
+    # Non-preemptible jobs are deducted at every priority row
+    # (priorityCutoffFor, nodedb.go:1017-1032): neither evictor will remove
+    # them, so higher-priority jobs must not over-pack past them.
     for j, run in enumerate(running):
         n = job_node[j]
         if n >= 0:
-            allocatable[priorities <= job_priority[j], n, :] -= job_req[j]
+            if job_preemptible[j]:
+                rows = priorities <= job_priority[j]
+            else:
+                rows = np.ones(P, dtype=bool)
+            allocatable[rows, n, :] -= job_req[j]
 
     # --- queue accounting ---
     queue_weight = np.asarray([q.weight for q in queues], dtype=np.float64)
@@ -324,6 +336,8 @@ def build_round_snapshot(
         job_node=job_node,
         job_order=job_order,
         job_gang=job_gang,
+        job_gang_id=[j.gang.id if j.gang is not None else "" for j in jobs],
+        job_pc_name=[config.priority_class(j.priority_class).name for j in jobs],
         gang_queue=gang_queue,
         gang_card=gang_card,
         gang_member_offsets=gang_member_offsets,

@@ -1,0 +1,4 @@
+from .result import RoundResult
+from .reference import ReferenceSolver
+
+__all__ = ["RoundResult", "ReferenceSolver"]

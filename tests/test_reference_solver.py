@@ -1,0 +1,336 @@
+"""Behavioral tests for the Python oracle, mirroring the scenario families of
+the reference's scheduler tests (preempting_queue_scheduler_test.go,
+queue_scheduler_test.go, nodedb_test.go)."""
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import Gang, JobSpec, NodeSpec, QueueSpec, RunningJob
+from armada_tpu.snapshot.round import NO_NODE, build_round_snapshot
+from armada_tpu.solver.reference import ReferenceSolver
+
+
+def cfg(**kw):
+    return SchedulingConfig(**kw)
+
+
+def nodes(n, cpu="32", mem="256Gi", pool="default", **kw):
+    return [
+        NodeSpec(
+            id=f"node-{i:03d}",
+            pool=pool,
+            total_resources={"cpu": cpu, "memory": mem},
+            **kw,
+        )
+        for i in range(n)
+    ]
+
+
+def job(i, queue="q", cpu="1", mem="1Gi", **kw):
+    return JobSpec(
+        id=f"job-{i:04d}",
+        queue=queue,
+        requests={"cpu": cpu, "memory": mem},
+        submitted_ts=float(i),
+        **kw,
+    )
+
+
+def solve(config, ns, qs, running, queued, **kw):
+    snap = build_round_snapshot(config, "default", ns, qs, running, queued)
+    return snap, ReferenceSolver(snap, **kw).solve()
+
+
+def test_all_jobs_fit():
+    snap, res = solve(cfg(), nodes(2), [QueueSpec("q")], [], [job(i) for i in range(10)])
+    assert res.scheduled_mask.sum() == 10
+    assert (res.assigned_node[res.scheduled_mask] >= 0).all()
+
+
+def test_capacity_limit():
+    # 1 node x 32 cpu; 40 jobs x 1 cpu -> 32 scheduled
+    snap, res = solve(cfg(), nodes(1), [QueueSpec("q")], [], [job(i) for i in range(40)])
+    assert res.scheduled_mask.sum() == 32
+
+
+def test_first_in_queue_order():
+    # queue order = priority then submit time: urgent job beats earlier ones
+    queued = [job(i) for i in range(32)] + [job(99).with_(priority=-1)]
+    snap, res = solve(cfg(), nodes(1), [QueueSpec("q")], [], queued)
+    assert res.scheduled_mask.sum() == 32
+    j_urgent = snap.job_ids.index("job-0099")
+    assert res.scheduled_mask[j_urgent]
+
+
+def test_drf_fair_split_two_queues():
+    # 2 queues, equal weight, 1 node x 32 cpu, 32+ jobs each -> 16/16
+    queued = [job(i, queue="a") for i in range(32)] + [
+        job(100 + i, queue="b") for i in range(32)
+    ]
+    snap, res = solve(cfg(), nodes(1), [QueueSpec("a"), QueueSpec("b")], [], queued)
+    by_queue = {}
+    for j in np.flatnonzero(res.scheduled_mask):
+        q = int(snap.job_queue[j])
+        by_queue[q] = by_queue.get(q, 0) + 1
+    assert by_queue == {0: 16, 1: 16}
+
+
+def test_weighted_queues():
+    # priority_factor 1 vs 3: weight 1 vs 1/3 -> 24/8 split of 32 cores
+    queued = [job(i, queue="a") for i in range(32)] + [
+        job(100 + i, queue="b") for i in range(32)
+    ]
+    snap, res = solve(
+        cfg(), nodes(1), [QueueSpec("a", 1.0), QueueSpec("b", 3.0)], [], queued
+    )
+    by_queue = {}
+    for j in np.flatnonzero(res.scheduled_mask):
+        q = int(snap.job_queue[j])
+        by_queue[q] = by_queue.get(q, 0) + 1
+    assert by_queue[0] == 24 and by_queue[1] == 8
+
+
+def test_undemanding_queue_share_redistributed():
+    # queue a wants only 4; queue b unlimited -> b gets the rest
+    queued = [job(i, queue="a") for i in range(4)] + [
+        job(100 + i, queue="b") for i in range(40)
+    ]
+    snap, res = solve(cfg(), nodes(1), [QueueSpec("a"), QueueSpec("b")], [], queued)
+    by_queue = {}
+    for j in np.flatnonzero(res.scheduled_mask):
+        q = int(snap.job_queue[j])
+        by_queue[q] = by_queue.get(q, 0) + 1
+    assert by_queue == {0: 4, 1: 28}
+
+
+def test_gang_all_or_nothing_failure():
+    # gang of 3 x 20 cpu on 2x32 nodes: only one per node, 2 < 3 -> none
+    g = Gang(id="g1", cardinality=3)
+    queued = [job(i, cpu="20", gang=g) for i in range(3)]
+    snap, res = solve(cfg(), nodes(2), [QueueSpec("q")], [], queued)
+    assert res.scheduled_mask.sum() == 0
+
+
+def test_gang_success():
+    g = Gang(id="g1", cardinality=3)
+    queued = [job(i, cpu="16", gang=g) for i in range(3)]
+    snap, res = solve(cfg(), nodes(3), [QueueSpec("q")], [], queued)
+    assert res.scheduled_mask.sum() == 3
+
+
+def test_gang_failure_does_not_block_singletons():
+    g = Gang(id="g1", cardinality=2)
+    queued = [job(0, cpu="20", gang=g), job(1, cpu="20", gang=g), job(2, cpu="4")]
+    snap, res = solve(cfg(), nodes(1), [QueueSpec("q")], [], queued)
+    # gang (40 cpu) cannot fit on 32-cpu node; the singleton still schedules
+    assert res.scheduled_mask.sum() == 1
+    j2 = snap.job_ids.index("job-0002")
+    assert res.scheduled_mask[j2]
+
+
+PREEMPT_CFG = cfg(
+    priority_classes={
+        "high": PriorityClass("high", 30000, preemptible=False),
+        "low": PriorityClass("low", 1000, preemptible=True),
+    },
+    default_priority_class="high",
+    protected_fraction_of_fair_share=1.0,
+)
+
+
+def test_urgency_preemption():
+    # node full of preemptible low-prio from queue b; high-prio queued job
+    # from queue a preempts via urgency
+    running = [
+        RunningJob(
+            job=job(i, queue="b", cpu="8", priority_class="low"),
+            node_id="node-000",
+            scheduled_at_priority=1000,
+        )
+        for i in range(4)
+    ]
+    queued = [job(100, queue="a", cpu="8", priority_class="high")]
+    snap, res = solve(
+        PREEMPT_CFG, nodes(1), [QueueSpec("a"), QueueSpec("b")], running, queued
+    )
+    assert res.scheduled_mask.sum() == 1
+    # exactly one low job preempted to make room (fair-share eviction may
+    # reshuffle but capacity forces >= 1 preemption)
+    assert res.preempted_mask.sum() >= 1
+    total_cpu = snap.factory.index_of("cpu")
+    # node not oversubscribed at the end: bound jobs' cpu <= 32
+    bound = [
+        j
+        for j in range(snap.num_jobs)
+        if res.assigned_node[j] == 0
+    ]
+    assert sum(int(snap.job_req[j][total_cpu]) for j in bound) <= 32000
+
+
+def test_non_preemptible_not_evicted():
+    running = [
+        RunningJob(
+            job=job(i, queue="b", cpu="8", priority_class="high"),
+            node_id="node-000",
+            scheduled_at_priority=30000,
+        )
+        for i in range(4)
+    ]
+    queued = [job(100, queue="a", cpu="8", priority_class="high")]
+    snap, res = solve(
+        PREEMPT_CFG, nodes(1), [QueueSpec("a"), QueueSpec("b")], running, queued
+    )
+    assert res.preempted_mask.sum() == 0
+    assert res.scheduled_mask.sum() == 0
+
+
+def test_protected_fair_share_prevents_eviction():
+    # queue b holds half the cluster = exactly its fair share -> protected
+    protected = cfg(
+        priority_classes={
+            "low": PriorityClass("low", 1000, preemptible=True),
+        },
+        default_priority_class="low",
+        protected_fraction_of_fair_share=1.0,
+    )
+    running = [
+        RunningJob(
+            job=job(i, queue="b", cpu="8", priority_class="low"),
+            node_id="node-000",
+            scheduled_at_priority=1000,
+        )
+        for i in range(2)
+    ]
+    queued = [job(100 + i, queue="a", cpu="8", priority_class="low") for i in range(2)]
+    snap, res = solve(
+        protected, nodes(1), [QueueSpec("a"), QueueSpec("b")], running, queued
+    )
+    # b is at 16/32 = its fair share; not above it -> no preemption
+    assert res.preempted_mask.sum() == 0
+    assert res.scheduled_mask.sum() == 2
+
+
+def test_fair_share_eviction_rebalances():
+    # queue b hogs the whole node with preemptible jobs; queue a arrives:
+    # eviction + rescheduling splits 50/50
+    balance = cfg(
+        priority_classes={"low": PriorityClass("low", 1000, preemptible=True)},
+        default_priority_class="low",
+        protected_fraction_of_fair_share=0.5,
+    )
+    running = [
+        RunningJob(
+            job=job(i, queue="b", cpu="4", priority_class="low"),
+            node_id="node-000",
+            scheduled_at_priority=1000,
+        )
+        for i in range(8)
+    ]
+    queued = [job(100 + i, queue="a", cpu="4", priority_class="low") for i in range(8)]
+    snap, res = solve(
+        balance, nodes(1), [QueueSpec("a"), QueueSpec("b")], running, queued
+    )
+    assert res.scheduled_mask.sum() == 4
+    assert res.preempted_mask.sum() == 4
+
+
+def test_rate_limit_burst():
+    from armada_tpu.core.config import RateLimits
+
+    limited = cfg(rate_limits=RateLimits(maximum_scheduling_burst=5))
+    snap, res = solve(limited, nodes(2), [QueueSpec("q")], [], [job(i) for i in range(10)])
+    assert res.scheduled_mask.sum() == 5
+
+
+def test_per_round_resource_fraction():
+    frac = cfg(maximum_resource_fraction_to_schedule={"cpu": 0.25})
+    # 32 cpu node, cap 8 cpu per round -> 8 one-cpu jobs, the check allows
+    # the round to stop once exceeded
+    snap, res = solve(frac, nodes(1), [QueueSpec("q")], [], [job(i) for i in range(20)])
+    assert res.scheduled_mask.sum() == 9  # limit checked before gang: overshoot by 1
+    assert res.termination_reason == "maximum resources scheduled"
+
+
+def test_node_selector_restricts_placement():
+    ns = nodes(2)
+    ns[1] = NodeSpec(
+        id="node-001",
+        pool="default",
+        labels={"zone": "west"},
+        total_resources={"cpu": "32", "memory": "256Gi"},
+    )
+    queued = [job(0, node_selector={"zone": "west"})]
+    snap, res = solve(cfg(), ns, [QueueSpec("q")], [], queued)
+    assert res.scheduled_mask.sum() == 1
+    assert snap.node_ids[res.assigned_node[snap.job_ids.index("job-0000")]] == "node-001"
+
+
+def test_best_fit_prefers_smaller_node():
+    ns = [
+        NodeSpec(id="big", pool="default", total_resources={"cpu": "64", "memory": "256Gi"}),
+        NodeSpec(id="small", pool="default", total_resources={"cpu": "8", "memory": "64Gi"}),
+    ]
+    snap, res = solve(cfg(), ns, [QueueSpec("q")], [], [job(0, cpu="2")])
+    # best-fit: node with least allocatable first
+    assert snap.node_ids[res.assigned_node[0]] == "small"
+
+
+def test_evicted_job_returns_home():
+    # eviction happens (unprotected), but there's room for everyone:
+    # all evicted jobs reschedule onto their original node; nothing preempted
+    balance = cfg(
+        priority_classes={"low": PriorityClass("low", 1000, preemptible=True)},
+        default_priority_class="low",
+        protected_fraction_of_fair_share=0.1,
+    )
+    running = [
+        RunningJob(
+            job=job(i, queue="b", cpu="4", priority_class="low"),
+            node_id="node-001",
+            scheduled_at_priority=1000,
+        )
+        for i in range(4)
+    ]
+    snap, res = solve(balance, nodes(2), [QueueSpec("b")], running, [])
+    assert res.preempted_mask.sum() == 0
+    for j in range(4):
+        assert snap.node_ids[res.assigned_node[j]] == "node-001"
+
+
+def test_incomplete_gang_never_schedules():
+    g = Gang(id="g1", cardinality=5)
+    queued = [job(i, gang=g) for i in range(3)]
+    snap, res = solve(cfg(), nodes(2), [QueueSpec("q")], [], queued)
+    assert res.scheduled_mask.sum() == 0
+
+
+def test_non_preemptible_blocks_higher_priority_overpack():
+    # Node saturated by non-preemptible low-priority jobs: a higher-priority
+    # job must NOT urgency-preempt past them (priorityCutoffFor semantics,
+    # nodedb.go:1017-1032) — nothing can be evicted, so nothing schedules.
+    mixed = cfg(
+        priority_classes={
+            "high": PriorityClass("high", 30000, preemptible=False),
+            "low-solid": PriorityClass("low-solid", 1000, preemptible=False),
+        },
+        default_priority_class="high",
+    )
+    running = [
+        RunningJob(
+            job=job(i, queue="b", cpu="8", priority_class="low-solid"),
+            node_id="node-000",
+            scheduled_at_priority=1000,
+        )
+        for i in range(4)
+    ]
+    queued = [job(100, queue="a", cpu="8", priority_class="high")]
+    snap, res = solve(mixed, nodes(1), [QueueSpec("a"), QueueSpec("b")], running, queued)
+    assert res.scheduled_mask.sum() == 0
+    assert res.preempted_mask.sum() == 0
+
+
+def test_queue_lookback_limit():
+    limited = cfg(max_queue_lookback=5)
+    snap, res = solve(limited, nodes(1), [QueueSpec("q")], [], [job(i) for i in range(20)])
+    assert res.scheduled_mask.sum() == 5
