@@ -1,0 +1,126 @@
+"""Event-sourced state transitions: the single source of truth.
+
+Equivalent in information content to the reference's EventSequence protobuf
+(/root/reference/pkg/armadaevents/events.proto:66-97): every job/run state
+transition is an event in a durable, jobset-keyed log; the scheduler database,
+the event API and the query views are all materializations of this log.
+Python dataclasses here; the wire encoding (msgpack/proto) lives with the
+transports that need it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as _time
+from dataclasses import dataclass, field
+
+from ..core.types import JobSpec
+
+_id_counter = itertools.count(1)
+
+
+def new_id(prefix: str = "id") -> str:
+    return f"{prefix}-{next(_id_counter):012d}"
+
+
+@dataclass(frozen=True)
+class Event:
+    """Base event; `created` is seconds since epoch (virtual time in sim)."""
+
+    created: float = 0.0
+
+
+@dataclass(frozen=True)
+class SubmitJob(Event):
+    job: JobSpec = None  # type: ignore[assignment]
+    deduplication_id: str = ""
+
+
+@dataclass(frozen=True)
+class CancelJob(Event):
+    job_id: str = ""
+    reason: str = ""
+
+
+@dataclass(frozen=True)
+class CancelJobSet(Event):
+    reason: str = ""
+
+
+@dataclass(frozen=True)
+class ReprioritiseJob(Event):
+    job_id: str = ""
+    priority: int = 0
+
+
+@dataclass(frozen=True)
+class JobRunLeased(Event):
+    job_id: str = ""
+    run_id: str = ""
+    executor: str = ""
+    node_id: str = ""
+    pool: str = ""
+    scheduled_at_priority: int = 0
+
+
+@dataclass(frozen=True)
+class JobRunRunning(Event):
+    job_id: str = ""
+    run_id: str = ""
+
+
+@dataclass(frozen=True)
+class JobRunSucceeded(Event):
+    job_id: str = ""
+    run_id: str = ""
+
+
+@dataclass(frozen=True)
+class JobRunErrors(Event):
+    job_id: str = ""
+    run_id: str = ""
+    error: str = ""
+    retryable: bool = True
+
+
+@dataclass(frozen=True)
+class JobRunPreempted(Event):
+    job_id: str = ""
+    run_id: str = ""
+    reason: str = ""
+
+
+@dataclass(frozen=True)
+class JobSucceeded(Event):
+    job_id: str = ""
+
+
+@dataclass(frozen=True)
+class JobErrors(Event):
+    job_id: str = ""
+    error: str = ""
+
+
+@dataclass(frozen=True)
+class JobRequeued(Event):
+    job_id: str = ""
+
+
+@dataclass(frozen=True)
+class EventSequence:
+    """A batch of events for one (queue, jobset), the log's unit of
+    publication (events.proto:66; jobset-keyed routing as in
+    internal/common/pulsarutils/jobsetevents/)."""
+
+    queue: str
+    jobset: str
+    events: tuple = ()
+    user: str = ""
+
+    @staticmethod
+    def of(queue: str, jobset: str, *events: Event, user: str = "") -> "EventSequence":
+        return EventSequence(queue=queue, jobset=jobset, events=tuple(events), user=user)
+
+
+def now() -> float:
+    return _time.time()

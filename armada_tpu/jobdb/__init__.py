@@ -1,0 +1,3 @@
+from .jobdb import Job, JobDb, JobRun, JobState, RunState
+
+__all__ = ["Job", "JobDb", "JobRun", "JobState", "RunState"]

@@ -1,0 +1,125 @@
+"""Event-log -> jobdb materialization (the scheduler ingester).
+
+The reference converts EventSequences into typed DbOperations applied to
+Postgres (/root/reference/internal/scheduleringester/{instructions,dbops}.go,
+~40 op types) which the scheduler then delta-polls into its in-memory jobDb
+(scheduler.go:441 syncState). Single-process deployments here skip the SQL
+hop: events apply straight to the JobDb inside one transaction, with the
+same state-machine semantics. The cursor the caller tracks is the log
+offset — identical recovery model (replay from cursor, at-least-once,
+idempotent application).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from .. import events as ev
+from .jobdb import Job, JobDb, JobRun, JobState, RunState
+
+
+def apply_entry(txn, entry) -> None:
+    seq: ev.EventSequence = entry.sequence
+    for event in seq.events:
+        _apply_event(txn, seq, event)
+
+
+def _apply_event(txn, seq: ev.EventSequence, event) -> None:
+    if isinstance(event, ev.SubmitJob):
+        if txn.get(event.job.id) is not None:
+            return  # idempotent replay
+        txn.upsert(
+            Job(
+                spec=event.job,
+                state=JobState.QUEUED,
+                priority=event.job.priority,
+                submitted=event.created,
+            )
+        )
+        return
+
+    if isinstance(event, ev.CancelJobSet):
+        for job in list(txn.all_jobs()):
+            if job.queue == seq.queue and job.jobset == seq.jobset and not job.state.terminal:
+                txn.upsert(job.with_(state=JobState.CANCELLED))
+        return
+
+    job = txn.get(getattr(event, "job_id", ""))
+    if job is None or job.state.terminal:
+        return
+
+    if isinstance(event, ev.CancelJob):
+        txn.upsert(job.with_(state=JobState.CANCELLED))
+    elif isinstance(event, ev.ReprioritiseJob):
+        txn.upsert(job.with_(priority=event.priority))
+    elif isinstance(event, ev.JobRunLeased):
+        run = JobRun(
+            id=event.run_id,
+            job_id=job.id,
+            executor=event.executor,
+            node_id=event.node_id,
+            pool=event.pool,
+            scheduled_at_priority=event.scheduled_at_priority,
+            state=RunState.LEASED,
+            attempt=job.num_attempts,
+        )
+        txn.upsert(job.with_(state=JobState.LEASED, runs=job.runs + (run,)))
+    elif isinstance(event, ev.JobRunRunning):
+        run = job.latest_run
+        if run and run.id == event.run_id:
+            run = replace(run, state=RunState.RUNNING)
+            txn.upsert(job.with_(state=JobState.RUNNING, runs=job.runs[:-1] + (run,)))
+    elif isinstance(event, ev.JobRunSucceeded):
+        run = job.latest_run
+        if run and run.id == event.run_id:
+            run = replace(run, state=RunState.SUCCEEDED)
+            txn.upsert(job.with_(runs=job.runs[:-1] + (run,)))
+    elif isinstance(event, ev.JobSucceeded):
+        txn.upsert(job.with_(state=JobState.SUCCEEDED))
+    elif isinstance(event, ev.JobRunPreempted):
+        run = job.latest_run
+        if run and run.id == event.run_id:
+            run = replace(run, state=RunState.PREEMPTED)
+            txn.upsert(
+                job.with_(state=JobState.PREEMPTED, runs=job.runs[:-1] + (run,))
+            )
+    elif isinstance(event, ev.JobRunErrors):
+        run = job.latest_run
+        if run and run.id == event.run_id:
+            run = replace(run, state=RunState.FAILED)
+            failed_nodes = job.failed_nodes + ((run.node_id,) if run.node_id else ())
+            txn.upsert(
+                job.with_(runs=job.runs[:-1] + (run,), failed_nodes=failed_nodes,
+                          error=event.error)
+            )
+    elif isinstance(event, ev.JobRequeued):
+        txn.upsert(job.with_(state=JobState.QUEUED))
+    elif isinstance(event, ev.JobErrors):
+        txn.upsert(job.with_(state=JobState.FAILED, error=event.error))
+
+
+class SchedulerIngester:
+    """Cursor-tracked consumer materializing the log into a JobDb."""
+
+    def __init__(self, log, jobdb: JobDb):
+        self.log = log
+        self.jobdb = jobdb
+        self.cursor = 0
+
+    def sync(self, limit: int = 10_000) -> int:
+        """Apply new log entries; returns number applied."""
+        applied = 0
+        while True:
+            entries = self.log.read(self.cursor, limit)
+            if not entries:
+                return applied
+            txn = self.jobdb.write_txn()
+            try:
+                for entry in entries:
+                    apply_entry(txn, entry)
+                txn.commit()
+            except Exception:
+                txn.abort()
+                raise
+            self.cursor = entries[-1].offset + 1
+            applied += len(entries)

@@ -1,0 +1,232 @@
+"""Host-side job store with snapshot transactions.
+
+The scheduler-facing equivalent of the reference's in-memory jobDb
+(/root/reference/internal/scheduler/jobdb/jobdb.go:68): job and run records,
+MVCC-style transactions (writers see a private copy until commit), and the
+indexes the scheduling loop needs — queued-by-queue in fair-share order,
+leased set, gang membership. The reference builds this on immutable
+radix/AVL maps; here a copy-on-write dict + lazily sorted per-queue views
+give the same semantics with far less machinery (the hot path reads whole
+columns into the snapshot builder anyway).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field, replace
+
+from ..core.types import JobSpec
+
+
+class JobState(enum.Enum):
+    QUEUED = "queued"
+    LEASED = "leased"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+    PREEMPTED = "preempted"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (
+            JobState.SUCCEEDED,
+            JobState.FAILED,
+            JobState.CANCELLED,
+            JobState.PREEMPTED,
+        )
+
+
+class RunState(enum.Enum):
+    LEASED = "leased"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    PREEMPTED = "preempted"
+
+
+@dataclass(frozen=True)
+class JobRun:
+    """One attempt at executing a job (jobdb/job_run.go)."""
+
+    id: str
+    job_id: str
+    executor: str = ""
+    node_id: str = ""
+    pool: str = ""
+    scheduled_at_priority: int = 0
+    state: RunState = RunState.LEASED
+    attempt: int = 0
+
+
+@dataclass(frozen=True)
+class Job:
+    """Immutable job record; updates produce new instances
+    (jobdb/job.go:23-83)."""
+
+    spec: JobSpec
+    state: JobState = JobState.QUEUED
+    priority: int = 0  # current (may be reprioritised)
+    runs: tuple = ()
+    serial: int = 0
+    submitted: float = 0.0
+    # Nodes where previous attempts failed (anti-affinity on retry,
+    # scheduler.go:589-636).
+    failed_nodes: tuple = ()
+    error: str = ""
+
+    @property
+    def id(self) -> str:
+        return self.spec.id
+
+    @property
+    def queue(self) -> str:
+        return self.spec.queue
+
+    @property
+    def jobset(self) -> str:
+        return self.spec.jobset
+
+    @property
+    def latest_run(self) -> JobRun | None:
+        return self.runs[-1] if self.runs else None
+
+    @property
+    def num_attempts(self) -> int:
+        return len(self.runs)
+
+    def with_(self, **kw) -> "Job":
+        return replace(self, **kw)
+
+
+class JobDbTxn:
+    """A read-your-writes view over the parent store. Commit is atomic;
+    conflicting commits are prevented by the store's single-writer lock
+    (the reference serializes write txns the same way, jobdb.go:362)."""
+
+    def __init__(self, db: "JobDb", writable: bool):
+        self._db = db
+        self._writable = writable
+        self._writes: dict[str, Job | None] = {}  # id -> job (None = delete)
+        self._base = db._jobs
+        self._committed = False
+
+    def get(self, job_id: str) -> Job | None:
+        if job_id in self._writes:
+            return self._writes[job_id]
+        return self._base.get(job_id)
+
+    def upsert(self, *jobs: Job):
+        assert self._writable, "read-only transaction"
+        for job in jobs:
+            self._writes[job.id] = job
+
+    def delete(self, job_id: str):
+        assert self._writable, "read-only transaction"
+        self._writes[job_id] = None
+
+    def all_jobs(self):
+        seen = set()
+        for jid, job in self._writes.items():
+            seen.add(jid)
+            if job is not None:
+                yield job
+        for jid, job in self._base.items():
+            if jid not in seen:
+                yield job
+
+    def queued_jobs(self, queue: str | None = None) -> list[Job]:
+        """Queued jobs in fair-share order: (priority, submitted, id) —
+        jobdb.go:27-31 FairShareOrder."""
+        jobs = [
+            j
+            for j in self.all_jobs()
+            if j.state == JobState.QUEUED and (queue is None or j.queue == queue)
+        ]
+        jobs.sort(key=lambda j: (j.priority, j.submitted, j.id))
+        return jobs
+
+    def leased_jobs(self) -> list[Job]:
+        return [
+            j
+            for j in self.all_jobs()
+            if j.state in (JobState.LEASED, JobState.PENDING, JobState.RUNNING)
+        ]
+
+    def gang_jobs(self, queue: str, gang_id: str) -> list[Job]:
+        return [
+            j
+            for j in self.all_jobs()
+            if j.spec.gang is not None
+            and j.spec.gang.id == gang_id
+            and j.queue == queue
+            and not j.state.terminal
+        ]
+
+    def commit(self):
+        assert self._writable and not self._committed
+        self._db._commit(self._writes)
+        self._committed = True
+
+    def abort(self):
+        self._writes.clear()
+
+    def assert_valid(self):
+        """Invariant checks, the jobdb.Assert equivalent (jobdb.go:475)."""
+        for job in self.all_jobs():
+            if job.state == JobState.QUEUED:
+                assert not job.runs or job.runs[-1].state in (
+                    RunState.FAILED,
+                    RunState.PREEMPTED,
+                ), f"queued job {job.id} has live run"
+            if job.state in (JobState.LEASED, JobState.RUNNING, JobState.PENDING):
+                assert job.runs, f"{job.state} job {job.id} has no runs"
+
+
+class JobDb:
+    def __init__(self):
+        self._jobs: dict[str, Job] = {}
+        self._write_lock = threading.Lock()
+        self.serial = 0
+
+    def read_txn(self) -> JobDbTxn:
+        return JobDbTxn(self, writable=False)
+
+    def write_txn(self) -> JobDbTxn:
+        self._write_lock.acquire()
+        txn = JobDbTxn(self, writable=True)
+        orig_commit, orig_abort = txn.commit, txn.abort
+
+        def commit():
+            try:
+                orig_commit()
+            finally:
+                self._write_lock.release()
+
+        def abort():
+            try:
+                orig_abort()
+            finally:
+                self._write_lock.release()
+
+        txn.commit, txn.abort = commit, abort
+        return txn
+
+    def _commit(self, writes: dict):
+        new = dict(self._jobs)
+        for jid, job in writes.items():
+            if job is None:
+                new.pop(jid, None)
+            else:
+                self.serial += 1
+                new[jid] = job.with_(serial=self.serial)
+        self._jobs = new  # atomic swap; readers keep their snapshot
+
+    def get(self, job_id: str) -> Job | None:
+        return self._jobs.get(job_id)
+
+    def __len__(self) -> int:
+        return len(self._jobs)

@@ -1,0 +1,25 @@
+"""Bitset predicates on uint32 word arrays (vectorized over leading axes).
+
+These are the device-side forms of the matching predicates in
+/root/reference/internal/scheduler/nodedb/nodematching.go: taint tolerance and
+node-selector subset checks become single bitwise reductions per node.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bits_subset(required, available):
+    """True where every set bit of `required` is set in `available`.
+
+    required: [..., W]; available: [..., W] (broadcastable). Used for node
+    selectors: job requires labels -> node must carry them all.
+    """
+    return jnp.all((required & ~available) == 0, axis=-1)
+
+
+def bits_disjoint(a, b):
+    """True where `a & b == 0` across all words. Used for taints: node's
+    blocking taints must all be tolerated, i.e. taints & ~tolerated == 0."""
+    return jnp.all((a & b) == 0, axis=-1)

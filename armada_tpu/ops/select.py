@@ -1,0 +1,46 @@
+"""Masked lexicographic argmin: the vectorized candidate selection.
+
+The reference iterates nodes from least to most allocatable at the target
+priority over the indexed resources, tie-broken by node id
+(nodeiteration.go:170-185), and takes the first feasible one. Dense form:
+among feasible nodes, take the lexicographic argmin of
+(key_0, key_1, ..., id_rank) — computed by iterative mask refinement,
+one masked-min reduction per key level. O(K * N), fully parallel, and
+reduces cleanly across device shards (each shard returns its local winner;
+a tiny cross-shard argmin picks the global one).
+
+The same primitive picks the next queue in the candidate-gang loop (float
+cost keys) — any total order expressible as a lexicographic key works.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _sentinel(dtype):
+    dtype = jnp.dtype(dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+
+
+def masked_min(values, mask):
+    """Min of values where mask, else the dtype's max sentinel."""
+    return jnp.min(jnp.where(mask, values, _sentinel(values.dtype)))
+
+
+def lex_argmin(keys, mask):
+    """Index of the lexicographically smallest entry among masked entries.
+
+    keys: list of [N] arrays (int or float), most-significant first; the last
+    key must be unique among masked entries (e.g. an id rank).
+    Returns (index int32, found bool); index is 0 when nothing matches.
+    """
+    m = mask
+    for k in keys:
+        best = masked_min(k, m)
+        m = m & (k == best)
+    found = jnp.any(mask)
+    idx = jnp.argmax(m)  # final key unique -> at most one bit set
+    return jnp.where(found, idx, 0).astype(jnp.int32), found

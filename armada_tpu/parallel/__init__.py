@@ -1,0 +1,3 @@
+from .mesh import node_sharded_solve, make_node_mesh, pad_nodes
+
+__all__ = ["node_sharded_solve", "make_node_mesh", "pad_nodes"]

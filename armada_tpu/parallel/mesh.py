@@ -1,0 +1,104 @@
+"""Multi-chip execution: the node axis sharded over a device mesh.
+
+The reference scales by partitioning nodes across Kubernetes clusters, one
+executor each, with the scheduler seeing the union
+(/root/reference/internal/scheduler/scheduling/scheduling_algo.go:135-147).
+The TPU-native analogue: one mesh axis ("nodes") over which every per-node
+tensor (allocatable[P, N, R], taint/label bitsets, totals) is sharded, so
+each chip owns one cluster's worth of nodes. Candidate selection inside the
+solve is a masked lexicographic argmin over N — under jit with shardings,
+XLA lowers the min-reductions to per-shard reductions plus tiny cross-chip
+collectives riding ICI; binds are scatter-updates landing on the owning
+shard only.
+
+The solve itself is unchanged (solver/kernel.py): jit + sharding annotations
+partition it. Job/queue/slot tensors are small relative to nodes and stay
+replicated; at 1M jobs the job axis can be sharded the same way later.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..solver.kernel import solve_impl
+from ..solver.kernel_prep import DeviceRound
+
+# Per-field partition specs: node-axis position in each sharded array.
+_NODE_SHARDED = {
+    "alloc0": P(None, "nodes", None),
+    "node_total": P("nodes", None),
+    "node_taints": P("nodes", None),
+    "node_labels": P("nodes", None),
+    "node_id_rank": P("nodes",),
+    "node_unschedulable": P("nodes",),
+}
+
+
+def make_node_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), ("nodes",))
+
+
+def pad_nodes(dev: DeviceRound, multiple: int) -> DeviceRound:
+    """Pad the node axis so it divides the mesh. Padded nodes are inert:
+    unschedulable, zero resources, worst id-rank."""
+    n = dev.node_total.shape[0]
+    pad = (-n) % multiple
+    if pad == 0:
+        return dev
+    total = n + pad
+
+    def pad_axis(arr, axis, fill=0):
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, pad)
+        return np.pad(np.asarray(arr), widths, constant_values=fill)
+
+    return dataclasses.replace(
+        dev,
+        alloc0=pad_axis(dev.alloc0, 1),
+        node_total=pad_axis(dev.node_total, 0),
+        node_taints=pad_axis(dev.node_taints, 0),
+        node_labels=pad_axis(dev.node_labels, 0),
+        node_id_rank=np.concatenate(
+            [np.asarray(dev.node_id_rank), np.arange(n, total, dtype=np.int32)]
+        ),
+        node_unschedulable=np.concatenate(
+            [np.asarray(dev.node_unschedulable), np.ones(pad, dtype=bool)]
+        ),
+    )
+
+
+def node_sharded_solve(mesh: Mesh):
+    """Jitted round solve with node-sharded inputs over `mesh`.
+
+    Returns a callable dev -> outputs. Inputs must have the node axis padded
+    to a multiple of the mesh size (pad_nodes)."""
+
+    def shardings_for(dev: DeviceRound):
+        spec = {}
+        for f in dataclasses.fields(DeviceRound):
+            if f.name in _NODE_SHARDED:
+                spec[f.name] = NamedSharding(mesh, _NODE_SHARDED[f.name])
+            else:
+                spec[f.name] = NamedSharding(mesh, P())
+        return spec
+
+    jitted = jax.jit(solve_impl)  # shared across rounds: cache by shape
+
+    def run(dev: DeviceRound):
+        spec = shardings_for(dev)
+        placed = {}
+        for f in dataclasses.fields(DeviceRound):
+            v = getattr(dev, f.name)
+            if isinstance(v, (np.ndarray, jax.Array)):
+                placed[f.name] = jax.device_put(v, spec[f.name])
+            else:
+                placed[f.name] = v
+        dev_placed = dataclasses.replace(dev, **placed)
+        return jitted(dev_placed)
+
+    return run

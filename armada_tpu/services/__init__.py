@@ -1,0 +1,4 @@
+from .scheduler import SchedulerService, ExecutorHeartbeat
+from .submit import SubmitService
+
+__all__ = ["SchedulerService", "ExecutorHeartbeat", "SubmitService"]

@@ -1,0 +1,143 @@
+"""Fake executor: simulated worker cluster with zero Kubernetes.
+
+The reference's fakeexecutor (/root/reference/internal/executor/fake,
+cmd/fakeexecutor/main.go:31) runs the full executor wiring against a
+simulated cluster context where pods "run" as timed sleeps — enabling whole
+control-plane runs with no kube-api. Same here: a FakeExecutor owns N
+synthetic nodes, consumes leases from the scheduler, walks each run through
+leased -> running -> succeeded on a (virtual or real) clock, and reports
+state back through the event log.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..core.types import NodeSpec
+from ..events import (
+    EventSequence,
+    JobRunRunning,
+    JobRunSucceeded,
+    JobSucceeded,
+)
+from .scheduler import ExecutorHeartbeat
+
+
+def make_nodes(
+    executor: str,
+    count: int = 500,
+    pool: str = "default",
+    cpu: str = "8",
+    memory: str = "128Gi",
+    labels: dict | None = None,
+    taints=(),
+) -> list[NodeSpec]:
+    """Default shape mirrors the reference fake executor: 500 x 8 cpu /
+    128Gi (internal/executor/fake/context/context.go:40-49)."""
+    return [
+        NodeSpec(
+            id=f"{executor}-node-{i:05d}",
+            name=f"{executor}-node-{i:05d}",
+            executor=executor,
+            pool=pool,
+            labels=dict(labels or {}),
+            taints=tuple(taints),
+            total_resources={"cpu": cpu, "memory": memory},
+        )
+        for i in range(count)
+    ]
+
+
+@dataclass
+class _ActiveRun:
+    run_id: str
+    job_id: str
+    queue: str
+    jobset: str
+    started: float
+    finishes_at: float
+    running_reported: bool = False
+
+
+class FakeExecutor:
+    """One simulated cluster; drive with tick(now)."""
+
+    def __init__(
+        self,
+        name: str,
+        log,
+        scheduler,
+        nodes: list[NodeSpec] | None = None,
+        pool: str = "default",
+        runtime_for=lambda job_id: 30.0,
+        startup_delay: float = 0.0,
+    ):
+        self.name = name
+        self.log = log
+        self.scheduler = scheduler
+        self.pool = pool
+        self.nodes = nodes if nodes is not None else make_nodes(name, pool=pool)
+        self.runtime_for = runtime_for
+        self.startup_delay = startup_delay
+        self.active: dict[str, _ActiveRun] = {}
+        self._seen_runs: set[str] = set()
+
+    def heartbeat(self, now: float):
+        """Report node state (the LeaseRequest half of the lease loop)."""
+        self.scheduler.report_executor(
+            ExecutorHeartbeat(
+                name=self.name, pool=self.pool, nodes=self.nodes, last_seen=now
+            )
+        )
+
+    def accept_leases(self, now: float):
+        """Pick up new runs assigned to this executor from the jobdb (the
+        JobRunLease stream half; the scheduler wrote leases via events)."""
+        txn = self.scheduler.jobdb.read_txn()
+        for job in txn.leased_jobs():
+            run = job.latest_run
+            if run is None or run.executor != self.name:
+                continue
+            if run.id in self._seen_runs:
+                continue
+            self._seen_runs.add(run.id)
+            runtime = float(self.runtime_for(job.id))
+            self.active[run.id] = _ActiveRun(
+                run_id=run.id,
+                job_id=job.id,
+                queue=job.queue,
+                jobset=job.jobset,
+                started=now,
+                finishes_at=now + self.startup_delay + runtime,
+            )
+
+    def tick(self, now: float):
+        """Advance pod lifecycle; emit state-transition events."""
+        self.heartbeat(now)
+        self.accept_leases(now)
+        txn = self.scheduler.jobdb.read_txn()
+        for run in list(self.active.values()):
+            job = txn.get(run.job_id)
+            if job is None or job.state.terminal:
+                # cancelled or preempted underneath us
+                self.active.pop(run.run_id, None)
+                continue
+            if not run.running_reported and now >= run.started + self.startup_delay:
+                self.log.publish(
+                    EventSequence.of(
+                        run.queue,
+                        run.jobset,
+                        JobRunRunning(created=now, job_id=run.job_id, run_id=run.run_id),
+                    )
+                )
+                run.running_reported = True
+            if now >= run.finishes_at:
+                self.log.publish(
+                    EventSequence.of(
+                        run.queue,
+                        run.jobset,
+                        JobRunSucceeded(created=now, job_id=run.job_id, run_id=run.run_id),
+                        JobSucceeded(created=now, job_id=run.job_id),
+                    )
+                )
+                self.active.pop(run.run_id, None)

@@ -1,0 +1,258 @@
+"""The scheduler service: leader-gated cycle loop.
+
+Mirrors the structure of the reference's Scheduler.Run/cycle
+(/root/reference/internal/scheduler/scheduler.go:148,282):
+
+  each cycle: sync jobDb from the event log -> expire stale executors ->
+  per pool: snapshot (jobs x nodes -> tensors) -> solve -> derive events ->
+  publish to the log.
+
+The jobDb is updated via the ingester on the next sync (the log is the
+source of truth; publishing then re-consuming gives the same idempotent
+at-least-once recovery the reference gets from Pulsar + serials,
+scheduler.go:257-281). The solve runs either on the vectorized JAX kernel
+(production) or the Python oracle (debug/parity).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+
+from ..core.config import SchedulingConfig
+from ..core.types import NodeSpec, QueueSpec, RunningJob
+from ..events import (
+    EventSequence,
+    JobErrors,
+    JobRequeued,
+    JobRunErrors,
+    JobRunLeased,
+    JobRunPreempted,
+)
+from ..events.model import new_id
+from ..jobdb import JobDb, JobState
+from ..jobdb.ingest import SchedulerIngester
+from ..snapshot.round import build_round_snapshot
+
+
+@dataclass
+class ExecutorHeartbeat:
+    """Executor-reported cluster state (the LeaseRequest node snapshot,
+    pkg/executorapi/executorapi.proto)."""
+
+    name: str
+    pool: str
+    nodes: list
+    last_seen: float = 0.0
+
+
+class SchedulerService:
+    def __init__(
+        self,
+        config: SchedulingConfig,
+        log,
+        *,
+        backend: str = "oracle",
+        queues: list[QueueSpec] | None = None,
+        is_leader=lambda: True,
+    ):
+        self.config = config
+        self.log = log
+        self.jobdb = JobDb()
+        self.ingester = SchedulerIngester(log, self.jobdb)
+        self.backend = backend
+        self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
+        self.executors: dict[str, ExecutorHeartbeat] = {}
+        self.is_leader = is_leader
+        self.cycle_count = 0
+        self.last_cycle_stats: dict = {}
+
+    # ---- control-plane inputs ----
+
+    def upsert_queue(self, queue: QueueSpec):
+        self.queues[queue.name] = queue
+
+    def report_executor(self, hb: ExecutorHeartbeat):
+        self.executors[hb.name] = hb
+
+    # ---- cycle ----
+
+    def cycle(self, now: float | None = None) -> list[EventSequence]:
+        """One scheduling cycle; returns the published event sequences."""
+        if not self.is_leader():
+            return []
+        now = _time.time() if now is None else now
+        self.ingester.sync()
+        sequences: list[EventSequence] = []
+        sequences += self._expire_stale_executors(now)
+
+        pools = {hb.pool for hb in self.executors.values()} or {
+            p.name for p in self.config.pools
+        }
+        # Pools schedule sequentially against the same jobdb snapshot; jobs
+        # leased by an earlier pool are excluded from later pools (the
+        # reference writes each pool's results into the jobdb txn,
+        # scheduling_algo.go:147-188).
+        leased_this_cycle: set[str] = set()
+        for pool in sorted(pools):
+            pool_seqs = self._schedule_pool(pool, now, exclude=leased_this_cycle)
+            for seq in pool_seqs:
+                for event in seq.events:
+                    if isinstance(event, JobRunLeased):
+                        leased_this_cycle.add(event.job_id)
+            sequences += pool_seqs
+
+        for seq in sequences:
+            self.log.publish(seq)
+        self.ingester.sync()  # optimistic immediate apply (same process)
+        self.cycle_count += 1
+        return sequences
+
+    def _expire_stale_executors(self, now: float) -> list[EventSequence]:
+        """Jobs on executors that stopped heartbeating are requeued or
+        failed (scheduler.go:1099 expireJobsIfNecessary)."""
+        timeout = self.config.executor_timeout_s
+        stale = {
+            name
+            for name, hb in self.executors.items()
+            if now - hb.last_seen > timeout
+        }
+        for name in stale:
+            self.executors.pop(name, None)
+        if not stale:
+            return []
+        sequences = []
+        txn = self.jobdb.read_txn()
+        for job in txn.leased_jobs():
+            run = job.latest_run
+            if run is None or run.executor not in stale:
+                continue
+            events = [
+                JobRunErrors(
+                    created=now,
+                    job_id=job.id,
+                    run_id=run.id,
+                    error=f"executor {run.executor} timed out",
+                    retryable=True,
+                )
+            ]
+            if job.num_attempts >= self.config.max_retries + 1:
+                events.append(
+                    JobErrors(created=now, job_id=job.id, error="max retries exceeded")
+                )
+            else:
+                events.append(JobRequeued(created=now, job_id=job.id))
+            sequences.append(
+                EventSequence.of(job.queue, job.jobset, *events)
+            )
+        return sequences
+
+    def _build_pool_inputs(self, pool: str, exclude: set[str] = frozenset()):
+        nodes: list[NodeSpec] = []
+        node_executor: dict[str, str] = {}
+        for hb in self.executors.values():
+            if hb.pool != pool:
+                continue
+            for node in hb.nodes:
+                nodes.append(node)
+                node_executor[node.id] = hb.name
+
+        txn = self.jobdb.read_txn()
+        running: list[RunningJob] = []
+        for job in txn.leased_jobs():
+            run = job.latest_run
+            if run is None or run.pool != pool:
+                continue
+            running.append(
+                RunningJob(
+                    job=job.spec.with_(priority=job.priority),
+                    node_id=run.node_id,
+                    scheduled_at_priority=run.scheduled_at_priority,
+                )
+            )
+        queued = [
+            j.spec.with_(priority=j.priority)
+            for j in txn.queued_jobs()
+            if j.id not in exclude
+        ]
+        queue_names = {j.queue for j in queued} | {r.job.queue for r in running}
+        queues = [
+            self.queues.get(name, QueueSpec(name)) for name in sorted(queue_names)
+        ]
+        return nodes, queues, running, queued, node_executor, txn
+
+    def _schedule_pool(
+        self, pool: str, now: float, exclude: set[str] = frozenset()
+    ) -> list[EventSequence]:
+        nodes, queues, running, queued, node_executor, txn = self._build_pool_inputs(
+            pool, exclude
+        )
+        if not nodes or not (queued or running):
+            return []
+        snap = build_round_snapshot(
+            self.config, pool, nodes, queues, running, queued
+        )
+        result = self._solve(snap)
+        self.last_cycle_stats = {
+            "pool": pool,
+            "jobs": snap.num_jobs,
+            "nodes": snap.num_nodes,
+            "scheduled": int(result["scheduled_mask"].sum()),
+            "preempted": int(result["preempted_mask"].sum()),
+        }
+
+        by_jobset: dict[tuple, list] = {}
+        import numpy as np
+
+        for j in np.flatnonzero(result["scheduled_mask"]):
+            job = txn.get(snap.job_ids[j])
+            node_id = snap.node_ids[int(result["assigned_node"][j])]
+            event = JobRunLeased(
+                created=now,
+                job_id=job.id,
+                run_id=new_id("run"),
+                executor=node_executor.get(node_id, ""),
+                node_id=node_id,
+                pool=pool,
+                scheduled_at_priority=int(result["scheduled_priority"][j]),
+            )
+            by_jobset.setdefault((job.queue, job.jobset), []).append(event)
+
+        for j in np.flatnonzero(result["preempted_mask"]):
+            job = txn.get(snap.job_ids[j])
+            run = job.latest_run
+            event = JobRunPreempted(
+                created=now,
+                job_id=job.id,
+                run_id=run.id if run else "",
+                reason="preempted by scheduler round",
+            )
+            by_jobset.setdefault((job.queue, job.jobset), []).append(event)
+
+        return [
+            EventSequence.of(queue, jobset, *events)
+            for (queue, jobset), events in by_jobset.items()
+        ]
+
+    def _solve(self, snap):
+        if self.backend == "kernel":
+            from ..solver.kernel import solve_round
+            from ..solver.kernel_prep import pad_device_round, prep_device_round
+
+            out = solve_round(pad_device_round(prep_device_round(snap)))
+            J, Q = snap.num_jobs, snap.num_queues
+            return {
+                "assigned_node": out["assigned_node"][:J],
+                "scheduled_priority": out["scheduled_priority"][:J],
+                "scheduled_mask": out["scheduled_mask"][:J],
+                "preempted_mask": out["preempted_mask"][:J],
+            }
+        from ..solver.reference import ReferenceSolver
+
+        res = ReferenceSolver(snap).solve()
+        return {
+            "assigned_node": res.assigned_node,
+            "scheduled_priority": res.scheduled_priority,
+            "scheduled_mask": res.scheduled_mask,
+            "preempted_mask": res.preempted_mask,
+        }

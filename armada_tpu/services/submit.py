@@ -1,0 +1,155 @@
+"""Submission API: validate, default, deduplicate, publish.
+
+The in-process equivalent of the reference's submit server
+(/root/reference/internal/server/submit/submit.go): SubmitJobs validates and
+defaults each job, deduplicates by (queue, deduplication_id), converts to
+SubmitJob events and publishes them to the event log; cancel/reprioritise
+publish the corresponding jobset events. gRPC/REST transport wraps this
+object in services/grpc_api.py.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+
+from ..core.config import SchedulingConfig
+from ..core.types import JobSpec, QueueSpec
+from ..events import (
+    CancelJob,
+    CancelJobSet,
+    EventSequence,
+    ReprioritiseJob,
+    SubmitJob,
+)
+from ..events.model import new_id
+
+
+class SubmissionError(ValueError):
+    pass
+
+
+@dataclass
+class Queue:
+    """Control-plane queue record (pkg/client queue API): permissions and
+    cordoning are modeled; auth enforcement lives in the transport."""
+
+    spec: QueueSpec
+    cordoned: bool = False
+    labels: dict = field(default_factory=dict)
+
+
+class SubmitService:
+    def __init__(self, config: SchedulingConfig, log, scheduler=None):
+        self.config = config
+        self.log = log
+        self.scheduler = scheduler  # optional: queue updates pushed through
+        self.queues: dict[str, Queue] = {}
+        self._dedup: dict[tuple, str] = {}  # (queue, dedup_id) -> job_id
+
+    # ---- queue CRUD (internal/server/queue) ----
+
+    def create_queue(self, spec: QueueSpec, cordoned: bool = False) -> Queue:
+        if spec.name in self.queues:
+            raise SubmissionError(f"queue {spec.name!r} already exists")
+        q = Queue(spec=spec, cordoned=cordoned)
+        self.queues[spec.name] = q
+        if self.scheduler is not None:
+            self.scheduler.upsert_queue(spec)
+        return q
+
+    def update_queue(self, spec: QueueSpec, cordoned: bool | None = None) -> Queue:
+        q = self.queues.get(spec.name)
+        if q is None:
+            raise SubmissionError(f"queue {spec.name!r} does not exist")
+        q.spec = spec
+        if cordoned is not None:
+            q.cordoned = cordoned
+        if self.scheduler is not None:
+            self.scheduler.upsert_queue(spec)
+        return q
+
+    def delete_queue(self, name: str):
+        self.queues.pop(name, None)
+
+    def get_queue(self, name: str) -> Queue | None:
+        return self.queues.get(name)
+
+    # ---- submission (internal/server/submit/submit.go) ----
+
+    def submit(
+        self, queue: str, jobset: str, jobs: list[JobSpec], now: float | None = None
+    ) -> list[str]:
+        """Validate + publish; returns job ids (existing ids for dedup hits)."""
+        if queue not in self.queues:
+            raise SubmissionError(f"queue {queue!r} does not exist")
+        now = _time.time() if now is None else now
+        events = []
+        job_ids = []
+        for job in jobs:
+            job = self._validate_and_default(queue, jobset, job, now)
+            dedup_key = None
+            dedup_id = job.annotations.get("armadaproject.io/deduplication-id", "")
+            if dedup_id:
+                dedup_key = (queue, dedup_id)
+                if dedup_key in self._dedup:
+                    job_ids.append(self._dedup[dedup_key])
+                    continue
+            if dedup_key:
+                self._dedup[dedup_key] = job.id
+            job_ids.append(job.id)
+            events.append(SubmitJob(created=now, job=job, deduplication_id=dedup_id))
+        if events:
+            self.log.publish(EventSequence.of(queue, jobset, *events))
+        return job_ids
+
+    def _validate_and_default(
+        self, queue: str, jobset: str, job: JobSpec, now: float
+    ) -> JobSpec:
+        """Validation rules from internal/server/submit/validation/."""
+        if not job.id:
+            job = job.with_(id=new_id("job"))
+        job = job.with_(queue=queue, jobset=jobset, submitted_ts=now)
+        if not job.requests:
+            raise SubmissionError(f"job {job.id}: no resource requests")
+        factory = self.config.resource_factory()
+        for name in job.requests:
+            if name not in factory.name_to_index:
+                raise SubmissionError(
+                    f"job {job.id}: unsupported resource {name!r}"
+                )
+        pc_name = job.priority_class or self.config.default_priority_class
+        if pc_name not in self.config.priority_classes:
+            raise SubmissionError(
+                f"job {job.id}: unknown priority class {pc_name!r}"
+            )
+        job = job.with_(priority_class=pc_name)
+        if job.gang is not None:
+            if job.gang.cardinality < 1:
+                raise SubmissionError(f"job {job.id}: gang cardinality < 1")
+        return job
+
+    # ---- cancel / reprioritise ----
+
+    def cancel_job(self, queue: str, jobset: str, job_id: str, reason: str = ""):
+        self.log.publish(
+            EventSequence.of(
+                queue, jobset, CancelJob(created=_time.time(), job_id=job_id, reason=reason)
+            )
+        )
+
+    def cancel_jobset(self, queue: str, jobset: str, reason: str = ""):
+        self.log.publish(
+            EventSequence.of(
+                queue, jobset, CancelJobSet(created=_time.time(), reason=reason)
+            )
+        )
+
+    def reprioritise_job(self, queue: str, jobset: str, job_id: str, priority: int):
+        self.log.publish(
+            EventSequence.of(
+                queue,
+                jobset,
+                ReprioritiseJob(created=_time.time(), job_id=job_id, priority=priority),
+            )
+        )

@@ -1,0 +1,259 @@
+"""Discrete-event simulator: whole-fleet runs in virtual time.
+
+The reference's simulator (/root/reference/internal/scheduler/simulator/
+simulator.go:64,206) is both the correctness oracle and the benchmark
+harness: it builds synthetic clusters and workloads from specs, pops events
+off a virtual-time priority queue, and drives the *real* scheduling code
+path; job runtimes come from shifted-exponential distributions. Same design
+here: the Simulator owns the real SchedulerService + FakeExecutors on a
+virtual clock, so simulated behavior is the production code path, not a
+model of it.
+
+Specs mirror the reference's YAML testdata
+(simulator/testdata/{clusters,workloads}): ClusterSpec{pool, node groups},
+WorkloadSpec{queues -> job templates with counts/sizes/arrival times}.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.config import SchedulingConfig
+from ..core.types import Gang, JobSpec, QueueSpec
+from ..events import InMemoryEventLog
+from ..jobdb import JobState
+from ..services.fake_executor import FakeExecutor, make_nodes
+from ..services.scheduler import SchedulerService
+from ..services.submit import SubmitService
+
+
+@dataclass(frozen=True)
+class NodeTemplate:
+    count: int
+    cpu: str = "32"
+    memory: str = "1024Gi"
+    gpu: str = "0"
+    labels: dict = field(default_factory=dict)
+    taints: tuple = ()
+
+
+@dataclass(frozen=True)
+class ClusterSpec:
+    name: str
+    pool: str = "default"
+    node_templates: tuple = (NodeTemplate(count=100),)
+
+
+@dataclass(frozen=True)
+class ShiftedExponential:
+    """Job runtime distribution: minimum + Exp(tailMean), as in
+    simulator.proto's shifted-exponential runtimes."""
+
+    minimum: float = 60.0
+    tail_mean: float = 0.0
+
+    def sample(self, rng) -> float:
+        if self.tail_mean <= 0:
+            return self.minimum
+        return self.minimum + rng.exponential(self.tail_mean)
+
+
+@dataclass(frozen=True)
+class JobTemplate:
+    id: str
+    number: int
+    cpu: str = "1"
+    memory: str = "4Gi"
+    gpu: str = "0"
+    priority_class: str = ""
+    queue_priority: int = 0
+    runtime: ShiftedExponential = ShiftedExponential()
+    submit_time: float = 0.0
+    gang_cardinality: int = 0  # >0: submit in gangs of this size
+    node_selector: dict = field(default_factory=dict)
+    jobset: str = ""
+
+
+@dataclass(frozen=True)
+class QueueSpecSim:
+    name: str
+    priority_factor: float = 1.0
+    job_templates: tuple = ()
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    queues: tuple = ()
+
+
+@dataclass
+class SimResult:
+    finished_jobs: int
+    total_jobs: int
+    makespan: float
+    preemptions: int
+    cycles: int
+    events_by_job: dict
+    placements: dict  # job_id -> node_id of final successful run
+
+
+class Simulator:
+    def __init__(
+        self,
+        cluster_specs: list[ClusterSpec],
+        workload: WorkloadSpec,
+        config: SchedulingConfig | None = None,
+        *,
+        backend: str = "oracle",
+        seed: int = 0,
+        cycle_interval: float = 10.0,
+        max_time: float = 7 * 24 * 3600.0,
+    ):
+        self.config = config or SchedulingConfig()
+        self.rng = np.random.default_rng(seed)
+        self.cycle_interval = cycle_interval
+        self.max_time = max_time
+
+        self.log = InMemoryEventLog()
+        self.scheduler = SchedulerService(self.config, self.log, backend=backend)
+        self.submit = SubmitService(self.config, self.log, scheduler=self.scheduler)
+
+        self._runtimes: dict[str, float] = {}
+        self.executors: list[FakeExecutor] = []
+        for spec in cluster_specs:
+            nodes = []
+            for ti, tmpl in enumerate(spec.node_templates):
+                for i in range(tmpl.count):
+                    from ..core.types import NodeSpec
+
+                    resources = {"cpu": tmpl.cpu, "memory": tmpl.memory}
+                    if tmpl.gpu not in ("0", 0, ""):
+                        resources["nvidia.com/gpu"] = tmpl.gpu
+                    nodes.append(
+                        NodeSpec(
+                            id=f"{spec.name}-{ti}-{i:05d}",
+                            name=f"{spec.name}-{ti}-{i:05d}",
+                            executor=spec.name,
+                            pool=spec.pool,
+                            labels=dict(tmpl.labels),
+                            taints=tuple(tmpl.taints),
+                            total_resources=resources,
+                        )
+                    )
+            self.executors.append(
+                FakeExecutor(
+                    spec.name,
+                    self.log,
+                    self.scheduler,
+                    nodes=nodes,
+                    pool=spec.pool,
+                    runtime_for=lambda job_id: self._runtimes.get(job_id, 60.0),
+                )
+            )
+
+        # Build submission schedule.
+        self._pending_submissions: list[tuple[float, str, str, list[JobSpec]]] = []
+        self.total_jobs = 0
+        gang_counter = itertools.count()
+        for q in workload.queues:
+            self.submit.create_queue(QueueSpec(q.name, q.priority_factor))
+            for tmpl in q.job_templates:
+                jobs = []
+                gang = None
+                for i in range(tmpl.number):
+                    if tmpl.gang_cardinality > 0 and i % tmpl.gang_cardinality == 0:
+                        gang = Gang(
+                            id=f"gang-{next(gang_counter)}",
+                            cardinality=tmpl.gang_cardinality,
+                        )
+                    requests = {"cpu": tmpl.cpu, "memory": tmpl.memory}
+                    if tmpl.gpu not in ("0", 0, ""):
+                        requests["nvidia.com/gpu"] = tmpl.gpu
+                    job_id = f"{q.name}-{tmpl.id}-{i:06d}"
+                    jobs.append(
+                        JobSpec(
+                            id=job_id,
+                            queue=q.name,
+                            jobset=tmpl.jobset or tmpl.id,
+                            priority=tmpl.queue_priority,
+                            priority_class=tmpl.priority_class,
+                            requests=requests,
+                            gang=gang if tmpl.gang_cardinality > 0 else None,
+                        )
+                    )
+                    self._runtimes[job_id] = tmpl.runtime.sample(self.rng)
+                self.total_jobs += len(jobs)
+                self._pending_submissions.append(
+                    (tmpl.submit_time, q.name, tmpl.jobset or tmpl.id, jobs)
+                )
+        self._pending_submissions.sort(key=lambda x: x[0])
+
+    def run(self) -> SimResult:
+        t = 0.0
+        cycles = 0
+        preemptions = 0
+        sub_idx = 0
+        finished = 0
+
+        while t <= self.max_time:
+            # Submit everything due by t.
+            while (
+                sub_idx < len(self._pending_submissions)
+                and self._pending_submissions[sub_idx][0] <= t
+            ):
+                _, queue, jobset, jobs = self._pending_submissions[sub_idx]
+                self.submit.submit(queue, jobset, jobs, now=t)
+                sub_idx += 1
+
+            for ex in self.executors:
+                ex.tick(t)
+            seqs = self.scheduler.cycle(now=t)
+            for seq in seqs:
+                for event in seq.events:
+                    if type(event).__name__ == "JobRunPreempted":
+                        preemptions += 1
+            for ex in self.executors:
+                ex.tick(t)
+            cycles += 1
+
+            txn = self.scheduler.jobdb.read_txn()
+            states = [j.state for j in txn.all_jobs()]
+            finished = sum(1 for s in states if s.terminal)
+            all_submitted = sub_idx >= len(self._pending_submissions)
+            if all_submitted and states and finished == len(states):
+                break
+
+            # Advance virtual time: next interesting instant.
+            nxt = t + self.cycle_interval
+            for ex in self.executors:
+                for run in ex.active.values():
+                    if not run.running_reported:
+                        nxt = min(nxt, run.started + ex.startup_delay)
+                    nxt = min(nxt, run.finishes_at)
+            if sub_idx < len(self._pending_submissions):
+                nxt = min(nxt, self._pending_submissions[sub_idx][0])
+            t = max(nxt, t + 1e-9)
+
+        txn = self.scheduler.jobdb.read_txn()
+        placements = {}
+        events_by_job = {}
+        for job in txn.all_jobs():
+            events_by_job[job.id] = job.state
+            run = job.latest_run
+            if run is not None and job.state == JobState.SUCCEEDED:
+                placements[job.id] = run.node_id
+        return SimResult(
+            finished_jobs=sum(
+                1 for s in events_by_job.values() if s == JobState.SUCCEEDED
+            ),
+            total_jobs=self.total_jobs,
+            makespan=t,
+            preemptions=preemptions,
+            cycles=cycles,
+            events_by_job=events_by_job,
+            placements=placements,
+        )

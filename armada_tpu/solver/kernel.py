@@ -1,0 +1,834 @@
+"""The vectorized, jit-compiled scheduling round.
+
+One `solve_round` call runs the entire preempt-and-schedule round on device
+as a single XLA program, mirroring the oracle in reference.py (and therefore
+the Go reference's PreemptingQueueScheduler):
+
+  fair shares -> balance eviction -> fairness-order indexing ->
+  pass 1 (evicted + queued) -> oversubscription eviction -> pass 2 ->
+  finalize.
+
+Vectorization strategy (the TPU-first re-design of the reference's
+memdb/iterator machinery):
+  - Feasibility is bit arithmetic + integer compares over all N nodes at
+    once; candidate choice is a masked lexicographic argmin (ops/select.py)
+    instead of a radix-tree walk (nodedb.go:754).
+  - The queue priority queue becomes a masked argmin over per-queue cost
+    keys; per-queue streams are precomputed slot tables with head selection
+    by segment-min (queue_scheduler.go:628-674).
+  - Fair preemption's sequential walk over evicted jobs (nodedb.go:808)
+    becomes a per-node prefix-sum over eviction ranks: a node is selectable
+    at the walk step where its cumulative evicted resources first cover the
+    job, and the chosen node is the one with the largest such rank.
+  - The gang loop is a lax.while_loop whose carry is the entire mutable
+    round state; gang atomicity is functional (failed attempts keep the old
+    carry, no undo log needed).
+
+Parity notes: with JAX x64 enabled (tests), cost arithmetic is float64 and
+aggregate accounting is exact for realistic magnitudes; on TPU (x64 off)
+costs are float32 and parity becomes approximate in exotic tie cases.
+Node-uniformity gang label search is not yet vectorized (gangs with a
+uniformity label schedule as regular gangs here).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.priorities import EVICTED_PRIORITY, MIN_PRIORITY
+from ..ops.bitset import bits_subset
+from ..ops.select import lex_argmin
+from .kernel_prep import DeviceRound
+
+NO_NODE = -1
+
+# slot_state values
+PENDING, DONE, FAILED = 0, 1, 2
+
+# failure codes from a gang attempt
+OK, FAIL, FAIL_TERMINAL, FAIL_QUEUE_TERMINAL, FAIL_GANG_PROPERTY = 0, 1, 2, 3, 4
+
+BIG = jnp.int32(2**30)
+
+
+class Carry(NamedTuple):
+    alloc: jax.Array  # int32[P, N, R]
+    qalloc: jax.Array  # float[Q, R]
+    qpc_alloc: jax.Array  # float[Q, C, R]
+    job_node: jax.Array  # int32[J]
+    job_prio: jax.Array  # int32[J]
+    job_evicted: jax.Array  # bool[J]
+    job_scheduled: jax.Array  # bool[J] newly scheduled queued jobs
+    slot_state: jax.Array  # int8[S]
+    evict_rank: jax.Array  # int32[J]; -1 inactive, -2 consumed
+    unfeasible: jax.Array  # bool[Gk]
+    only_ev_global: jax.Array  # bool
+    only_ev_queue: jax.Array  # bool[Q]
+    tokens: jax.Array  # float
+    qtokens: jax.Array  # float[Q]
+    scheduled_new: jax.Array  # float[R]
+    stop: jax.Array  # bool
+    loops: jax.Array  # int32
+
+
+def _f(x):
+    return jnp.asarray(x, jnp.result_type(float))
+
+
+def _drf_cost(alloc, total, mult):
+    """DRF cost (fairness.go:103-105); alloc [..., R]."""
+    safe = jnp.where(total > 0, total, 1.0)
+    frac = jnp.where(total > 0, alloc / safe, 0.0) * mult
+    return jnp.maximum(jnp.max(frac, axis=-1), 0.0)
+
+
+def _fair_shares(weights, demand_costs, total_is_zero):
+    """Water-filling fair shares (context/scheduling.go:252-331), jit form."""
+    Q = weights.shape[0]
+    fair_share = weights / jnp.sum(weights)
+    demand = jnp.where(total_is_zero, 1.0, demand_costs)
+
+    def body(state):
+        capped, uncapped, achieved, spare, unallocated, i = state
+        total_weight = jnp.sum(jnp.where(achieved, 0.0, weights))
+        total_incl = total_weight + jnp.where(achieved, weights, 0.0)
+        share = jnp.where(total_incl > 0, weights / jnp.where(total_incl > 0, total_incl, 1.0), 0.0)
+        uncapped = uncapped + share * (unallocated - spare)
+        live = total_weight > 0.0
+        capped = jnp.where(
+            live & ~achieved,
+            capped + (weights / jnp.where(live, total_weight, 1.0)) * unallocated,
+            capped,
+        )
+        new_spare = capped - demand
+        over = live & (new_spare > 0)
+        capped = jnp.where(over, demand, capped)
+        achieved = achieved | over
+        spare = jnp.where(over, new_spare, 0.0)
+        unallocated = jnp.where(live, jnp.sum(jnp.where(over, new_spare, 0.0)), 0.0)
+        return capped, uncapped, achieved, spare, unallocated, i + 1
+
+    def cond(state):
+        *_, unallocated, i = state
+        return (i < 10) & (unallocated > 0.01)
+
+    init = (
+        jnp.zeros(Q),
+        jnp.zeros(Q),
+        jnp.zeros(Q, dtype=bool),
+        jnp.zeros(Q),
+        jnp.asarray(1.0, jnp.result_type(float)),
+        jnp.asarray(0, jnp.int32),
+    )
+    capped, uncapped, *_ = jax.lax.while_loop(cond, body, init)
+    return fair_share, capped, uncapped
+
+
+def _static_ok(dev, j):
+    """StaticJobRequirementsMet over all nodes (nodematching.go:161-190)."""
+    tolerated = dev.job_tolerated[j]
+    taints_ok = jnp.all((dev.node_taints & ~tolerated) == 0, axis=-1)
+    sel_ok = bits_subset(dev.job_selector[j], dev.node_labels)
+    total_ok = jnp.all(dev.job_req[j] <= dev.node_total, axis=-1)
+    return taints_ok & sel_ok & total_ok & ~dev.node_unschedulable & dev.job_possible[j]
+
+
+def _select_at_row(dev, alloc, j, row, static_ok):
+    """First-fit in best-fit order at one priority row (nodedb.go:713-752)."""
+    dyn = jnp.all(dev.job_req[j] <= alloc[row], axis=-1)
+    mask = static_ok & dyn
+    keys = []
+    for k in range(dev.order_res_idx.shape[0]):
+        ri = dev.order_res_idx[k]
+        res = dev.order_res_resolution[k]
+        keys.append(alloc[row, :, ri] // res)
+    keys.append(dev.node_id_rank)
+    return lex_argmin(keys, mask)
+
+
+def _fair_preemption(dev, carry, j, static_ok):
+    """Vectorized selectNodeForJobWithFairPreemption (nodedb.go:808-899).
+
+    Walk evicted jobs in reverse rank order; node n becomes selectable at the
+    first step where its cumulative freed resources cover the job. Choose the
+    node whose threshold step is earliest (largest rank)."""
+    rank = carry.evict_rank
+    active = rank >= 0
+    node = carry.job_node
+    # Sort by (node, -rank): cumulative per node in walk order; inactive
+    # entries sink to the end via a node key beyond any real node.
+    node_key = jnp.where(active, node, BIG)
+    order = jnp.lexsort((BIG - rank, node_key))
+    n_sorted = node[order]
+    a_sorted = active[order]
+    contrib = jnp.where(a_sorted[:, None], dev.job_req[order], 0).astype(
+        jnp.result_type(int)
+    )
+    c = jnp.cumsum(contrib, axis=0)
+    pos = jnp.arange(node.shape[0])
+    is_first = jnp.concatenate(
+        [jnp.ones(1, bool), n_sorted[1:] != n_sorted[:-1]]
+    )
+    seg_first = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_first, pos, 0)
+    )
+    base = c[seg_first] - contrib[seg_first]
+    cwithin = c - base
+    safe_node = jnp.clip(n_sorted, 0, dev.alloc0.shape[1] - 1)
+    avail = carry.alloc[0, safe_node].astype(jnp.result_type(int)) + cwithin
+    feasible = (
+        a_sorted
+        & jnp.all(avail >= dev.job_req[j], axis=-1)
+        & static_ok[safe_node]
+    )
+    rank_sorted = rank[order]
+    idx, found = lex_argmin([-rank_sorted, pos.astype(jnp.int32)], feasible)
+    sel_node = safe_node[idx]
+    sel_rank = rank_sorted[idx]
+    consumed = active & (node == sel_node) & (rank >= sel_rank) & found
+    freed = jnp.sum(
+        jnp.where(consumed[:, None], dev.job_req, 0), axis=0
+    ).astype(carry.alloc.dtype)
+    new_alloc = carry.alloc.at[0, sel_node].add(jnp.where(found, freed, 0))
+    new_rank = jnp.where(consumed, -2, rank)
+    preempted_at = jnp.max(
+        jnp.where(consumed, carry.job_prio, MIN_PRIORITY)
+    )
+    return sel_node, found, preempted_at, new_alloc, new_rank
+
+
+def _select_node(dev, carry, j):
+    """SelectNodeForJobWithTxn (nodedb.go:423-503). Returns
+    (node, found, preempted_at, new_alloc, new_evict_rank)."""
+    prio = carry.job_prio[j]
+    row_p = jnp.searchsorted(dev.priorities, prio).astype(jnp.int32)
+    alloc = carry.alloc
+
+    pinned = carry.job_evicted[j]
+    home = carry.job_node[j]
+    safe_home = jnp.clip(home, 0, alloc.shape[1] - 1)
+    over_alloc = jnp.any(alloc[:, safe_home] < 0)
+    home_fit = jnp.all(dev.job_req[j] <= alloc[row_p, safe_home]) | (
+        dev.node_unschedulable[safe_home] & over_alloc
+    )
+
+    static_ok = _static_ok(dev, j)
+
+    n0, f0 = _select_at_row(dev, alloc, j, 0, static_ok)
+    np_, fp = _select_at_row(dev, alloc, j, row_p, static_ok)
+
+    # Fair preemption involves a J-sized sort; skip it when the evicted-job
+    # index is empty (every queued-only round).
+    fpre_n, fpre_found, fpre_at, fpre_alloc, fpre_rank = jax.lax.cond(
+        jnp.any(carry.evict_rank >= 0),
+        lambda: _fair_preemption(dev, carry, j, static_ok),
+        lambda: (
+            jnp.int32(0),
+            jnp.zeros((), bool),
+            jnp.int32(MIN_PRIORITY),
+            carry.alloc,
+            carry.evict_rank,
+        ),
+    )
+
+    # Urgency: lowest priority row (ascending) where the job fits.
+    urg_n = jnp.int32(0)
+    urg_found = jnp.zeros((), bool)
+    urg_at = jnp.int32(MIN_PRIORITY)
+    P = dev.priorities.shape[0]
+    for r in range(1, P):
+        allowed = dev.priorities[r] <= prio
+        nr, fr = _select_at_row(dev, alloc, j, r, static_ok)
+        take = allowed & fr & ~urg_found
+        urg_n = jnp.where(take, nr, urg_n)
+        urg_at = jnp.where(take, dev.priorities[r], urg_at)
+        urg_found = urg_found | take
+
+    # Resolution order: pinned; row0; (no fit at own priority -> fail);
+    # fair preemption; urgency.
+    found = jnp.where(
+        pinned,
+        home_fit,
+        f0 | (fp & (fpre_found | urg_found)),
+    )
+    use_row0 = ~pinned & f0
+    use_fpre = ~pinned & ~f0 & fp & fpre_found
+    use_urg = ~pinned & ~f0 & fp & ~fpre_found & urg_found
+
+    node = jnp.where(
+        pinned,
+        safe_home,
+        jnp.where(use_row0, n0, jnp.where(use_fpre, fpre_n, urg_n)),
+    )
+    preempted_at = jnp.where(
+        pinned,
+        prio,
+        jnp.where(
+            use_row0,
+            EVICTED_PRIORITY,
+            jnp.where(use_fpre, fpre_at, urg_at),
+        ),
+    )
+    new_alloc = jnp.where(use_fpre, fpre_alloc, carry.alloc)
+    new_rank = jnp.where(use_fpre, fpre_rank, carry.evict_rank)
+    return node, found, preempted_at, new_alloc, new_rank
+
+
+def _bind(dev, carry: Carry, j, n, at_prio) -> Carry:
+    """bindJobToNodeInPlace (nodedb.go:911-945)."""
+    preemptible = dev.job_preemptible[j]
+    rows = jnp.where(
+        preemptible, dev.priorities <= at_prio, jnp.ones_like(dev.priorities, bool)
+    )
+    delta = jnp.where(rows[:, None], dev.job_req[j], 0).astype(carry.alloc.dtype)
+    alloc = carry.alloc.at[:, n].add(-delta)
+    was_evicted = carry.job_evicted[j]
+    alloc = alloc.at[0, n].add(
+        jnp.where(was_evicted, dev.job_req[j], 0).astype(carry.alloc.dtype)
+    )
+    return carry._replace(
+        alloc=alloc,
+        job_node=carry.job_node.at[j].set(n),
+        job_prio=carry.job_prio.at[j].set(at_prio),
+        job_evicted=carry.job_evicted.at[j].set(False),
+        job_scheduled=carry.job_scheduled.at[j].set(
+            carry.job_scheduled[j] | (~was_evicted & ~dev.job_is_running[j])
+        ),
+        evict_rank=carry.evict_rank.at[j].set(
+            jnp.where(was_evicted, -2, carry.evict_rank[j])
+        ),
+    )
+
+
+def _gang_attempt(dev, carry: Carry, s, all_ev):
+    """GangScheduler.Schedule + ScheduleManyWithTxn. Returns
+    (carry, status_code)."""
+    q = dev.slot_queue[s]
+    card = dev.slot_count[s].astype(jnp.result_type(float))
+    pc = dev.job_pc[dev.slot_members[s, 0]]
+
+    # Constraints for non-evicted gangs (gang_scheduler.go:100-145).
+    over_round = jnp.any(carry.scheduled_new > dev.max_round_resources)
+    no_tokens = carry.tokens < 1
+    gang_too_big = dev.global_burst < card
+    tokens_short = carry.tokens < card
+    qno_tokens = carry.qtokens[q] < 1
+    qgang_too_big = dev.queue_burst < card
+    qtokens_short = carry.qtokens[q] < card
+    pc_over = jnp.any(carry.qpc_alloc[q, pc] > dev.queue_pc_limit[q, pc])
+
+    blocked_code = jnp.where(
+        over_round | no_tokens,
+        FAIL_TERMINAL,
+        jnp.where(
+            qno_tokens,
+            FAIL_QUEUE_TERMINAL,
+            jnp.where(
+                gang_too_big,
+                FAIL_GANG_PROPERTY,
+                jnp.where(
+                    tokens_short | qgang_too_big | qtokens_short | pc_over,
+                    FAIL,
+                    OK,
+                ),
+            ),
+        ),
+    )
+    blocked_code = jnp.where(all_ev, OK, blocked_code)
+
+    # Member-by-member placement.
+    M = dev.slot_members.shape[1]
+
+    def member_body(m, state):
+        c, ok = state
+        j = dev.slot_members[s, m]
+        live = (m < dev.slot_count[s]) & ok
+        safe_j = jnp.clip(j, 0, dev.job_req.shape[0] - 1)
+        node, found, _, new_alloc, new_rank = _select_node(dev, c, safe_j)
+        do = live & found
+        c2 = c._replace(alloc=new_alloc, evict_rank=new_rank)
+        c2 = _bind(dev, c2, safe_j, node, c2.job_prio[safe_j])
+        c = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(do, b, a), c, c2
+        )
+        return c, ok & (found | ~live)
+
+    attempted, ok = jax.lax.fori_loop(
+        0, M, member_body, (carry, blocked_code == OK)
+    )
+
+    # Commit or roll back (functional txn).
+    new_carry = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(ok, b, a), carry, attempted
+    )
+
+    # Success accounting (AddGangSchedulingContext + rate-limiter reserve).
+    req = _f(dev.slot_req[s])
+    qalloc = jnp.where(
+        ok, new_carry.qalloc.at[q].add(req), new_carry.qalloc
+    )
+    qpc_alloc = jnp.where(
+        ok, new_carry.qpc_alloc.at[q, pc].add(req), new_carry.qpc_alloc
+    )
+    tokens = jnp.where(ok & ~all_ev, new_carry.tokens - card, new_carry.tokens)
+    qtokens = jnp.where(
+        ok & ~all_ev, new_carry.qtokens.at[q].add(-card), new_carry.qtokens
+    )
+    scheduled_new = jnp.where(
+        ok & ~all_ev, new_carry.scheduled_new + req, new_carry.scheduled_new
+    )
+    # Member placement failures are gang-property reasons (JobDoesNotFit /
+    # GangDoesNotFit, constraints.go:59-61).
+    fail_code = jnp.where(blocked_code != OK, blocked_code, FAIL_GANG_PROPERTY)
+    status = jnp.where(ok, OK, fail_code)
+    new_carry = new_carry._replace(
+        qalloc=qalloc,
+        qpc_alloc=qpc_alloc,
+        tokens=tokens,
+        qtokens=qtokens,
+        scheduled_new=scheduled_new,
+        slot_state=new_carry.slot_state.at[s].set(
+            jnp.where(ok, DONE, FAILED).astype(jnp.int8)
+        ),
+    )
+    return new_carry, status
+
+
+def _slot_validity(dev, carry: Carry, include_queued, use_key_skip):
+    """Which slots can be yielded right now (QueuedGangIterator semantics)."""
+    S, M = dev.slot_members.shape
+    members = dev.slot_members  # [S, M]
+    member_mask = jnp.arange(M)[None, :] < dev.slot_count[:, None]
+    safe = jnp.clip(members, 0, dev.job_req.shape[0] - 1)
+    all_evicted = jnp.all(
+        jnp.where(member_mask, carry.job_evicted[safe], True), axis=1
+    )
+    pending = (carry.slot_state == PENDING) & (dev.slot_count > 0)
+    only_ev = carry.only_ev_global | carry.only_ev_queue[
+        jnp.clip(dev.slot_queue, 0, carry.only_ev_queue.shape[0] - 1)
+    ]
+
+    valid = pending
+    if include_queued:
+        active = jnp.where(dev.slot_is_running, all_evicted, True)
+        valid = valid & active & (~only_ev | all_evicted)
+        # Lookback: queued jobs beyond the limit stop yielding; 0 means
+        # unlimited (QueuedGangIterator.stopYieldingNewJobsIfLimitHit).
+        if dev.max_lookback:
+            valid = valid & (
+                dev.slot_is_running
+                | all_evicted
+                | (dev.slot_jobs_before < dev.max_lookback)
+            )
+        if use_key_skip:
+            kg = jnp.clip(dev.slot_key_group, 0, carry.unfeasible.shape[0] - 1)
+            known_bad = (dev.slot_key_group >= 0) & carry.unfeasible[kg]
+            valid = valid & ~known_bad
+    else:
+        valid = valid & all_evicted
+    return valid, all_evicted
+
+
+def _queue_heads(dev, valid):
+    """First valid slot per queue (segment-min over slot positions)."""
+    S = valid.shape[0]
+    Q = dev.queue_slot_start.shape[0]
+    pos = jnp.where(valid, jnp.arange(S, dtype=jnp.int32), BIG)
+    seg = jnp.clip(dev.slot_queue, 0, Q - 1)
+    heads = jax.ops.segment_min(pos, seg, num_segments=Q)
+    return jnp.where(heads < BIG, heads, 0).astype(jnp.int32), heads < BIG
+
+
+def _slot_min_prio(dev, carry, s):
+    M = dev.slot_members.shape[1]
+    members = dev.slot_members[s]
+    mask = jnp.arange(M) < dev.slot_count[s]
+    safe = jnp.clip(members, 0, dev.job_req.shape[0] - 1)
+    return jnp.min(jnp.where(mask, carry.job_prio[safe], jnp.int32(2**31 - 1)))
+
+
+def _schedule_pass(
+    dev,
+    carry: Carry,
+    budgets,
+    *,
+    include_queued: bool,
+    use_key_skip: bool,
+    consider_priority: bool,
+    prefer_large: bool,
+):
+    """QueueScheduler.Schedule as a while_loop (queue_scheduler.go:91-276)."""
+    Q = dev.queue_slot_start.shape[0]
+    S = dev.slot_members.shape[0]
+
+    def cond(c: Carry):
+        return ~c.stop & (c.loops < S + 2)
+
+    def body(c: Carry):
+        valid, all_ev_flags = _slot_validity(dev, c, include_queued, use_key_skip)
+        heads, has_head = _queue_heads(dev, valid)
+
+        req_h = _f(dev.slot_req[heads])  # [Q, R]
+        cur = _drf_cost(c.qalloc, dev.total_resources, dev.drf_multipliers)
+        w = jnp.maximum(dev.queue_weight, 1e-12)
+        current = cur / w
+        proposed = (
+            _drf_cost(c.qalloc + req_h, dev.total_resources, dev.drf_multipliers) / w
+        )
+        size = (
+            _drf_cost(req_h, dev.total_resources, dev.drf_multipliers)
+            * dev.queue_weight
+        )
+        pcp = jax.vmap(lambda s: _slot_min_prio(dev, c, s))(heads)
+
+        keys = []
+        if consider_priority:
+            keys.append(-pcp)
+        if prefer_large:
+            over = (proposed > budgets).astype(jnp.int32)
+            k1 = jnp.where(over == 1, proposed, current)
+            k2 = jnp.where(over == 1, 0.0, -size)
+            keys += [over, k1, k2]
+        else:
+            keys.append(proposed)
+        keys.append(dev.queue_name_rank)
+
+        qstar, any_head = lex_argmin(keys, has_head)
+        sstar = heads[qstar]
+
+        def attempt(c):
+            c2, status = _gang_attempt(dev, c, sstar, all_ev_flags[sstar])
+            # Terminal handling (queue_scheduler.go:176-190).
+            c2 = c2._replace(
+                only_ev_global=c2.only_ev_global | (status == FAIL_TERMINAL),
+                only_ev_queue=c2.only_ev_queue.at[dev.slot_queue[sstar]].set(
+                    c2.only_ev_queue[dev.slot_queue[sstar]]
+                    | (status == FAIL_QUEUE_TERMINAL)
+                ),
+            )
+            # Register unfeasible keys: single-member, non-evicted slots with
+            # gang-property failures (gang_scheduler.go:80-95).
+            kg = dev.slot_key_group[sstar]
+            register = (
+                (status == FAIL_GANG_PROPERTY)
+                & (dev.slot_count[sstar] == 1)
+                & (kg >= 0)
+                & ~all_ev_flags[sstar]
+            )
+            safe_kg = jnp.clip(kg, 0, c2.unfeasible.shape[0] - 1)
+            c2 = c2._replace(
+                unfeasible=c2.unfeasible.at[safe_kg].set(
+                    c2.unfeasible[safe_kg] | register
+                )
+            )
+            return c2
+
+        c = jax.lax.cond(any_head, attempt, lambda c: c._replace(stop=True), c)
+        return c._replace(loops=c.loops + 1)
+
+    # Each iteration consumes one slot (or stops), so S+2 bounds the loop;
+    # the counter restarts per pass (the reference's loopNumber is also
+    # per-QueueScheduler, queue_scheduler.go:99).
+    carry = carry._replace(stop=jnp.zeros((), bool), loops=jnp.zeros((), jnp.int32))
+    return jax.lax.while_loop(cond, body, carry)
+
+
+def _apply_evictions(dev, carry: Carry, evict_mask):
+    """Move evicted jobs' usage to the evicted row and update queue
+    accounting (EvictJobsFromNode + sctx.EvictJob)."""
+    P = dev.priorities.shape[0]
+    N = dev.alloc0.shape[1]
+    req = dev.job_req
+    node = jnp.clip(carry.job_node, 0, N - 1)
+    alloc = carry.alloc
+    for r in range(1, P):
+        in_rows = jnp.where(
+            dev.job_preemptible,
+            dev.priorities[r] <= carry.job_prio,
+            True,
+        )
+        contrib = jnp.where(
+            (evict_mask & in_rows)[:, None], req, 0
+        ).astype(alloc.dtype)
+        add = jax.ops.segment_sum(contrib, node, num_segments=N)
+        alloc = alloc.at[r].add(add)
+
+    qseg = jnp.clip(dev.job_queue, 0, dev.queue_weight.shape[0] - 1)
+    qsub = jax.ops.segment_sum(
+        jnp.where(evict_mask[:, None], _f(req), 0.0),
+        qseg,
+        num_segments=dev.queue_weight.shape[0],
+    )
+    qalloc = carry.qalloc - qsub
+    # per-PC accounting
+    C = dev.pc_priority.shape[0]
+    pc_seg = qseg * C + dev.job_pc
+    qpc_sub = jax.ops.segment_sum(
+        jnp.where(evict_mask[:, None], _f(req), 0.0),
+        pc_seg,
+        num_segments=dev.queue_weight.shape[0] * C,
+    ).reshape(carry.qpc_alloc.shape)
+    return carry._replace(
+        alloc=alloc,
+        qalloc=qalloc,
+        qpc_alloc=carry.qpc_alloc - qpc_sub,
+        job_evicted=carry.job_evicted | evict_mask,
+    )
+
+
+def _assign_evict_ranks(dev, carry: Carry, budgets, prefer_large: bool):
+    """addEvictedJobsToNodeDb (preempting_queue_scheduler.go:584-633): walk
+    evicted slots in candidate order with static allocations, assigning a
+    global fairness rank to each member."""
+    S, M = dev.slot_members.shape
+    Q = dev.queue_weight.shape[0]
+    member_mask = jnp.arange(M)[None, :] < dev.slot_count[:, None]
+    safe = jnp.clip(dev.slot_members, 0, dev.job_req.shape[0] - 1)
+    slot_all_ev = jnp.all(
+        jnp.where(member_mask, carry.job_evicted[safe], True), axis=1
+    )
+    eligible0 = (carry.slot_state == PENDING) & slot_all_ev & (dev.slot_count > 0)
+
+    w = jnp.maximum(dev.queue_weight, 1e-12)
+    cur = _drf_cost(carry.qalloc, dev.total_resources, dev.drf_multipliers) / w
+
+    def cond(state):
+        _, _, remaining, i = state
+        return remaining & (i < S + 1)
+
+    def body(state):
+        rank, done, _, i = state
+        elig = eligible0 & ~done
+        heads, has_head = _queue_heads(dev, elig)
+        req_h = _f(dev.slot_req[heads])
+        proposed = (
+            _drf_cost(
+                carry.qalloc + req_h, dev.total_resources, dev.drf_multipliers
+            )
+            / w
+        )
+        size = (
+            _drf_cost(req_h, dev.total_resources, dev.drf_multipliers)
+            * dev.queue_weight
+        )
+        keys = []
+        if prefer_large:
+            over = (proposed > budgets).astype(jnp.int32)
+            keys += [over, jnp.where(over == 1, proposed, cur),
+                     jnp.where(over == 1, 0.0, -size)]
+        else:
+            keys.append(proposed)
+        keys.append(dev.queue_name_rank)
+        qstar, any_head = lex_argmin(keys, has_head)
+        sstar = heads[qstar]
+        mmask = jnp.arange(M) < dev.slot_count[sstar]
+        js = jnp.clip(dev.slot_members[sstar], 0, rank.shape[0] - 1)
+        base = i * M
+        new_rank = rank.at[js].set(
+            jnp.where(mmask, base + jnp.arange(M, dtype=jnp.int32), rank[js])
+        )
+        rank = jnp.where(any_head, new_rank, rank)
+        done = done.at[sstar].set(done[sstar] | any_head)
+        return rank, done, any_head, i + 1
+
+    rank0 = jnp.full(dev.job_req.shape[0], -1, dtype=jnp.int32)
+    done0 = jnp.zeros(S, dtype=bool)
+    rank, *_ = jax.lax.while_loop(
+        cond, body, (rank0, done0, jnp.ones((), bool), jnp.asarray(0, jnp.int32))
+    )
+    # Ranks increase with scheduling preference; fair preemption consumes the
+    # LARGEST ranks first (latest in the fairness order). Here larger rank =
+    # scheduled later = consumed first, matching ReverseLowerBound.
+    return carry._replace(evict_rank=rank)
+
+
+def _oversubscribed_mask(dev, carry: Carry):
+    """OversubscribedEvictor (eviction.go:133-180)."""
+    P = dev.priorities.shape[0]
+    N = dev.alloc0.shape[1]
+    bound = (carry.job_node >= 0) & ~carry.job_evicted
+    node = jnp.clip(carry.job_node, 0, N - 1)
+    mask = jnp.zeros(dev.job_req.shape[0], dtype=bool)
+    for r in range(1, P):
+        over_nodes = jnp.any(carry.alloc[r] < 0, axis=-1)  # [N]
+        at_prio = carry.job_prio == dev.priorities[r]
+        mask = mask | (bound & dev.job_preemptible & at_prio & over_nodes[node])
+    return mask & (dev.job_queue >= 0)
+
+
+def _gang_complete_mask(dev, carry: Carry, evict_mask):
+    """Extend an eviction mask to whole gangs (evictGangs)."""
+    S, M = dev.slot_members.shape
+    safe = jnp.clip(dev.slot_members, 0, evict_mask.shape[0] - 1)
+    member_mask = jnp.arange(M)[None, :] < dev.slot_count[:, None]
+    slot_has_evicted = jnp.any(member_mask & evict_mask[safe], axis=1)
+    bound = (carry.job_node >= 0) & ~carry.job_evicted
+    add = jnp.zeros_like(evict_mask)
+    slot_sel = slot_has_evicted & (dev.slot_count > 1)
+    flat = safe.reshape(-1)
+    sel_flat = (slot_sel[:, None] & member_mask).reshape(-1)
+    add = add.at[flat].max(sel_flat)
+    return evict_mask | (add & bound)
+
+
+def solve_impl(dev: DeviceRound):
+    J = dev.job_req.shape[0]
+    Q = dev.queue_weight.shape[0]
+    S = dev.slot_members.shape[0]
+    C = dev.pc_priority.shape[0]
+    R = dev.job_req.shape[1]
+
+    fdt = jnp.result_type(float)
+
+    # Fair shares from constrained demand.
+    demand_capped_pc = jnp.minimum(
+        _f(dev.queue_demand_pc), dev.queue_pc_limit
+    )
+    constrained = jnp.sum(demand_capped_pc, axis=1)  # [Q, R]
+    total_is_zero = jnp.all(dev.total_resources == 0)
+    demand_costs = _drf_cost(
+        constrained, dev.total_resources, dev.drf_multipliers
+    )
+    fair_share, demand_capped, uncapped = _fair_shares(
+        _f(dev.queue_weight), demand_costs, total_is_zero
+    )
+    budgets = jnp.where(
+        dev.queue_weight > 0, demand_capped / _f(dev.queue_weight), jnp.inf
+    )
+
+    carry = Carry(
+        alloc=jnp.asarray(dev.alloc0, jnp.int32),
+        qalloc=_f(dev.queue_alloc0),
+        qpc_alloc=jnp.zeros((Q, C, R), fdt),
+        job_node=jnp.asarray(dev.job_node, jnp.int32),
+        job_prio=jnp.asarray(dev.job_prio, jnp.int32),
+        job_evicted=jnp.zeros(J, bool),
+        job_scheduled=jnp.zeros(J, bool),
+        slot_state=jnp.zeros(S, jnp.int8),
+        evict_rank=jnp.full(J, -1, jnp.int32),
+        unfeasible=jnp.zeros(max(1, dev.num_key_groups), bool),
+        only_ev_global=jnp.zeros((), bool),
+        only_ev_queue=jnp.zeros(Q, bool),
+        tokens=jnp.asarray(dev.global_tokens, fdt),
+        qtokens=_f(dev.queue_tokens),
+        scheduled_new=jnp.zeros(R, fdt),
+        stop=jnp.zeros((), bool),
+        loops=jnp.zeros((), jnp.int32),
+    )
+    # Initial per-PC allocation of running jobs.
+    qseg = jnp.clip(dev.job_queue, 0, Q - 1) * C + dev.job_pc
+    run_alloc = jax.ops.segment_sum(
+        jnp.where(
+            (dev.job_is_running & (dev.job_queue >= 0))[:, None],
+            _f(dev.job_req),
+            0.0,
+        ),
+        qseg,
+        num_segments=Q * C,
+    ).reshape(Q, C, R)
+    carry = carry._replace(qpc_alloc=run_alloc)
+
+    # 1. Balance eviction (NodeEvictor + gang completion).
+    actual_cost = _drf_cost(carry.qalloc, dev.total_resources, dev.drf_multipliers)
+    fs = jnp.maximum(demand_capped, fair_share)
+    fraction = jnp.where(fs > 0, actual_cost / fs, jnp.inf)
+    evict_queue = fraction > dev.protected_fraction
+    qidx = jnp.clip(dev.job_queue, 0, Q - 1)
+    evict0 = (
+        dev.job_is_running
+        & dev.job_preemptible
+        & (dev.job_queue >= 0)
+        & (carry.job_node >= 0)
+        & evict_queue[qidx]
+    )
+    evict0 = _gang_complete_mask(dev, carry, evict0)
+    carry = _apply_evictions(dev, carry, evict0)
+    carry = _assign_evict_ranks(dev, carry, budgets, dev.prefer_large)
+
+    # 2. Pass 1: evicted + queued.
+    carry = _schedule_pass(
+        dev,
+        carry,
+        budgets,
+        include_queued=True,
+        use_key_skip=True,
+        consider_priority=False,
+        prefer_large=dev.prefer_large,
+    )
+
+    # 3. Oversubscription eviction.
+    over = _oversubscribed_mask(dev, carry)
+    over = _gang_complete_mask(dev, carry, over)
+    # Back out per-round scheduled resources for re-evicted new jobs.
+    sched_backout = jnp.sum(
+        jnp.where((over & carry.job_scheduled)[:, None], _f(dev.job_req), 0.0),
+        axis=0,
+    )
+    carry = _apply_evictions(dev, carry, over)
+    carry = carry._replace(scheduled_new=carry.scheduled_new - sched_backout)
+    # Re-open slots whose members are all evicted for pass 2.
+    S_, M_ = dev.slot_members.shape
+    member_mask = jnp.arange(M_)[None, :] < dev.slot_count[:, None]
+    safe = jnp.clip(dev.slot_members, 0, J - 1)
+    slot_all_ev = jnp.all(
+        jnp.where(member_mask, carry.job_evicted[safe], True), axis=1
+    )
+    any_over = jnp.any(over)
+    carry = carry._replace(
+        slot_state=jnp.where(
+            slot_all_ev & any_over, jnp.int8(PENDING), carry.slot_state
+        ),
+        only_ev_global=jnp.zeros((), bool),
+        only_ev_queue=jnp.zeros(Q, bool),
+    )
+    carry = jax.lax.cond(
+        any_over,
+        lambda c: _assign_evict_ranks(dev, c, budgets, dev.prefer_large),
+        lambda c: c,
+        carry,
+    )
+
+    # 4. Pass 2: evicted only, considering priority-class priority.
+    carry = jax.lax.cond(
+        any_over,
+        lambda c: _schedule_pass(
+            dev,
+            c,
+            budgets,
+            include_queued=False,
+            use_key_skip=False,
+            consider_priority=True,
+            prefer_large=dev.prefer_large,
+        ),
+        lambda c: c,
+        carry,
+    )
+
+    # 5. Finalize.
+    preempted = dev.job_is_running & carry.job_evicted
+    scheduled = carry.job_scheduled & ~carry.job_evicted
+    assigned = jnp.where(carry.job_evicted, NO_NODE, carry.job_node)
+    return {
+        "assigned_node": assigned,
+        "scheduled_priority": carry.job_prio,
+        "scheduled_mask": scheduled,
+        "preempted_mask": preempted,
+        "fair_share": fair_share,
+        "demand_capped_fair_share": demand_capped,
+        "uncapped_fair_share": uncapped,
+        "num_loops": carry.loops,
+    }
+
+
+_solve = jax.jit(solve_impl)
+
+
+def solve_round(dev: DeviceRound):
+    """Run the jitted round solve; returns numpy outputs."""
+    out = _solve(dev)
+    return {k: np.asarray(v) for k, v in out.items()}

@@ -1,0 +1,403 @@
+"""Host-side preparation of the device tensors for the JAX round kernel.
+
+Flattens a RoundSnapshot into fixed-shape arrays:
+
+- The per-queue candidate order becomes a global *slot* table: one slot per
+  gang (running gangs grouped for potential eviction, queued gangs from the
+  snapshot's gang table), sorted by (queue, segment, order) where segment 0
+  is the evicted stream and segment 1 the queued stream — mirroring the
+  evicted-then-queued iterator chaining in the reference
+  (preempting_queue_scheduler.go:719-726).
+- Scheduling keys are interned into dense groups so the unfeasible-key skip
+  (gang_scheduler.go:80-95) is a boolean table lookup on device.
+- All quantities are int32 device lanes (requests ceil-scaled, allocatable
+  floor-scaled by the factory's device divisors).
+
+Shapes are static per snapshot; the kernel is re-jitted only when padded
+sizes change (callers can bucket J/N/S to powers of two to cap recompiles).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from ..snapshot.round import RoundSnapshot
+
+NO_NODE = -1
+
+# Config constants baked into the compiled program (recompile per config).
+_META_FIELDS = (
+    "protected_fraction",
+    "max_lookback",
+    "global_burst",
+    "queue_burst",
+    "prefer_large",
+    "num_key_groups",
+)
+
+
+@dataclass
+class DeviceRound:
+    """Everything solve_round needs, as numpy arrays ready for jnp.asarray.
+
+    Members are a pytree of arrays; scalars live in static_config.
+    """
+
+    # priorities
+    priorities: np.ndarray  # int32[P]
+
+    # nodes
+    alloc0: np.ndarray  # int32[P, N, R]
+    node_total: np.ndarray  # int32[N, R]
+    node_taints: np.ndarray  # uint32[N, Wt]
+    node_labels: np.ndarray  # uint32[N, Wl]
+    node_id_rank: np.ndarray  # int32[N]
+    node_unschedulable: np.ndarray  # bool[N]
+    order_res_idx: np.ndarray  # int32[K]
+    order_res_resolution: np.ndarray  # int32[K]
+
+    # jobs
+    job_req: np.ndarray  # int32[J, R]
+    job_tolerated: np.ndarray  # uint32[J, Wt]
+    job_selector: np.ndarray  # uint32[J, Wl]
+    job_possible: np.ndarray  # bool[J]
+    job_queue: np.ndarray  # int32[J]
+    job_prio: np.ndarray  # int32[J]
+    job_preemptible: np.ndarray  # bool[J]
+    job_is_running: np.ndarray  # bool[J]
+    job_node: np.ndarray  # int32[J]
+    job_key_group: np.ndarray  # int32[J]
+    job_pc: np.ndarray  # int32[J] priority-class index
+
+    # slots
+    slot_members: np.ndarray  # int32[S, M] (-1 pad)
+    slot_count: np.ndarray  # int32[S]
+    slot_queue: np.ndarray  # int32[S]
+    slot_is_running: np.ndarray  # bool[S]
+    slot_req: np.ndarray  # int32[S, R]
+    slot_key_group: np.ndarray  # int32[S] (-1 if N/A)
+    slot_jobs_before: np.ndarray  # int32[S] queued jobs before this slot in its queue
+    queue_slot_start: np.ndarray  # int32[Q]
+    queue_slot_end: np.ndarray  # int32[Q]
+
+    # queues
+    queue_weight: np.ndarray  # float[Q]
+    queue_name_rank: np.ndarray  # int32[Q]
+    queue_alloc0: np.ndarray  # sum[Q, R] running allocation (device units)
+    queue_demand_pc: np.ndarray  # sum[Q, C, R] demand by priority class
+    queue_pc_limit: np.ndarray  # float[Q, C, R] caps (+inf none)
+
+    # priority classes
+    pc_priority: np.ndarray  # int32[C]
+    pc_preemptible: np.ndarray  # bool[C]
+
+    # totals / limits
+    total_resources: np.ndarray  # float[R]
+    drf_multipliers: np.ndarray  # float[R]
+    max_round_resources: np.ndarray  # float[R]
+
+    # scalars (static or runtime)
+    protected_fraction: float
+    max_lookback: int
+    global_burst: int
+    queue_burst: int
+    global_tokens: float
+    queue_tokens: np.ndarray  # float[Q]
+    prefer_large: bool
+    num_key_groups: int
+
+
+jax.tree_util.register_dataclass(
+    DeviceRound,
+    data_fields=[
+        f.name for f in dataclasses.fields(DeviceRound) if f.name not in _META_FIELDS
+    ],
+    meta_fields=list(_META_FIELDS),
+)
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    n = max(n, floor)
+    return 1 << (n - 1).bit_length()
+
+
+def pad_device_round(dev: DeviceRound) -> DeviceRound:
+    """Pad J/N/S/Q/M axes to powers of two so differently sized snapshots
+    share compiled programs. Padded entries are inert:
+
+    - nodes: unschedulable, zero resources, id-rank after all real nodes
+    - jobs: impossible, queue -1, bound nowhere
+    - slots: count 0 (validity and rank assignment skip count-0 slots)
+    - queues: weight 0, no demand, no slot range (start=end=0)
+    """
+    J, R = dev.job_req.shape
+    N = dev.node_total.shape[0]
+    S, M = dev.slot_members.shape
+    Q = dev.queue_weight.shape[0]
+    P = dev.priorities.shape[0]
+    Jp, Np, Sp, Qp, Mp = _pow2(J), _pow2(N), _pow2(S), _pow2(Q, 2), _pow2(M, 1)
+    Gp = _pow2(dev.num_key_groups, 8)
+    if (Jp, Np, Sp, Qp, Mp, Gp) == (J, N, S, Q, M, dev.num_key_groups):
+        return dev
+
+    def pad(arr, axis, n_new, fill=0):
+        arr = np.asarray(arr)
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, n_new - arr.shape[axis])
+        return np.pad(arr, widths, constant_values=fill)
+
+    return dataclasses.replace(
+        dev,
+        alloc0=pad(dev.alloc0, 1, Np),
+        node_total=pad(dev.node_total, 0, Np),
+        node_taints=pad(dev.node_taints, 0, Np),
+        node_labels=pad(dev.node_labels, 0, Np),
+        node_id_rank=np.concatenate(
+            [np.asarray(dev.node_id_rank), np.arange(N, Np, dtype=np.int32)]
+        ),
+        node_unschedulable=pad(dev.node_unschedulable, 0, Np, fill=True),
+        job_req=pad(dev.job_req, 0, Jp),
+        job_tolerated=pad(dev.job_tolerated, 0, Jp),
+        job_selector=pad(dev.job_selector, 0, Jp),
+        job_possible=pad(dev.job_possible, 0, Jp, fill=False),
+        job_queue=pad(dev.job_queue, 0, Jp, fill=-1),
+        job_prio=pad(dev.job_prio, 0, Jp),
+        job_preemptible=pad(dev.job_preemptible, 0, Jp, fill=False),
+        job_is_running=pad(dev.job_is_running, 0, Jp, fill=False),
+        job_node=pad(dev.job_node, 0, Jp, fill=NO_NODE),
+        job_key_group=pad(dev.job_key_group, 0, Jp, fill=-1),
+        job_pc=pad(dev.job_pc, 0, Jp),
+        slot_members=pad(pad(dev.slot_members, 1, Mp, fill=-1), 0, Sp, fill=-1),
+        slot_count=pad(dev.slot_count, 0, Sp),
+        slot_queue=pad(dev.slot_queue, 0, Sp, fill=-1),
+        slot_is_running=pad(dev.slot_is_running, 0, Sp, fill=False),
+        slot_req=pad(dev.slot_req, 0, Sp),
+        slot_key_group=pad(dev.slot_key_group, 0, Sp, fill=-1),
+        slot_jobs_before=pad(dev.slot_jobs_before, 0, Sp),
+        queue_slot_start=pad(dev.queue_slot_start, 0, Qp),
+        queue_slot_end=pad(dev.queue_slot_end, 0, Qp),
+        queue_weight=pad(dev.queue_weight, 0, Qp),
+        queue_name_rank=np.concatenate(
+            [np.asarray(dev.queue_name_rank), np.arange(Q, Qp, dtype=np.int32)]
+        ),
+        queue_alloc0=pad(dev.queue_alloc0, 0, Qp),
+        queue_demand_pc=pad(dev.queue_demand_pc, 0, Qp),
+        queue_pc_limit=pad(dev.queue_pc_limit, 0, Qp, fill=np.inf),
+        queue_tokens=pad(dev.queue_tokens, 0, Qp),
+        num_key_groups=Gp,
+    )
+
+
+def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
+    cfg = snap.config
+    factory = snap.factory
+    J, N, Q = snap.num_jobs, snap.num_nodes, snap.num_queues
+    R = factory.num_resources
+    P = snap.num_priorities
+
+    req_dev = factory.to_device(snap.job_req, ceil=True)
+    alloc_dev = factory.to_device(snap.allocatable, ceil=False)
+    total_dev = factory.to_device(snap.node_total, ceil=False)
+
+    # Priority classes.
+    pc_names = list(cfg.priority_classes)
+    pc_index = {n: i for i, n in enumerate(pc_names)}
+    C = len(pc_names)
+    pc_priority = np.asarray(
+        [cfg.priority_classes[n].priority for n in pc_names], dtype=np.int32
+    )
+    pc_preemptible = np.asarray(
+        [cfg.priority_classes[n].preemptible for n in pc_names], dtype=bool
+    )
+    job_pc = np.asarray([pc_index[n] for n in snap.job_pc_name], dtype=np.int32)
+
+    # Scheduling-key groups over non-running jobs.
+    key_to_group: dict = {}
+    job_key_group = np.full(J, -1, dtype=np.int32)
+    for j in range(J):
+        if snap.job_is_running[j]:
+            continue
+        key = (
+            int(snap.job_queue[j]),
+            snap.job_req[j].tobytes(),
+            snap.job_tolerated[j].tobytes(),
+            snap.job_selector[j].tobytes(),
+            int(snap.job_priority[j]),
+            snap.job_pc_name[j],
+        )
+        g = key_to_group.setdefault(key, len(key_to_group))
+        job_key_group[j] = g
+    num_key_groups = max(1, len(key_to_group))
+
+    # ---- slots ----
+    # Segment 0: running gangs (eviction candidates), grouped by gang id.
+    # Segment 1: queued gangs from the snapshot gang table (complete only).
+    slots: list[dict] = []
+    running_groups: dict = {}
+    for j in range(J):
+        if not snap.job_is_running[j] or snap.job_queue[j] < 0:
+            continue
+        gid = snap.job_gang_id[j]
+        key = (int(snap.job_queue[j]), gid) if gid else (int(snap.job_queue[j]), f"__r{j}")
+        running_groups.setdefault(key, []).append(j)
+    for (q, _), members in running_groups.items():
+        members = sorted(members, key=lambda x: snap.job_order[x])
+        slots.append(
+            {
+                "queue": q,
+                "segment": 0,
+                "order": max(snap.job_order[m] for m in members),
+                "members": members,
+                "running": True,
+                "key_group": -1,
+            }
+        )
+    for g in range(snap.num_gangs):
+        members = snap.gang_members[
+            snap.gang_member_offsets[g] : snap.gang_member_offsets[g + 1]
+        ].tolist()
+        if snap.job_is_running[members[0]] or snap.gang_queue[g] < 0:
+            continue  # running jobs got slots above; unknown queues skipped
+        if not snap.gang_complete[g]:
+            continue  # incomplete gangs never yield (queue_scheduler.go:357)
+        kg = int(job_key_group[members[0]]) if len(members) == 1 else -1
+        slots.append(
+            {
+                "queue": int(snap.gang_queue[g]),
+                "segment": 1,
+                "order": int(snap.gang_order[g]),
+                "members": members,
+                "running": False,
+                "key_group": kg,
+            }
+        )
+
+    slots.sort(key=lambda s: (s["queue"], s["segment"], s["order"]))
+    S = max(1, len(slots))
+    M = max([1] + [len(s["members"]) for s in slots])
+    slot_members = np.full((S, M), -1, dtype=np.int32)
+    slot_count = np.zeros(S, dtype=np.int32)
+    slot_queue = np.full(S, -1, dtype=np.int32)
+    slot_is_running = np.zeros(S, dtype=bool)
+    slot_req = np.zeros((S, R), dtype=np.int32)
+    slot_key_group = np.full(S, -1, dtype=np.int32)
+    slot_jobs_before = np.zeros(S, dtype=np.int32)
+    queue_slot_start = np.zeros(Q, dtype=np.int32)
+    queue_slot_end = np.zeros(Q, dtype=np.int32)
+
+    jobs_before = 0
+    prev_queue = -1
+    for i, s in enumerate(slots):
+        q = s["queue"]
+        if q != prev_queue:
+            jobs_before = 0
+            if prev_queue >= 0:
+                queue_slot_end[prev_queue] = i
+            if 0 <= q < Q:
+                queue_slot_start[q] = i
+            prev_queue = q
+        members = s["members"]
+        slot_members[i, : len(members)] = members
+        slot_count[i] = len(members)
+        slot_queue[i] = q
+        slot_is_running[i] = s["running"]
+        slot_req[i] = req_dev[members].sum(axis=0)
+        slot_key_group[i] = s["key_group"]
+        slot_jobs_before[i] = jobs_before
+        if not s["running"]:
+            jobs_before += len(members)
+    if prev_queue >= 0:
+        queue_slot_end[prev_queue] = len(slots)
+
+    # ---- queue tensors ----
+    queue_name_rank = np.argsort(np.argsort(snap.queue_names)).astype(np.int32)
+    queue_alloc0 = np.zeros((Q, R), dtype=np.int64)
+    queue_demand_pc = np.zeros((Q, C, R), dtype=np.int64)
+    for j in range(J):
+        q = int(snap.job_queue[j])
+        if q < 0:
+            continue
+        if snap.job_is_running[j]:
+            queue_alloc0[q] += req_dev[j]
+        queue_demand_pc[q, job_pc[j]] += req_dev[j]
+
+    queue_pc_limit = np.full((Q, C, R), np.inf)
+    total_dev_sum = total_dev.astype(np.float64).sum(axis=0)
+    for ci, name in enumerate(pc_names):
+        pc = cfg.priority_classes[name]
+        fractions = dict(pc.maximum_resource_fraction_per_queue)
+        fractions.update(pc.maximum_resource_fraction_per_queue_by_pool.get(snap.pool, {}))
+        for rname, frac in fractions.items():
+            ri = factory.name_to_index.get(rname)
+            if ri is not None:
+                queue_pc_limit[:, ci, ri] = frac * total_dev_sum[ri]
+
+    max_round = np.full(R, np.inf)
+    for rname, frac in cfg.maximum_resource_fraction_to_schedule.items():
+        ri = factory.name_to_index.get(rname)
+        if ri is not None:
+            max_round[ri] = frac * total_dev_sum[ri]
+
+    # Candidate-order resolutions in device units.
+    order_res = []
+    for k, ri in enumerate(snap.order_res_idx):
+        host_res = int(snap.order_res_resolution[k])
+        dev_res = max(1, host_res // int(factory.device_divisor[ri]))
+        order_res.append(dev_res)
+
+    mult = snap.drf_multipliers()
+
+    limits = cfg.rate_limits
+    return DeviceRound(
+        priorities=snap.priorities.astype(np.int32),
+        alloc0=alloc_dev,
+        node_total=total_dev,
+        node_taints=snap.node_taint_bits,
+        node_labels=snap.node_label_bits,
+        node_id_rank=snap.node_id_rank,
+        node_unschedulable=snap.node_unschedulable,
+        order_res_idx=snap.order_res_idx.astype(np.int32),
+        order_res_resolution=np.asarray(order_res, dtype=np.int32),
+        job_req=req_dev,
+        job_tolerated=snap.job_tolerated,
+        job_selector=snap.job_selector,
+        job_possible=snap.job_possible,
+        job_queue=snap.job_queue,
+        job_prio=snap.job_priority.astype(np.int32),
+        job_preemptible=snap.job_preemptible,
+        job_is_running=snap.job_is_running,
+        job_node=snap.job_node.astype(np.int32),
+        job_key_group=job_key_group,
+        job_pc=job_pc,
+        slot_members=slot_members,
+        slot_count=slot_count,
+        slot_queue=slot_queue,
+        slot_is_running=slot_is_running,
+        slot_req=slot_req,
+        slot_key_group=slot_key_group,
+        slot_jobs_before=slot_jobs_before,
+        queue_slot_start=queue_slot_start,
+        queue_slot_end=queue_slot_end,
+        queue_weight=snap.queue_weight,
+        queue_name_rank=queue_name_rank,
+        queue_alloc0=queue_alloc0,
+        queue_demand_pc=queue_demand_pc,
+        queue_pc_limit=queue_pc_limit,
+        pc_priority=pc_priority,
+        pc_preemptible=pc_preemptible,
+        total_resources=total_dev.astype(np.float64).sum(axis=0),
+        drf_multipliers=mult,
+        max_round_resources=max_round,
+        protected_fraction=cfg.protected_fraction_of_fair_share,
+        max_lookback=cfg.max_queue_lookback,
+        global_burst=limits.maximum_scheduling_burst,
+        queue_burst=limits.maximum_per_queue_scheduling_burst,
+        global_tokens=float(limits.maximum_scheduling_burst),
+        queue_tokens=np.full(Q, float(limits.maximum_per_queue_scheduling_burst)),
+        prefer_large=cfg.enable_prefer_large_job_ordering,
+        num_key_groups=num_key_groups,
+    )

@@ -1,0 +1,50 @@
+"""JAX platform health guard.
+
+This machine exposes one real TPU chip through an experimental tunnel
+plugin ("axon") that registers itself in every interpreter via PYTHONPATH
+sitecustomize. When the tunnel is unhealthy, backend initialization blocks
+forever inside a C call — unkillable from Python. Guard: probe device init
+in a disposable subprocess with a timeout; on failure, deregister the
+tunnel backend factories in this process and pin the CPU platform.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def ensure_healthy_backend(probe_timeout: float = 90.0) -> str:
+    """Returns the platform that will be used ("axon"/"tpu"/"cpu")."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "cpu" in want.split(","):
+        _force_cpu()
+        return "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout,
+            capture_output=True,
+        )
+        if proc.returncode == 0:
+            return want or "axon"
+    except subprocess.TimeoutExpired:
+        pass
+    _force_cpu()
+    return "cpu"
+
+
+def _force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PYTHONPATH", None)
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        for plugin in ("axon", "tpu"):
+            xla_bridge._backend_factories.pop(plugin, None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

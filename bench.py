@@ -1,0 +1,105 @@
+"""Benchmark: one full scheduling round on the device (TPU when available).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "...", "vs_baseline": N}
+
+Baseline: the reference guards a production round with
+maxSchedulingDuration=5s (config/scheduler/config.yaml:83) at
+"tens of thousands of nodes / millions of queued jobs" scale.
+vs_baseline = 5.0 / measured_round_seconds (higher is better).
+"""
+
+import json
+import os
+import sys
+import time
+
+N_NODES = int(os.environ.get("BENCH_NODES", 5000))
+N_JOBS = int(os.environ.get("BENCH_JOBS", 100_000))
+N_QUEUES = int(os.environ.get("BENCH_QUEUES", 10))
+
+
+def build_inputs():
+    import numpy as np
+
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.core.types import JobSpec, NodeSpec, QueueSpec
+    from armada_tpu.snapshot.round import build_round_snapshot
+    from armada_tpu.solver.kernel_prep import prep_device_round
+
+    cfg = SchedulingConfig(
+        priority_classes={
+            "high": PriorityClass("high", 30000, preemptible=False),
+            "low": PriorityClass("low", 1000, preemptible=True),
+        },
+        default_priority_class="low",
+    )
+    rng = np.random.default_rng(0)
+    nodes = [
+        NodeSpec(
+            id=f"node-{i:05d}",
+            pool="default",
+            total_resources={"cpu": "32", "memory": "256Gi"},
+        )
+        for i in range(N_NODES)
+    ]
+    queues = [QueueSpec(f"queue-{i:02d}", 1.0) for i in range(N_QUEUES)]
+    cpus = rng.choice([1, 2, 4, 8], size=N_JOBS)
+    qidx = rng.integers(0, N_QUEUES, size=N_JOBS)
+    queued = [
+        JobSpec(
+            id=f"job-{i:07d}",
+            queue=f"queue-{qidx[i]:02d}",
+            priority_class="low",
+            requests={"cpu": str(int(cpus[i])), "memory": f"{int(cpus[i]) * 2}Gi"},
+            submitted_ts=float(i),
+        )
+        for i in range(N_JOBS)
+    ]
+    snap = build_round_snapshot(cfg, "default", nodes, queues, [], queued)
+    return prep_device_round(snap)
+
+
+def main():
+    from armada_tpu.utils.platform import ensure_healthy_backend
+
+    ensure_healthy_backend()
+
+    t_setup = time.time()
+    dev = build_inputs()
+    setup_s = time.time() - t_setup
+
+    import jax
+
+    from armada_tpu.solver.kernel import solve_round
+
+    platform = jax.devices()[0].platform
+    t0 = time.time()
+    out = solve_round(dev)  # compile + run
+    compile_s = time.time() - t0
+
+    t0 = time.time()
+    out = solve_round(dev)
+    round_s = time.time() - t0
+
+    scheduled = int(out["scheduled_mask"].sum())
+    result = {
+        "metric": (
+            f"scheduling_round_latency({N_JOBS} jobs x {N_NODES} nodes, "
+            f"{N_QUEUES} queues, burst-limited, {platform})"
+        ),
+        "value": round(round_s, 4),
+        "unit": "s",
+        "vs_baseline": round(5.0 / round_s, 2),
+        "extra": {
+            "scheduled_jobs": scheduled,
+            "compile_s": round(compile_s, 1),
+            "snapshot_build_s": round(setup_s, 1),
+            "loops": int(out["num_loops"]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,14 +2,33 @@
 
 Multi-chip hardware is not available in CI; sharding tests run on a virtual
 8-device CPU mesh instead (mirrors how the driver dry-runs multichip code).
-Must run before jax is imported anywhere.
+Must run before any test module imports jax-dependent code.
+
+The machine's global environment injects a TPU-tunnel PJRT plugin (axon) at
+interpreter startup which can hang backend discovery when the tunnel is
+unhealthy; armada_tpu.utils.platform handles the scrub.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("REPO_ROOT", os.path.dirname(os.path.dirname(__file__)))
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# x64 gives float64 cost arithmetic and int64 aggregates: exact parity with
+# the host oracle. The TPU bench path runs with x64 off (float32 costs).
+os.environ["JAX_ENABLE_X64"] = "1"
+
+from armada_tpu.utils.platform import _force_cpu  # noqa: E402
+
+_force_cpu()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

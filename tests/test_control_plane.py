@@ -1,0 +1,176 @@
+"""End-to-end control-plane tests: submit -> event log -> ingester -> jobdb
+-> scheduler cycle -> leases -> fake executor -> completion. The hermetic
+full-stack loop the reference gets from `mage dev:up fake-executor`."""
+
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import Gang, JobSpec, QueueSpec
+from armada_tpu.events import InMemoryEventLog
+from armada_tpu.jobdb import JobState
+from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+from armada_tpu.services.scheduler import SchedulerService
+from armada_tpu.services.submit import SubmissionError, SubmitService
+
+
+def mk_stack(n_nodes=4, backend="oracle", **cfg_kw):
+    config = SchedulingConfig(
+        priority_classes={
+            "default": PriorityClass("default", 1000, preemptible=True),
+        },
+        default_priority_class="default",
+        **cfg_kw,
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend=backend)
+    submit = SubmitService(config, log, scheduler=sched)
+    executor = FakeExecutor(
+        "cluster-a",
+        log,
+        sched,
+        nodes=make_nodes("cluster-a", count=n_nodes, cpu="16", memory="64Gi"),
+        runtime_for=lambda job_id: 10.0,
+    )
+    return config, log, sched, submit, executor
+
+
+def job(i, cpu="2", mem="4Gi", **kw):
+    return JobSpec(
+        id=f"job-{i:04d}", queue="", requests={"cpu": cpu, "memory": mem}, **kw
+    )
+
+
+def test_submit_validation():
+    _, _, _, submit, _ = mk_stack()
+    submit.create_queue(QueueSpec("team"))
+    with pytest.raises(SubmissionError):
+        submit.submit("ghost-queue", "set1", [job(0)])
+    with pytest.raises(SubmissionError):
+        submit.submit("team", "set1", [job(1).with_(requests={})])
+    with pytest.raises(SubmissionError):
+        submit.submit("team", "set1", [job(2).with_(requests={"fancy/widget": "1"})])
+    with pytest.raises(SubmissionError):
+        submit.submit("team", "set1", [job(3).with_(priority_class="nope")])
+    ids = submit.submit("team", "set1", [job(4)])
+    assert ids == ["job-0004"]
+
+
+def test_deduplication():
+    _, _, sched, submit, _ = mk_stack()
+    submit.create_queue(QueueSpec("team"))
+    j = job(0, annotations={"armadaproject.io/deduplication-id": "once"})
+    ids1 = submit.submit("team", "set1", [j])
+    ids2 = submit.submit("team", "set1", [job(1, annotations={"armadaproject.io/deduplication-id": "once"})])
+    assert ids1 == ids2
+    sched.ingester.sync()
+    assert len(sched.jobdb) == 1
+
+
+def test_full_lifecycle():
+    config, log, sched, submit, executor = mk_stack()
+    submit.create_queue(QueueSpec("team"))
+    submit.submit("team", "set1", [job(i) for i in range(8)], now=0.0)
+
+    executor.tick(0.0)  # heartbeat so the scheduler knows the cluster
+    sched.cycle(now=1.0)
+    txn = sched.jobdb.read_txn()
+    leased = [j for j in txn.all_jobs() if j.state == JobState.LEASED]
+    assert len(leased) == 8
+    assert all(j.latest_run.executor == "cluster-a" for j in leased)
+
+    executor.tick(2.0)  # accepts leases, reports running
+    sched.ingester.sync()
+    txn = sched.jobdb.read_txn()
+    assert all(j.state == JobState.RUNNING for j in txn.all_jobs())
+
+    executor.tick(13.0)  # runtime 10s elapsed -> succeeded
+    sched.ingester.sync()
+    txn = sched.jobdb.read_txn()
+    assert all(j.state == JobState.SUCCEEDED for j in txn.all_jobs())
+
+
+def test_capacity_backlog_drains():
+    config, log, sched, submit, executor = mk_stack(n_nodes=1)
+    submit.create_queue(QueueSpec("team"))
+    # 1 node x 16 cpu; 16 jobs x 4 cpu -> 4 at a time
+    submit.submit("team", "set1", [job(i, cpu="4") for i in range(16)], now=0.0)
+    t = 0.0
+    done = 0
+    for step in range(40):
+        t += 5.0
+        executor.tick(t)
+        sched.cycle(now=t)
+        txn = sched.jobdb.read_txn()
+        done = sum(1 for j in txn.all_jobs() if j.state == JobState.SUCCEEDED)
+        if done == 16:
+            break
+    assert done == 16, f"only {done} finished"
+
+
+def test_cancel_job():
+    config, log, sched, submit, executor = mk_stack()
+    submit.create_queue(QueueSpec("team"))
+    (jid,) = submit.submit("team", "set1", [job(0)], now=0.0)
+    submit.cancel_job("team", "set1", jid)
+    sched.ingester.sync()
+    assert sched.jobdb.get(jid).state == JobState.CANCELLED
+    # cancelled jobs never schedule
+    executor.tick(1.0)
+    sched.cycle(now=1.0)
+    assert sched.jobdb.get(jid).state == JobState.CANCELLED
+
+
+def test_reprioritise_changes_order():
+    config, log, sched, submit, executor = mk_stack(n_nodes=1)
+    submit.create_queue(QueueSpec("team"))
+    ids = submit.submit("team", "set1", [job(i, cpu="16") for i in range(3)], now=0.0)
+    submit.reprioritise_job("team", "set1", ids[2], -10)
+    executor.tick(1.0)
+    sched.cycle(now=1.0)
+    txn = sched.jobdb.read_txn()
+    # only one fits; the reprioritised job wins
+    assert txn.get(ids[2]).state == JobState.LEASED
+    assert txn.get(ids[0]).state == JobState.QUEUED
+
+
+def test_executor_timeout_requeues():
+    config, log, sched, submit, executor = mk_stack()
+    submit.create_queue(QueueSpec("team"))
+    (jid,) = submit.submit("team", "set1", [job(0)], now=0.0)
+    executor.tick(0.0)
+    sched.cycle(now=1.0)
+    assert sched.jobdb.get(jid).state == JobState.LEASED
+    # executor goes silent; timeout default 600s
+    sched.cycle(now=700.0)
+    j = sched.jobdb.get(jid)
+    assert j.state == JobState.QUEUED
+    assert j.num_attempts == 1
+
+
+def test_gang_schedules_atomically_e2e():
+    config, log, sched, submit, executor = mk_stack(n_nodes=4)
+    submit.create_queue(QueueSpec("team"))
+    gang = Gang(id="g1", cardinality=4)
+    submit.submit(
+        "team", "set1", [job(i, cpu="16", gang=gang) for i in range(4)], now=0.0
+    )
+    executor.tick(0.0)
+    sched.cycle(now=1.0)
+    txn = sched.jobdb.read_txn()
+    states = {j.id: j.state for j in txn.all_jobs()}
+    assert all(s == JobState.LEASED for s in states.values())
+    # each on its own node (16 cpu each, nodes are 16 cpu)
+    nodes = {j.latest_run.node_id for j in txn.all_jobs()}
+    assert len(nodes) == 4
+
+
+def test_cancel_jobset():
+    config, log, sched, submit, executor = mk_stack()
+    submit.create_queue(QueueSpec("team"))
+    submit.submit("team", "set1", [job(i) for i in range(3)], now=0.0)
+    submit.submit("team", "set2", [job(10)], now=0.0)
+    submit.cancel_jobset("team", "set1")
+    sched.ingester.sync()
+    txn = sched.jobdb.read_txn()
+    assert sum(1 for j in txn.all_jobs() if j.state == JobState.CANCELLED) == 3
+    assert txn.get("job-0010").state == JobState.QUEUED

@@ -1,0 +1,283 @@
+"""Placement parity: the jitted JAX kernel must reproduce the Python oracle
+exactly (which in turn mirrors the Go reference). Randomized scenario sweep
+plus directed cases for each mechanism."""
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import PriorityClass, RateLimits, SchedulingConfig
+from armada_tpu.core.types import Gang, JobSpec, NodeSpec, QueueSpec, RunningJob, Taint, Toleration
+from armada_tpu.snapshot.round import build_round_snapshot
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round, prep_device_round
+from armada_tpu.solver.reference import ReferenceSolver
+
+PREEMPT_CFG = SchedulingConfig(
+    priority_classes={
+        "high": PriorityClass("high", 30000, preemptible=False),
+        "low": PriorityClass("low", 1000, preemptible=True),
+    },
+    default_priority_class="low",
+    protected_fraction_of_fair_share=0.5,
+)
+
+
+def assert_parity(cfg, nodes, queues, running, queued, label=""):
+    snap = build_round_snapshot(cfg, "default", nodes, queues, running, queued)
+    oracle = ReferenceSolver(snap).solve()
+    # Padded shapes: scenarios share compiled programs across tests.
+    out = solve_round(pad_device_round(prep_device_round(snap)))
+    J, Q = snap.num_jobs, snap.num_queues
+    out = {
+        k: v[:J] if k.startswith(("assigned", "scheduled", "preempted")) else v[:Q]
+        for k, v in out.items()
+        if k != "num_loops"
+    }
+    o_nodes = oracle.assigned_node
+    k_nodes = out["assigned_node"]
+    mism = np.flatnonzero(o_nodes != k_nodes)
+    detail = [
+        (snap.job_ids[j], int(o_nodes[j]), int(k_nodes[j])) for j in mism[:10]
+    ]
+    assert (o_nodes == k_nodes).all(), f"{label}: node mismatch {detail}"
+    assert (oracle.scheduled_mask == out["scheduled_mask"]).all(), label
+    assert (oracle.preempted_mask == out["preempted_mask"]).all(), label
+    np.testing.assert_allclose(
+        oracle.demand_capped_fair_share,
+        out["demand_capped_fair_share"],
+        rtol=1e-12,
+        err_msg=label,
+    )
+    return snap, oracle, out
+
+
+def rand_scenario(rng, with_running=False, with_gangs=True, n_queues=3):
+    n_nodes = int(rng.integers(2, 8))
+    nodes = []
+    for i in range(n_nodes):
+        cpu = int(rng.choice([8, 16, 32, 64]))
+        mem = cpu * 4
+        labels = {}
+        taints = ()
+        if rng.random() < 0.3:
+            labels["zone"] = str(rng.choice(["a", "b"]))
+        if rng.random() < 0.2:
+            taints = (Taint("special", "true"),)
+        nodes.append(
+            NodeSpec(
+                id=f"node-{i:03d}",
+                pool="default",
+                labels=labels,
+                taints=taints,
+                total_resources={"cpu": str(cpu), "memory": f"{mem}Gi"},
+            )
+        )
+    queues = [QueueSpec(f"q{i}", float(rng.choice([1.0, 1.0, 2.0]))) for i in range(n_queues)]
+
+    running = []
+    jid = 0
+    if with_running:
+        for _ in range(int(rng.integers(0, 10))):
+            node = nodes[int(rng.integers(0, n_nodes))]
+            pc = str(rng.choice(["low", "low", "high"]))
+            running.append(
+                RunningJob(
+                    job=JobSpec(
+                        id=f"run-{jid:04d}",
+                        queue=f"q{int(rng.integers(0, n_queues))}",
+                        priority_class=pc,
+                        requests={
+                            "cpu": str(int(rng.choice([1, 2, 4]))),
+                            "memory": f"{int(rng.choice([1, 2, 4]))}Gi",
+                        },
+                        submitted_ts=float(jid),
+                        tolerations=(Toleration(key="special", value="true"),),
+                    ),
+                    node_id=node.id,
+                    scheduled_at_priority=1000 if pc == "low" else 30000,
+                )
+            )
+            jid += 1
+
+    queued = []
+    n_jobs = int(rng.integers(5, 30))
+    g = 0
+    while len(queued) < n_jobs:
+        q = f"q{int(rng.integers(0, n_queues))}"
+        cpu = int(rng.choice([1, 2, 4, 8]))
+        kw = {}
+        if rng.random() < 0.25:
+            kw["tolerations"] = (Toleration(key="special", value="true"),)
+        if rng.random() < 0.2:
+            kw["node_selector"] = {"zone": str(rng.choice(["a", "b"]))}
+        if with_gangs and rng.random() < 0.2:
+            card = int(rng.integers(2, 5))
+            gang = Gang(id=f"gang-{g}", cardinality=card)
+            g += 1
+            for _ in range(card):
+                queued.append(
+                    JobSpec(
+                        id=f"job-{jid:04d}",
+                        queue=q,
+                        priority_class=str(rng.choice(["low", "high"])),
+                        requests={"cpu": str(cpu), "memory": f"{cpu}Gi"},
+                        submitted_ts=float(jid),
+                        gang=gang,
+                        **kw,
+                    )
+                )
+                jid += 1
+        else:
+            queued.append(
+                JobSpec(
+                    id=f"job-{jid:04d}",
+                    queue=q,
+                    priority_class=str(rng.choice(["low", "high"])),
+                    requests={"cpu": str(cpu), "memory": f"{cpu}Gi"},
+                    submitted_ts=float(jid),
+                    **kw,
+                )
+            )
+            jid += 1
+    return nodes, queues, running, queued
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_parity_queued_only(seed):
+    rng = np.random.default_rng(seed)
+    nodes, queues, running, queued = rand_scenario(rng, with_running=False)
+    assert_parity(PREEMPT_CFG, nodes, queues, [], queued, f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", range(12, 24))
+def test_parity_with_running(seed):
+    rng = np.random.default_rng(seed)
+    nodes, queues, running, queued = rand_scenario(rng, with_running=True)
+    assert_parity(PREEMPT_CFG, nodes, queues, running, queued, f"seed={seed}")
+
+
+def test_parity_rate_limited():
+    cfg = SchedulingConfig(rate_limits=RateLimits(maximum_scheduling_burst=3))
+    nodes = [
+        NodeSpec(id="n0", pool="default", total_resources={"cpu": "32", "memory": "128Gi"})
+    ]
+    queued = [
+        JobSpec(id=f"j{i}", queue="q", requests={"cpu": "1", "memory": "1Gi"}, submitted_ts=i)
+        for i in range(10)
+    ]
+    assert_parity(cfg, nodes, [QueueSpec("q")], [], queued, "rate")
+
+
+def test_parity_round_fraction():
+    cfg = SchedulingConfig(maximum_resource_fraction_to_schedule={"cpu": 0.25})
+    nodes = [
+        NodeSpec(id="n0", pool="default", total_resources={"cpu": "32", "memory": "128Gi"})
+    ]
+    queued = [
+        JobSpec(id=f"j{i}", queue="q", requests={"cpu": "1", "memory": "1Gi"}, submitted_ts=i)
+        for i in range(20)
+    ]
+    assert_parity(cfg, nodes, [QueueSpec("q")], [], queued, "fraction")
+
+
+def test_parity_lookback():
+    cfg = SchedulingConfig(max_queue_lookback=4)
+    nodes = [
+        NodeSpec(id="n0", pool="default", total_resources={"cpu": "32", "memory": "128Gi"})
+    ]
+    queued = [
+        JobSpec(id=f"j{i}", queue="q", requests={"cpu": "1", "memory": "1Gi"}, submitted_ts=i)
+        for i in range(10)
+    ]
+    assert_parity(cfg, nodes, [QueueSpec("q")], [], queued, "lookback")
+
+
+def test_parity_eviction_rebalance():
+    nodes = [
+        NodeSpec(id="n0", pool="default", total_resources={"cpu": "32", "memory": "128Gi"})
+    ]
+    running = [
+        RunningJob(
+            job=JobSpec(
+                id=f"r{i}",
+                queue="hog",
+                priority_class="low",
+                requests={"cpu": "4", "memory": "4Gi"},
+                submitted_ts=i,
+            ),
+            node_id="n0",
+            scheduled_at_priority=1000,
+        )
+        for i in range(8)
+    ]
+    queued = [
+        JobSpec(
+            id=f"j{i}",
+            queue="newbie",
+            priority_class="low",
+            requests={"cpu": "4", "memory": "4Gi"},
+            submitted_ts=100 + i,
+        )
+        for i in range(8)
+    ]
+    assert_parity(
+        PREEMPT_CFG,
+        nodes,
+        [QueueSpec("hog"), QueueSpec("newbie")],
+        running,
+        queued,
+        "rebalance",
+    )
+
+
+def test_parity_urgency_preemption():
+    nodes = [
+        NodeSpec(id="n0", pool="default", total_resources={"cpu": "32", "memory": "128Gi"})
+    ]
+    running = [
+        RunningJob(
+            job=JobSpec(
+                id=f"r{i}",
+                queue="b",
+                priority_class="low",
+                requests={"cpu": "8", "memory": "8Gi"},
+                submitted_ts=i,
+            ),
+            node_id="n0",
+            scheduled_at_priority=1000,
+        )
+        for i in range(4)
+    ]
+    queued = [
+        JobSpec(
+            id="high0",
+            queue="a",
+            priority_class="high",
+            requests={"cpu": "8", "memory": "8Gi"},
+            submitted_ts=100,
+        )
+    ]
+    assert_parity(
+        PREEMPT_CFG, nodes, [QueueSpec("a"), QueueSpec("b")], running, queued, "urgency"
+    )
+
+
+def test_parity_gang_atomicity():
+    nodes = [
+        NodeSpec(id=f"n{i}", pool="default", total_resources={"cpu": "32", "memory": "128Gi"})
+        for i in range(2)
+    ]
+    gang = Gang(id="g", cardinality=3)
+    queued = [
+        JobSpec(
+            id=f"g{i}",
+            queue="q",
+            requests={"cpu": "20", "memory": "20Gi"},
+            submitted_ts=i,
+            gang=gang,
+        )
+        for i in range(3)
+    ] + [
+        JobSpec(id="s0", queue="q", requests={"cpu": "4", "memory": "4Gi"}, submitted_ts=10)
+    ]
+    assert_parity(SchedulingConfig(), nodes, [QueueSpec("q")], [], queued, "gang")
