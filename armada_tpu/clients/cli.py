@@ -1,0 +1,258 @@
+"""armadactl-equivalent CLI.
+
+Command surface mirrors /root/reference/internal/armadactl: queue CRUD and
+cordon, submit (YAML job files), cancel, reprioritize, watch, job queries,
+scheduling reports, plus `server` to run a local control plane.
+
+  python -m armada_tpu.clients.cli --server 127.0.0.1:50051 <command> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import yaml
+
+from .grpc_client import connect
+
+
+def _print(obj):
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def cmd_queue(args):
+    client = connect(args.server)
+    cordoned = True if args.cordon else (False if args.uncordon else None)
+    if args.action == "create":
+        client.create_queue(
+            args.name, args.priority_factor or 1.0, bool(cordoned)
+        )
+        print(f"created queue {args.name}")
+    elif args.action == "update":
+        client.update_queue(args.name, args.priority_factor, cordoned)
+        print(f"updated queue {args.name}")
+    elif args.action == "delete":
+        client.delete_queue(args.name)
+        print(f"deleted queue {args.name}")
+    elif args.action == "get":
+        _print(client.get_queue(args.name))
+    elif args.action == "list":
+        _print(client.list_queues())
+
+
+def _jobs_from_yaml(path: str) -> tuple[str, str, list[dict]]:
+    """Job-file format mirrors armadactl submit yaml: queue, jobSetId, jobs:
+    [{priority, priorityClassName, podSpec-ish requests, ...}]."""
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    queue = doc.get("queue", "")
+    jobset = doc.get("jobSetId", doc.get("jobset", ""))
+    jobs = []
+    for item in doc.get("jobs", []):
+        job = {
+            "priority": item.get("priority", 0),
+            "priority_class": item.get("priorityClassName", ""),
+            "requests": item.get("requests", {}),
+            "node_selector": item.get("nodeSelector", {}),
+            "annotations": item.get("annotations", {}),
+            "tolerations": item.get("tolerations", []),
+        }
+        count = int(item.get("count", 1))
+        gang = item.get("gang")
+        if gang:
+            job["gang"] = {
+                "id": gang.get("id", "gang"),
+                "cardinality": gang.get("cardinality", count),
+                "node_uniformity_label": gang.get("nodeUniformityLabel", ""),
+            }
+        jobs.extend([dict(job) for _ in range(count)])
+    return queue, jobset, jobs
+
+
+def cmd_submit(args):
+    client = connect(args.server)
+    queue, jobset, jobs = _jobs_from_yaml(args.file)
+    queue = args.queue or queue
+    jobset = args.jobset or jobset
+    ids = client.submit_jobs(queue, jobset, jobs)
+    for jid in ids:
+        print(jid)
+
+
+def cmd_cancel(args):
+    client = connect(args.server)
+    client.cancel_jobs(
+        args.queue,
+        args.jobset,
+        job_ids=[args.job_id] if args.job_id else (),
+        cancel_jobset=args.job_id is None,
+    )
+    print("cancelled")
+
+
+def cmd_reprioritize(args):
+    client = connect(args.server)
+    client.reprioritize_jobs(args.queue, args.jobset, [args.job_id], args.priority)
+    print("reprioritized")
+
+
+def cmd_watch(args):
+    client = connect(args.server)
+    for event in client.watch_jobset(args.queue, args.jobset, watch=not args.no_follow):
+        print(json.dumps(event, default=str))
+
+
+def cmd_jobs(args):
+    client = connect(args.server)
+    filters = []
+    if args.queue:
+        filters.append({"field": "queue", "value": args.queue})
+    if args.state:
+        filters.append({"field": "state", "value": args.state})
+    _print(client.get_jobs(filters=filters, take=args.take))
+
+
+def cmd_report(args):
+    client = connect(args.server)
+    if args.kind == "scheduling":
+        print(client.scheduling_report())
+    elif args.kind == "queue":
+        print(client.queue_report(args.name))
+    elif args.kind == "job":
+        print(client.job_report(args.name))
+
+
+def cmd_server(args):
+    from ..core.config import SchedulingConfig
+    from ..services.server import ControlPlane
+
+    config = SchedulingConfig()
+    if args.config:
+        with open(args.config) as f:
+            doc = yaml.safe_load(f) or {}
+        config = SchedulingConfig.from_dict(doc.get("scheduling", doc))
+    fakes = []
+    for spec in args.fake_executor or []:
+        # name:nodes:cpu e.g. clusterA:100:8
+        parts = spec.split(":")
+        fakes.append(
+            {
+                "name": parts[0],
+                "nodes": int(parts[1]) if len(parts) > 1 else 10,
+                "cpu": parts[2] if len(parts) > 2 else "8",
+            }
+        )
+    plane = ControlPlane(
+        config,
+        backend=args.backend,
+        grpc_port=args.port,
+        metrics_port=args.metrics_port,
+        fake_executors=fakes,
+        cycle_period=args.cycle_period,
+    ).start()
+    print(f"serving on {plane.address}" + (
+        f", metrics on :{args.metrics_port}" if args.metrics_port else ""
+    ))
+    try:
+        import signal
+
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    finally:
+        plane.stop()
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="armadactl-tpu")
+    p.add_argument(
+        "--server",
+        default=os.environ.get("ARMADA_SERVER", "127.0.0.1:50051"),
+        help="gRPC server address",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    q = sub.add_parser("queue", help="queue CRUD")
+    q.add_argument("action", choices=["create", "update", "delete", "get", "list"])
+    q.add_argument("name", nargs="?", default="")
+    q.add_argument("--priority-factor", type=float, default=None)
+    q.add_argument("--cordon", action="store_true")
+    q.add_argument("--uncordon", action="store_true")
+    q.set_defaults(fn=cmd_queue)
+
+    s = sub.add_parser("submit", help="submit jobs from a YAML file")
+    s.add_argument("file")
+    s.add_argument("--queue", default="")
+    s.add_argument("--jobset", default="")
+    s.set_defaults(fn=cmd_submit)
+
+    c = sub.add_parser("cancel")
+    c.add_argument("--queue", required=True)
+    c.add_argument("--jobset", required=True)
+    c.add_argument("--job-id")
+    c.set_defaults(fn=cmd_cancel)
+
+    r = sub.add_parser("reprioritize")
+    r.add_argument("--queue", required=True)
+    r.add_argument("--jobset", required=True)
+    r.add_argument("--job-id", required=True)
+    r.add_argument("--priority", type=int, required=True)
+    r.set_defaults(fn=cmd_reprioritize)
+
+    w = sub.add_parser("watch")
+    w.add_argument("queue")
+    w.add_argument("jobset")
+    w.add_argument("--no-follow", action="store_true")
+    w.set_defaults(fn=cmd_watch)
+
+    j = sub.add_parser("jobs")
+    j.add_argument("--queue")
+    j.add_argument("--state")
+    j.add_argument("--take", type=int, default=100)
+    j.set_defaults(fn=cmd_jobs)
+
+    rep = sub.add_parser("report")
+    rep.add_argument("kind", choices=["scheduling", "queue", "job"])
+    rep.add_argument("name", nargs="?", default="")
+    rep.set_defaults(fn=cmd_report)
+
+    srv = sub.add_parser("server", help="run a local control plane")
+    srv.add_argument("--port", type=int, default=50051)
+    srv.add_argument("--metrics-port", type=int, default=None)
+    srv.add_argument("--config")
+    srv.add_argument("--backend", default="oracle", choices=["oracle", "kernel"])
+    srv.add_argument("--cycle-period", type=float, default=1.0)
+    srv.add_argument(
+        "--fake-executor",
+        action="append",
+        help="name:nodes:cpu, repeatable",
+    )
+    srv.set_defaults(fn=cmd_server)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    try:
+        args.fn(args)
+    except BrokenPipeError:
+        # stdout consumer (e.g. head) closed the pipe: normal for CLIs.
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        sys.exit(0)
+    except Exception as e:
+        import grpc
+
+        if isinstance(e, grpc.RpcError):
+            print(f"error: {e.details()}", file=sys.stderr)
+            sys.exit(1)
+        raise
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""Client connection helper (pkg/client ApiConnectionDetails analogue)."""
+
+from __future__ import annotations
+
+from ..services.grpc_api import ApiClient
+
+
+def connect(target: str) -> ApiClient:
+    return ApiClient(target)

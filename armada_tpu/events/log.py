@@ -85,3 +85,9 @@ class InMemoryEventLog(EventLog):
         cond = threading.Condition()
         self._watchers.append(cond)
         return cond
+
+    def remove_watcher(self, cond: threading.Condition):
+        try:
+            self._watchers.remove(cond)
+        except ValueError:
+            pass

@@ -1,0 +1,397 @@
+"""gRPC API: remote submit/query/events/reports surface.
+
+Plays the role of the reference's gRPC services (Submit/QueueService/
+Event/Jobs, /root/reference/pkg/api/submit.proto:356-401, event.proto:279,
+job.proto:102). Methods are hosted with grpc generic handlers and
+JSON-encoded messages: same capability surface (remote clients, streaming
+watch) without a protoc codegen step; a protobuf wire encoding can be added
+as an alternate content type behind the same method table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time as _time
+
+import grpc
+
+from ..core.types import Gang, JobSpec, QueueSpec, Toleration
+from ..jobdb import JobState
+from .queryapi import JobFilter, Order
+
+SERVICE = "armada_tpu.Api"
+
+
+def _encode(obj) -> bytes:
+    def default(o):
+        if dataclasses.is_dataclass(o) and not isinstance(o, type):
+            return dataclasses.asdict(o)
+        if isinstance(o, JobState):
+            return o.value
+        if hasattr(o, "tolist"):
+            return o.tolist()
+        raise TypeError(f"unserializable {type(o)}")
+
+    return json.dumps(obj, default=default).encode()
+
+
+def _decode(data: bytes):
+    return json.loads(data.decode()) if data else {}
+
+
+def job_spec_from_dict(d: dict) -> JobSpec:
+    gang = None
+    if d.get("gang"):
+        g = d["gang"]
+        gang = Gang(
+            id=g["id"],
+            cardinality=int(g["cardinality"]),
+            node_uniformity_label=g.get("node_uniformity_label", ""),
+        )
+    tolerations = tuple(
+        Toleration(
+            key=t.get("key", ""),
+            operator=t.get("operator", "Equal"),
+            value=t.get("value", ""),
+            effect=t.get("effect", ""),
+        )
+        for t in d.get("tolerations", ())
+    )
+    return JobSpec(
+        id=d.get("id", ""),
+        queue=d.get("queue", ""),
+        jobset=d.get("jobset", ""),
+        priority=int(d.get("priority", 0)),
+        priority_class=d.get("priority_class", ""),
+        requests=dict(d.get("requests", {})),
+        node_selector=dict(d.get("node_selector", {})),
+        tolerations=tolerations,
+        gang=gang,
+        annotations=dict(d.get("annotations", {})),
+    )
+
+
+class ApiServer:
+    """Hosts submit/query/events/reports over one gRPC server."""
+
+    def __init__(self, submit, scheduler, query, log, submit_checker=None):
+        self.submit = submit
+        self.scheduler = scheduler
+        self.query = query
+        self.log = log
+        self.submit_checker = submit_checker
+
+    # ---- unary handlers ----
+
+    def _submit_jobs(self, req):
+        jobs = [
+            job_spec_from_dict(j).with_(queue=req["queue"], jobset=req["jobset"])
+            for j in req["jobs"]
+        ]
+        if self.submit_checker is not None:
+            check = self.submit_checker.check(jobs)
+            if not check.schedulable:
+                raise ValueError(f"jobs would never schedule: {check.reason}")
+        ids = self.submit.submit(req["queue"], req["jobset"], jobs)
+        return {"job_ids": ids}
+
+    def _cancel_jobs(self, req):
+        for job_id in req.get("job_ids", []):
+            self.submit.cancel_job(
+                req["queue"], req["jobset"], job_id, req.get("reason", "")
+            )
+        if req.get("cancel_jobset"):
+            self.submit.cancel_jobset(req["queue"], req["jobset"], req.get("reason", ""))
+        return {}
+
+    def _reprioritize(self, req):
+        for job_id in req.get("job_ids", []):
+            self.submit.reprioritise_job(
+                req["queue"], req["jobset"], job_id, int(req["priority"])
+            )
+        return {}
+
+    def _create_queue(self, req):
+        self.submit.create_queue(
+            QueueSpec(req["name"], float(req.get("priority_factor", 1.0))),
+            cordoned=bool(req.get("cordoned", False)),
+        )
+        return {}
+
+    def _update_queue(self, req):
+        pf = req.get("priority_factor")
+        self.submit.update_queue(
+            req["name"],
+            priority_factor=float(pf) if pf is not None else None,
+            cordoned=req.get("cordoned"),
+        )
+        return {}
+
+    def _delete_queue(self, req):
+        self.submit.delete_queue(req["name"])
+        return {}
+
+    def _get_queue(self, req):
+        q = self.submit.get_queue(req["name"])
+        if q is None:
+            raise KeyError(f"queue {req['name']!r} not found")
+        return {
+            "name": q.spec.name,
+            "priority_factor": q.spec.priority_factor,
+            "cordoned": q.cordoned,
+        }
+
+    def _list_queues(self, req):
+        return {
+            "queues": [
+                {
+                    "name": q.spec.name,
+                    "priority_factor": q.spec.priority_factor,
+                    "cordoned": q.cordoned,
+                }
+                for q in self.submit.queues.values()
+            ]
+        }
+
+    def _get_jobs(self, req):
+        filters = [
+            JobFilter(f["field"], f.get("value"), f.get("match", "exact"))
+            for f in req.get("filters", [])
+        ]
+        order = Order(
+            req.get("order_field", "submitted"), req.get("order_direction", "asc")
+        )
+        rows, total = self.query.get_jobs(
+            filters, order, int(req.get("skip", 0)), int(req.get("take", 100))
+        )
+        return {"jobs": [dataclasses.asdict(r) for r in rows], "total": total}
+
+    def _group_jobs(self, req):
+        filters = [
+            JobFilter(f["field"], f.get("value"), f.get("match", "exact"))
+            for f in req.get("filters", [])
+        ]
+        return {
+            "groups": self.query.group_jobs(
+                req["group_by"], filters, req.get("aggregates", [])
+            )
+        }
+
+    def _scheduling_report(self, req):
+        return {"report": self.scheduler.reports.scheduling_report()}
+
+    def _queue_report(self, req):
+        return {"report": self.scheduler.reports.queue_report(req["queue"])}
+
+    def _job_report(self, req):
+        return {"report": self.scheduler.reports.job_report(req["job_id"])}
+
+    # ---- streaming ----
+
+    def _watch_jobset(self, req, context):
+        """Server-streaming jobset events (event.proto:279 GetJobSetEvents)."""
+        queue, jobset = req["queue"], req["jobset"]
+        cursor = int(req.get("from_offset", 0))
+        watch = bool(req.get("watch", True))
+        cond = self.log.watcher() if watch else None
+        try:
+            while context.is_active():
+                entries = self.log.read(cursor, 1000)
+                for entry in entries:
+                    cursor = entry.offset + 1
+                    seq = entry.sequence
+                    if seq.queue != queue or seq.jobset != jobset:
+                        continue
+                    for event in seq.events:
+                        payload = {
+                            "type": type(event).__name__,
+                            "offset": entry.offset,
+                            **{
+                                k: v
+                                for k, v in dataclasses.asdict(event).items()
+                                if k != "job" and not isinstance(v, dict)
+                            },
+                        }
+                        if hasattr(event, "job") and event.job is not None:
+                            payload["job_id"] = event.job.id
+                        yield _encode(payload)
+                if not watch:
+                    return
+                with cond:
+                    cond.wait(timeout=0.5)
+        finally:
+            if cond is not None:
+                self.log.remove_watcher(cond)
+
+    # ---- wiring ----
+
+    def method_table(self):
+        return {
+            "SubmitJobs": self._submit_jobs,
+            "CancelJobs": self._cancel_jobs,
+            "ReprioritizeJobs": self._reprioritize,
+            "CreateQueue": self._create_queue,
+            "UpdateQueue": self._update_queue,
+            "DeleteQueue": self._delete_queue,
+            "GetQueue": self._get_queue,
+            "ListQueues": self._list_queues,
+            "GetJobs": self._get_jobs,
+            "GroupJobs": self._group_jobs,
+            "SchedulingReport": self._scheduling_report,
+            "QueueReport": self._queue_report,
+            "JobReport": self._job_report,
+        }
+
+    def serve(self, port: int = 0, max_workers: int = 8):
+        from concurrent import futures
+
+        table = self.method_table()
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                name = handler_call_details.method  # /Service/Method
+                parts = name.strip("/").split("/")
+                if len(parts) != 2 or parts[0] != SERVICE:
+                    return None
+                method = parts[1]
+                if method == "WatchJobSet":
+                    def stream(request, context):
+                        yield from outer._watch_jobset(_decode(request), context)
+
+                    return grpc.unary_stream_rpc_method_handler(
+                        stream,
+                        request_deserializer=bytes,
+                        response_serializer=bytes,
+                    )
+                fn = table.get(method)
+                if fn is None:
+                    return None
+
+                def unary(request, context):
+                    try:
+                        return _encode(fn(_decode(request)))
+                    except KeyError as e:
+                        context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                    except ValueError as e:
+                        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+
+                return grpc.unary_unary_rpc_method_handler(
+                    unary, request_deserializer=bytes, response_serializer=bytes
+                )
+
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        server.add_generic_rpc_handlers((Handler(),))
+        bound_port = server.add_insecure_port(f"127.0.0.1:{port}")
+        server.start()
+        return server, bound_port
+
+
+class ApiClient:
+    """Python client for the gRPC API (pkg/client + client/python analogue)."""
+
+    def __init__(self, target: str):
+        self.channel = grpc.insecure_channel(target)
+
+    def _call(self, method: str, request: dict):
+        fn = self.channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=bytes,
+            response_deserializer=bytes,
+        )
+        return _decode(fn(_encode(request)))
+
+    def submit_jobs(self, queue, jobset, jobs: list[dict]):
+        return self._call(
+            "SubmitJobs", {"queue": queue, "jobset": jobset, "jobs": jobs}
+        )["job_ids"]
+
+    def cancel_jobs(self, queue, jobset, job_ids=(), cancel_jobset=False, reason=""):
+        self._call(
+            "CancelJobs",
+            {
+                "queue": queue,
+                "jobset": jobset,
+                "job_ids": list(job_ids),
+                "cancel_jobset": cancel_jobset,
+                "reason": reason,
+            },
+        )
+
+    def reprioritize_jobs(self, queue, jobset, job_ids, priority):
+        self._call(
+            "ReprioritizeJobs",
+            {
+                "queue": queue,
+                "jobset": jobset,
+                "job_ids": list(job_ids),
+                "priority": priority,
+            },
+        )
+
+    def create_queue(self, name, priority_factor=1.0, cordoned=False):
+        self._call(
+            "CreateQueue",
+            {"name": name, "priority_factor": priority_factor, "cordoned": cordoned},
+        )
+
+    def update_queue(self, name, priority_factor=None, cordoned=None):
+        self._call(
+            "UpdateQueue",
+            {"name": name, "priority_factor": priority_factor, "cordoned": cordoned},
+        )
+
+    def delete_queue(self, name):
+        self._call("DeleteQueue", {"name": name})
+
+    def get_queue(self, name):
+        return self._call("GetQueue", {"name": name})
+
+    def list_queues(self):
+        return self._call("ListQueues", {})["queues"]
+
+    def get_jobs(self, filters=(), order_field="submitted", order_direction="asc",
+                 skip=0, take=100):
+        return self._call(
+            "GetJobs",
+            {
+                "filters": list(filters),
+                "order_field": order_field,
+                "order_direction": order_direction,
+                "skip": skip,
+                "take": take,
+            },
+        )
+
+    def group_jobs(self, group_by, filters=(), aggregates=()):
+        return self._call(
+            "GroupJobs",
+            {"group_by": group_by, "filters": list(filters),
+             "aggregates": list(aggregates)},
+        )["groups"]
+
+    def scheduling_report(self):
+        return self._call("SchedulingReport", {})["report"]
+
+    def queue_report(self, queue):
+        return self._call("QueueReport", {"queue": queue})["report"]
+
+    def job_report(self, job_id):
+        return self._call("JobReport", {"job_id": job_id})["report"]
+
+    def watch_jobset(self, queue, jobset, from_offset=0, watch=True):
+        fn = self.channel.unary_stream(
+            f"/{SERVICE}/WatchJobSet",
+            request_serializer=bytes,
+            response_deserializer=bytes,
+        )
+        stream = fn(
+            _encode(
+                {"queue": queue, "jobset": jobset, "from_offset": from_offset,
+                 "watch": watch}
+            )
+        )
+        for msg in stream:
+            yield _decode(msg)

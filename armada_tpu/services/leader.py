@@ -1,0 +1,105 @@
+"""Leader election: standalone and file-lease modes.
+
+The reference supports `standalone` (always leader) and `kubernetes`
+(coordination.k8s.io Lease) modes, with a LeaderToken whose validity gates
+publishing (/root/reference/internal/leaderelection/leaderelection.go:16-63).
+Kubernetes is out of scope here; the file-lease mode gives multi-process
+HA on a shared filesystem with the same token semantics: a cycle captures a
+token at its start, and publishes only validate against that token — losing
+leadership mid-cycle invalidates the token so the next leader re-derives
+events idempotently (scheduler.go:225-233).
+"""
+
+from __future__ import annotations
+
+import os
+import time as _time
+import uuid
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class LeaderToken:
+    leader: bool
+    id: str = ""
+
+
+class StandaloneLeader:
+    """Always the leader (leader.mode=standalone)."""
+
+    def __init__(self):
+        self._id = str(uuid.uuid4())
+
+    def get_token(self) -> LeaderToken:
+        return LeaderToken(leader=True, id=self._id)
+
+    def validate(self, token: LeaderToken) -> bool:
+        return token.leader and token.id == self._id
+
+    def __call__(self) -> bool:  # is_leader interface for SchedulerService
+        return True
+
+
+class FileLeaseLeader:
+    """Lease file on shared storage: holder renews mtime; takeover after
+    lease_duration of silence. Single-writer via atomic create/replace."""
+
+    def __init__(
+        self,
+        path: str,
+        lease_duration: float = 15.0,
+        renew_deadline: float = 10.0,
+        identity: str | None = None,
+    ):
+        self.path = path
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4()}"
+        self._epoch = 0
+
+    def _read(self):
+        try:
+            with open(self.path) as f:
+                holder, ts = f.read().strip().split("\n")
+                return holder, float(ts)
+        except (FileNotFoundError, ValueError):
+            return None, 0.0
+
+    def _write(self, now: float):
+        tmp = f"{self.path}.{self.identity}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{self.identity}\n{now}")
+        os.replace(tmp, self.path)
+
+    def try_acquire_or_renew(self, now: float | None = None) -> bool:
+        now = _time.time() if now is None else now
+        holder, ts = self._read()
+        if holder == self.identity:
+            self._write(now)
+            return True
+        if holder is None or now - ts > self.lease_duration:
+            self._write(now)
+            # Re-read to confirm we won the race.
+            holder, _ = self._read()
+            won = holder == self.identity
+            if won:
+                self._epoch += 1
+            return won
+        return False
+
+    def get_token(self) -> LeaderToken:
+        leader = self.try_acquire_or_renew()
+        return LeaderToken(leader=leader, id=f"{self.identity}:{self._epoch}")
+
+    def validate(self, token: LeaderToken) -> bool:
+        if not token.leader:
+            return False
+        holder, ts = self._read()
+        return (
+            holder == self.identity
+            and token.id == f"{self.identity}:{self._epoch}"
+            and _time.time() - ts <= self.lease_duration
+        )
+
+    def __call__(self) -> bool:
+        return self.try_acquire_or_renew()

@@ -1,0 +1,110 @@
+"""Prometheus metrics for the scheduler and control plane.
+
+Covers the reference's headline scheduler metrics
+(/root/reference/internal/scheduler/metrics/{metrics,cycle_metrics}.go):
+cycle time, per-queue/pool fair share vs actual share, demand, scheduled and
+preempted counts, and job state-transition counters. Exposed via
+prometheus_client's text endpoint.
+"""
+
+from __future__ import annotations
+
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+
+    HAVE_PROMETHEUS = True
+except Exception:  # pragma: no cover
+    HAVE_PROMETHEUS = False
+
+
+class SchedulerMetrics:
+    def __init__(self, registry=None):
+        if not HAVE_PROMETHEUS:
+            self.registry = None
+            return
+        self.registry = registry or CollectorRegistry()
+        r = self.registry
+        self.cycle_time = Histogram(
+            "scheduler_cycle_seconds",
+            "Wall-clock time of one scheduling cycle",
+            registry=r,
+        )
+        self.solve_time = Histogram(
+            "scheduler_solve_seconds",
+            "Device solve time within a cycle",
+            ["pool"],
+            registry=r,
+        )
+        self.fair_share = Gauge(
+            "scheduler_queue_fair_share",
+            "Demand-capped adjusted fair share",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.actual_share = Gauge(
+            "scheduler_queue_actual_share",
+            "Actual share of pool resources",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.scheduled_jobs = Counter(
+            "scheduler_jobs_scheduled_total",
+            "Jobs scheduled",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.preempted_jobs = Counter(
+            "scheduler_jobs_preempted_total",
+            "Jobs preempted",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.considered_jobs = Gauge(
+            "scheduler_jobs_considered",
+            "Jobs considered in the last round",
+            ["pool"],
+            registry=r,
+        )
+        self.job_state_transitions = Counter(
+            "scheduler_job_state_transitions_total",
+            "Job state transitions observed",
+            ["state"],
+            registry=r,
+        )
+        self.event_log_offset = Gauge(
+            "event_log_end_offset", "End offset of the event log", registry=r
+        )
+
+    def render(self) -> bytes:
+        if not HAVE_PROMETHEUS:
+            return b""
+        return generate_latest(self.registry)
+
+
+def serve_metrics(metrics: SchedulerMetrics, port: int):
+    """Tiny HTTP endpoint serving /metrics (common.ServeMetrics)."""
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = metrics.render()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server

@@ -1,0 +1,145 @@
+"""Job query API: the Lookout data surface.
+
+The reference materializes a denormalized lookout Postgres schema and
+serves a REST API with rich filtering/grouping/aggregation
+(/root/reference/internal/lookout/repository/{getjobs,groupjobs}.go and
+internal/server/queryapi). Here the same query surface runs over the jobdb
+materialization directly (the log is the source of truth either way); the
+REST/gRPC transport wraps this object.
+
+Supported: field filters (exact/any-of/prefix), ordering, pagination,
+group-by with counts and aggregates — the operations the Lookout UI issues.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..jobdb import JobDb, JobState
+
+
+@dataclass(frozen=True)
+class JobFilter:
+    field: str  # queue | jobset | state | job_id | priority_class
+    value: object = None
+    match: str = "exact"  # exact | anyOf | startsWith
+
+
+@dataclass(frozen=True)
+class Order:
+    field: str = "submitted"  # submitted | job_id | priority | state
+    direction: str = "asc"
+
+
+@dataclass
+class JobRow:
+    job_id: str
+    queue: str
+    jobset: str
+    state: str
+    priority: int
+    priority_class: str
+    submitted: float
+    node: str
+    executor: str
+    attempts: int
+    error: str
+
+    @staticmethod
+    def from_job(job) -> "JobRow":
+        run = job.latest_run
+        return JobRow(
+            job_id=job.id,
+            queue=job.queue,
+            jobset=job.jobset,
+            state=job.state.value,
+            priority=job.priority,
+            priority_class=job.spec.priority_class,
+            submitted=job.submitted,
+            node=run.node_id if run else "",
+            executor=run.executor if run else "",
+            attempts=job.num_attempts,
+            error=job.error,
+        )
+
+
+def _matches(row: JobRow, f: JobFilter) -> bool:
+    actual = getattr(row, f.field, None)
+    if f.match == "exact":
+        return actual == f.value
+    if f.match == "anyOf":
+        return actual in f.value
+    if f.match == "startsWith":
+        return isinstance(actual, str) and actual.startswith(str(f.value))
+    raise ValueError(f"unknown match {f.match!r}")
+
+
+class QueryApi:
+    def __init__(self, jobdb: JobDb):
+        self.jobdb = jobdb
+
+    def _rows(self) -> list[JobRow]:
+        txn = self.jobdb.read_txn()
+        return [JobRow.from_job(j) for j in txn.all_jobs()]
+
+    def get_jobs(
+        self,
+        filters: list[JobFilter] = (),
+        order: Order = Order(),
+        skip: int = 0,
+        take: int = 100,
+    ) -> tuple[list[JobRow], int]:
+        """Filtered, ordered, paginated rows + total match count."""
+        rows = [r for r in self._rows() if all(_matches(r, f) for f in filters)]
+        rows.sort(
+            key=lambda r: getattr(r, order.field),
+            reverse=(order.direction == "desc"),
+        )
+        return rows[skip : skip + take], len(rows)
+
+    def group_jobs(
+        self,
+        group_by: str,
+        filters: list[JobFilter] = (),
+        aggregates: list[str] = (),
+    ) -> list[dict]:
+        """Counts (+ aggregates) per group value (groupjobs.go)."""
+        groups: dict = {}
+        for row in self._rows():
+            if not all(_matches(row, f) for f in filters):
+                continue
+            key = getattr(row, group_by)
+            g = groups.setdefault(
+                key, {"name": key, "count": 0, "aggregates": {}}
+            )
+            g["count"] += 1
+            for agg in aggregates:
+                if agg == "submitted_min":
+                    cur = g["aggregates"].get(agg)
+                    g["aggregates"][agg] = (
+                        row.submitted if cur is None else min(cur, row.submitted)
+                    )
+                elif agg == "submitted_max":
+                    cur = g["aggregates"].get(agg)
+                    g["aggregates"][agg] = (
+                        row.submitted if cur is None else max(cur, row.submitted)
+                    )
+                elif agg == "state_counts":
+                    sc = g["aggregates"].setdefault(agg, {})
+                    sc[row.state] = sc.get(row.state, 0) + 1
+        return sorted(groups.values(), key=lambda g: -g["count"])
+
+    def get_job_spec(self, job_id: str):
+        job = self.jobdb.get(job_id)
+        return job.spec if job else None
+
+    def get_job_runs(self, job_id: str):
+        job = self.jobdb.get(job_id)
+        return list(job.runs) if job else []
+
+    def active_job_sets(self) -> list[tuple[str, str]]:
+        seen = {}
+        for row in self._rows():
+            if row.state in ("queued", "leased", "pending", "running"):
+                seen[(row.queue, row.jobset)] = True
+        return sorted(seen)

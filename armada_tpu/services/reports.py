@@ -1,0 +1,105 @@
+"""Scheduling reports: the most recent round context per queue and job.
+
+Equivalent of /root/reference/internal/scheduler/reports/: the scheduler
+stores each round's outcome (per-queue shares/allocations, per-job
+unschedulable reasons), and armadactl-equivalent tooling renders them. The
+leader-proxying of the reference is unnecessary in-process; the gRPC layer
+can forward to the leader when multi-replica deployments arrive.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class QueueReport:
+    queue: str
+    fair_share: float = 0.0
+    adjusted_fair_share: float = 0.0
+    actual_share: float = 0.0
+    scheduled_jobs: int = 0
+    preempted_jobs: int = 0
+
+
+@dataclass
+class RoundReport:
+    pool: str
+    started: float
+    finished: float
+    num_jobs: int
+    num_nodes: int
+    termination_reason: str = ""
+    queues: dict = field(default_factory=dict)  # queue -> QueueReport
+    job_reasons: dict = field(default_factory=dict)  # job_id -> reason
+
+    def report_string(self) -> str:
+        lines = [
+            f"pool: {self.pool}",
+            f"duration: {self.finished - self.started:.3f}s",
+            f"jobs considered: {self.num_jobs}, nodes: {self.num_nodes}",
+            f"termination: {self.termination_reason}",
+        ]
+        for q in sorted(self.queues):
+            r = self.queues[q]
+            lines.append(
+                f"  queue {q}: fairShare={r.fair_share:.4f} "
+                f"adjustedFairShare={r.adjusted_fair_share:.4f} "
+                f"actualShare={r.actual_share:.4f} "
+                f"scheduled={r.scheduled_jobs} preempted={r.preempted_jobs}"
+            )
+        return "\n".join(lines)
+
+
+class SchedulingReportsRepository:
+    """Most-recent report per pool, per queue, per job
+    (reports/repository.go:18)."""
+
+    def __init__(self, retained_jobs: int = 10_000):
+        import threading
+
+        self.by_pool: dict[str, RoundReport] = {}
+        self._job_reports: dict[str, tuple[float, str]] = {}
+        self._retained = retained_jobs
+        # Written by the scheduler thread, read from gRPC worker threads.
+        self._lock = threading.Lock()
+
+    def record(self, report: RoundReport):
+        with self._lock:
+            self.by_pool[report.pool] = report
+            for job_id, reason in report.job_reasons.items():
+                self._job_reports[job_id] = (report.finished, reason)
+            if len(self._job_reports) > self._retained:
+                oldest = sorted(self._job_reports.items(), key=lambda kv: kv[1][0])
+                for job_id, _ in oldest[: len(oldest) // 2]:
+                    del self._job_reports[job_id]
+
+    def queue_report(self, queue: str) -> str:
+        with self._lock:
+            pools = dict(self.by_pool)
+        parts = []
+        for pool, report in sorted(pools.items()):
+            if queue in report.queues:
+                r = report.queues[queue]
+                parts.append(
+                    f"pool {pool}: fairShare={r.fair_share:.4f} "
+                    f"adjustedFairShare={r.adjusted_fair_share:.4f} "
+                    f"actualShare={r.actual_share:.4f}"
+                )
+        return "\n".join(parts) or f"no reports for queue {queue}"
+
+    def job_report(self, job_id: str) -> str:
+        with self._lock:
+            hit = self._job_reports.get(job_id)
+        if hit is None:
+            return f"no report for job {job_id}"
+        _, reason = hit
+        return reason or "scheduled"
+
+    def scheduling_report(self) -> str:
+        with self._lock:
+            pools = dict(self.by_pool)
+        return "\n\n".join(
+            pools[pool].report_string() for pool in sorted(pools)
+        ) or "no scheduling rounds recorded"

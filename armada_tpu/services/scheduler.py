@@ -66,6 +66,13 @@ class SchedulerService:
         self.is_leader = is_leader
         self.cycle_count = 0
         self.last_cycle_stats: dict = {}
+        from .reports import SchedulingReportsRepository
+
+        self.reports = SchedulingReportsRepository()
+        self.metrics = None  # set via attach_metrics
+
+    def attach_metrics(self, metrics):
+        self.metrics = metrics
 
     # ---- control-plane inputs ----
 
@@ -78,8 +85,19 @@ class SchedulerService:
     # ---- cycle ----
 
     def cycle(self, now: float | None = None) -> list[EventSequence]:
-        """One scheduling cycle; returns the published event sequences."""
-        if not self.is_leader():
+        """One scheduling cycle; returns the published event sequences.
+
+        Leader-token protocol (leaderelection.go token model): the token is
+        captured at cycle start and re-validated immediately before
+        publishing. Losing leadership mid-cycle drops the publish; the new
+        leader re-derives identical events idempotently
+        (scheduler.go:225-233)."""
+        token = None
+        if hasattr(self.is_leader, "get_token"):
+            token = self.is_leader.get_token()
+            if not token.leader:
+                return []
+        elif not self.is_leader():
             return []
         now = _time.time() if now is None else now
         self.ingester.sync()
@@ -102,6 +120,8 @@ class SchedulerService:
                         leased_this_cycle.add(event.job_id)
             sequences += pool_seqs
 
+        if token is not None and not self.is_leader.validate(token):
+            return []  # lost leadership mid-cycle: nothing published
         for seq in sequences:
             self.log.publish(seq)
         self.ingester.sync()  # optimistic immediate apply (same process)
@@ -192,6 +212,7 @@ class SchedulerService:
         snap = build_round_snapshot(
             self.config, pool, nodes, queues, running, queued
         )
+        solve_started = _time.time()
         result = self._solve(snap)
         self.last_cycle_stats = {
             "pool": pool,
@@ -200,6 +221,7 @@ class SchedulerService:
             "scheduled": int(result["scheduled_mask"].sum()),
             "preempted": int(result["preempted_mask"].sum()),
         }
+        self._record_round(pool, snap, result, solve_started)
 
         by_jobset: dict[tuple, list] = {}
         import numpy as np
@@ -246,6 +268,10 @@ class SchedulerService:
                 "scheduled_priority": out["scheduled_priority"][:J],
                 "scheduled_mask": out["scheduled_mask"][:J],
                 "preempted_mask": out["preempted_mask"][:J],
+                "fair_share": out["fair_share"][:Q],
+                "demand_capped_fair_share": out["demand_capped_fair_share"][:Q],
+                "unschedulable_reason": None,
+                "termination_reason": "",
             }
         from ..solver.reference import ReferenceSolver
 
@@ -255,4 +281,77 @@ class SchedulerService:
             "scheduled_priority": res.scheduled_priority,
             "scheduled_mask": res.scheduled_mask,
             "preempted_mask": res.preempted_mask,
+            "fair_share": res.fair_share,
+            "demand_capped_fair_share": res.demand_capped_fair_share,
+            "unschedulable_reason": res.unschedulable_reason,
+            "termination_reason": res.termination_reason,
         }
+
+    def _record_round(self, pool, snap, result, started):
+        import numpy as np
+
+        from ..solver.drf import unweighted_cost
+        from .reports import QueueReport, RoundReport
+
+        finished = _time.time()
+        mult = snap.drf_multipliers()
+        total = snap.total_resources.astype(float)
+        report = RoundReport(
+            pool=pool,
+            started=started,
+            finished=finished,
+            num_jobs=snap.num_jobs,
+            num_nodes=snap.num_nodes,
+            termination_reason=result.get("termination_reason", ""),
+        )
+        sched_by_q = {}
+        preempt_by_q = {}
+        alloc_by_q = np.zeros((snap.num_queues, snap.factory.num_resources))
+        for j in range(snap.num_jobs):
+            q = int(snap.job_queue[j])
+            if q < 0:
+                continue
+            if result["scheduled_mask"][j]:
+                sched_by_q[q] = sched_by_q.get(q, 0) + 1
+            if result["preempted_mask"][j]:
+                preempt_by_q[q] = preempt_by_q.get(q, 0) + 1
+            if result["assigned_node"][j] >= 0:
+                alloc_by_q[q] += snap.job_req[j]
+        actual = unweighted_cost(alloc_by_q, total, mult) if snap.num_queues else []
+        for q, name in enumerate(snap.queue_names):
+            report.queues[name] = QueueReport(
+                queue=name,
+                fair_share=float(result["fair_share"][q]),
+                adjusted_fair_share=float(result["demand_capped_fair_share"][q]),
+                actual_share=float(actual[q]),
+                scheduled_jobs=sched_by_q.get(q, 0),
+                preempted_jobs=preempt_by_q.get(q, 0),
+            )
+        reasons = result.get("unschedulable_reason")
+        if reasons is not None:
+            report.job_reasons = {
+                snap.job_ids[j]: reasons[j]
+                for j in range(snap.num_jobs)
+                if reasons[j]
+            }
+        self.reports.record(report)
+
+        if self.metrics is not None and self.metrics.registry is not None:
+            self.metrics.solve_time.labels(pool=pool).observe(finished - started)
+            self.metrics.considered_jobs.labels(pool=pool).set(snap.num_jobs)
+            for q, name in enumerate(snap.queue_names):
+                self.metrics.fair_share.labels(pool=pool, queue=name).set(
+                    float(result["demand_capped_fair_share"][q])
+                )
+                self.metrics.actual_share.labels(pool=pool, queue=name).set(
+                    float(actual[q])
+                )
+                if sched_by_q.get(q):
+                    self.metrics.scheduled_jobs.labels(pool=pool, queue=name).inc(
+                        sched_by_q[q]
+                    )
+                if preempt_by_q.get(q):
+                    self.metrics.preempted_jobs.labels(pool=pool, queue=name).inc(
+                        preempt_by_q[q]
+                    )
+            self.metrics.event_log_offset.set(self.log.end_offset)

@@ -1,0 +1,112 @@
+"""Single-process control-plane assembly.
+
+Wires the full stack the way the reference's `mage dev:up fake-executor`
+does (server + scheduler + ingesters + fake executors, zero Kubernetes):
+event log, scheduler cycle loop, submission API, query API, reports,
+metrics, gRPC endpoint. One process; every component is the same object the
+distributed deployment uses.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+from ..core.config import SchedulingConfig
+from ..events import InMemoryEventLog
+from .fake_executor import FakeExecutor, make_nodes
+from .grpc_api import ApiServer
+from .leader import StandaloneLeader
+from .metrics import SchedulerMetrics, serve_metrics
+from .queryapi import QueryApi
+from .scheduler import SchedulerService
+from .submit import SubmitService
+from .submit_check import SubmitChecker
+
+
+class ControlPlane:
+    def __init__(
+        self,
+        config: SchedulingConfig | None = None,
+        *,
+        backend: str = "oracle",
+        cycle_period: float = 1.0,
+        grpc_port: int = 0,
+        metrics_port: int | None = None,
+        fake_executors: list[dict] | None = None,
+        enable_submit_check: bool = False,
+    ):
+        self.config = config or SchedulingConfig()
+        self.log = InMemoryEventLog()
+        self.leader = StandaloneLeader()
+        self.scheduler = SchedulerService(
+            self.config, self.log, backend=backend, is_leader=self.leader
+        )
+        self.submit = SubmitService(self.config, self.log, scheduler=self.scheduler)
+        self.query = QueryApi(self.scheduler.jobdb)
+        self.metrics = SchedulerMetrics()
+        self.scheduler.attach_metrics(self.metrics)
+        self.submit_checker = (
+            SubmitChecker(self.config, self.scheduler) if enable_submit_check else None
+        )
+        self.cycle_period = cycle_period
+
+        self.executors: list[FakeExecutor] = []
+        for spec in fake_executors or []:
+            self.executors.append(
+                FakeExecutor(
+                    spec.get("name", f"fake-{len(self.executors)}"),
+                    self.log,
+                    self.scheduler,
+                    nodes=make_nodes(
+                        spec.get("name", f"fake-{len(self.executors)}"),
+                        count=int(spec.get("nodes", 10)),
+                        pool=spec.get("pool", "default"),
+                        cpu=str(spec.get("cpu", "8")),
+                        memory=str(spec.get("memory", "128Gi")),
+                    ),
+                    pool=spec.get("pool", "default"),
+                    runtime_for=lambda job_id, rt=float(spec.get("runtime", 30.0)): rt,
+                )
+            )
+
+        self.api = ApiServer(
+            self.submit, self.scheduler, self.query, self.log, self.submit_checker
+        )
+        self.grpc_server, self.grpc_port = self.api.serve(grpc_port)
+        self.metrics_server = (
+            serve_metrics(self.metrics, metrics_port) if metrics_port else None
+        )
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _loop(self):
+        while not self._stop.is_set():
+            started = _time.time()
+            now = _time.time()
+            for ex in self.executors:
+                ex.tick(now)
+            try:
+                self.scheduler.cycle(now=now)
+            except Exception as e:  # keep the loop alive; next cycle retries
+                print(f"cycle error: {e!r}")
+            if self.metrics.registry is not None:
+                self.metrics.cycle_time.observe(_time.time() - started)
+            self._stop.wait(self.cycle_period)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.grpc_server.stop(grace=0.5)
+        if self.metrics_server:
+            self.metrics_server.shutdown()
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.grpc_port}"

@@ -58,15 +58,22 @@ class SubmitService:
             self.scheduler.upsert_queue(spec)
         return q
 
-    def update_queue(self, spec: QueueSpec, cordoned: bool | None = None) -> Queue:
-        q = self.queues.get(spec.name)
+    def update_queue(
+        self,
+        name: str,
+        priority_factor: float | None = None,
+        cordoned: bool | None = None,
+    ) -> Queue:
+        """Partial update: None leaves a field unchanged."""
+        q = self.queues.get(name)
         if q is None:
-            raise SubmissionError(f"queue {spec.name!r} does not exist")
-        q.spec = spec
+            raise SubmissionError(f"queue {name!r} does not exist")
+        if priority_factor is not None:
+            q.spec = QueueSpec(name, priority_factor)
         if cordoned is not None:
             q.cordoned = cordoned
         if self.scheduler is not None:
-            self.scheduler.upsert_queue(spec)
+            self.scheduler.upsert_queue(q.spec)
         return q
 
     def delete_queue(self, name: str):
