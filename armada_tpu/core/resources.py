@@ -24,6 +24,11 @@ from fractions import Fraction
 
 import numpy as np
 
+try:  # C++ fast path (native/quantity.cpp); exact-Fraction fallback below.
+    import _armada_native as _native
+except ImportError:  # pragma: no cover
+    _native = None
+
 # Binary and decimal suffixes accepted by Kubernetes resource quantities.
 _BINARY = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60}
 _DECIMAL = {
@@ -149,7 +154,37 @@ class ResourceListFactory:
                     raise KeyError(f"unknown resource {name!r}")
                 continue
             scaled = parse_quantity(quantity) / (Fraction(10) ** self.scales[i])
-            out[i] = int(math.ceil(scaled) if ceil else math.floor(scaled))
+            value = int(math.ceil(scaled) if ceil else math.floor(scaled))
+            # Saturate: absurd quantities (e.g. "1Ei" at byte scale) clamp
+            # rather than crash, matching the native parser.
+            out[i] = min(max(value, -(2**63)), 2**63 - 1)
+        return out
+
+    def encode_requests_batch(self, requests: list, *, ceil: bool) -> np.ndarray:
+        """Encode a batch of {name: quantity} dicts into int64[J, R].
+
+        Uses the native C++ parser when built (~100x the Fraction path);
+        results are bit-identical (exact int128 arithmetic, fuzz-tested).
+        """
+        J = len(requests)
+        if _native is not None:
+            try:
+                raw = _native.encode_requests(
+                    list(requests), list(self.names), list(self.scales), ceil
+                )
+                return (
+                    np.frombuffer(raw, dtype=np.int64)
+                    .reshape(J, self.num_resources)
+                    .copy()
+                )
+            except (ValueError, TypeError):
+                # The Fraction path accepts a slightly wider grammar (e.g.
+                # Fraction instances, "1e3Ki"); fall back rather than let
+                # parser strictness depend on whether the extension is built.
+                pass
+        out = np.zeros((J, self.num_resources), dtype=np.int64)
+        for j, req in enumerate(requests):
+            out[j] = self.from_map(req, ceil=ceil)
         return out
 
     def to_map(self, vec: np.ndarray) -> dict[str, Fraction]:

@@ -163,12 +163,13 @@ def build_round_snapshot(
     )
 
     # --- node tensors ---
-    node_total = np.zeros((N, R), dtype=np.int64)
+    node_total = factory.encode_requests_batch(
+        [n.total_resources for n in nodes], ceil=False
+    )
     node_taint_bits = np.zeros((N, taint_vocab.n_words), dtype=np.uint32)
     node_label_bits = np.zeros((N, label_vocab.n_words), dtype=np.uint32)
     node_unschedulable = np.zeros(N, dtype=bool)
     for i, node in enumerate(nodes):
-        node_total[i] = factory.from_map(node.total_resources, ceil=False)
         node_taint_bits[i] = taint_vocab.node_bits(node)
         node_label_bits[i] = label_vocab.node_bits(node)
         node_unschedulable[i] = node.unschedulable
@@ -182,7 +183,7 @@ def build_round_snapshot(
 
     # --- job table ---
     J = len(jobs)
-    job_req = np.zeros((J, R), dtype=np.int64)
+    job_req = factory.encode_requests_batch([j.requests for j in jobs], ceil=True)
     job_tolerated = np.zeros((J, taint_vocab.n_words), dtype=np.uint32)
     job_selector = np.zeros((J, label_vocab.n_words), dtype=np.uint32)
     job_possible = np.ones(J, dtype=bool)
@@ -196,7 +197,6 @@ def build_round_snapshot(
     Q = len(queues)
 
     for j, job in enumerate(jobs):
-        job_req[j] = factory.from_map(job.requests, ceil=True)
         job_tolerated[j] = taint_vocab.tolerated_bits(job.tolerations)
         bits, possible = label_vocab.selector_bits(job.node_selector)
         job_selector[j] = bits

@@ -1,0 +1,22 @@
+"""Build the _armada_native C++ extension in-place:
+
+    cd native && python setup.py build_ext --inplace
+    (or: make -C native)
+
+The built module is copied next to armada_tpu/ so `import _armada_native`
+resolves; armada_tpu.core.resources falls back to the exact-Fraction Python
+path when it is absent.
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    name="armada-tpu-native",
+    ext_modules=[
+        Extension(
+            "_armada_native",
+            sources=["quantity.cpp"],
+            extra_compile_args=["-O3", "-std=c++17"],
+        )
+    ],
+)

@@ -1,0 +1,83 @@
+"""Native C++ quantity parser: bit-exact equivalence with the Fraction path,
+fuzzed over the full k8s quantity grammar."""
+
+import math
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.resources import ResourceListFactory, parse_quantity
+
+native = pytest.importorskip("_armada_native")
+
+
+def py_scale(value, scale: int, ceil: bool) -> int:
+    scaled = parse_quantity(value) / (Fraction(10) ** scale)
+    value = int(math.ceil(scaled) if ceil else math.floor(scaled))
+    return min(max(value, -(2**63)), 2**63 - 1)  # saturating, like native
+
+
+SAMPLES = [
+    "0", "1", "42", "100m", "1500m", "0.5", "0.0001", "2.75",
+    "1Ki", "2Mi", "1.5Gi", "3Ti", "7Pi", "1Ei",
+    "1k", "250M", "3G", "2T", "1P", "5E",
+    "2e3", "1e-3", "2.5e2", "1E3", "5e0",
+    "123456789", "999999999999", "0.001", "16Gi", "128Gi", "100Mi",
+    7, 1000, 0.25, 3.5, "-5", "-100m", "  8  ",
+]
+
+
+@pytest.mark.parametrize("scale", [-3, 0, 3, 8])
+@pytest.mark.parametrize("ceil", [True, False])
+def test_samples_match_fraction_path(scale, ceil):
+    for value in SAMPLES:
+        expected = py_scale(value, scale, ceil)
+        got = native.parse_quantity(value, scale, ceil)
+        assert got == expected, (value, scale, ceil, got, expected)
+
+
+def test_fuzz_random_quantities():
+    rng = np.random.default_rng(0)
+    suffixes = ["", "m", "k", "M", "G", "Ki", "Mi", "Gi", "Ti", "n", "u"]
+    for _ in range(3000):
+        mant = rng.integers(0, 10**9)
+        frac = rng.integers(0, 1000)
+        suffix = suffixes[rng.integers(0, len(suffixes))]
+        s = f"{mant}.{frac:03d}{suffix}" if rng.random() < 0.5 else f"{mant}{suffix}"
+        scale = int(rng.choice([-3, 0, 3]))
+        ceil = bool(rng.random() < 0.5)
+        assert native.parse_quantity(s, scale, ceil) == py_scale(s, scale, ceil), s
+
+
+def test_invalid_inputs_raise():
+    for bad in ["", "abc", "1.2.3", "12X", "e3", "--1"]:
+        with pytest.raises(ValueError):
+            native.parse_quantity(bad, 0, True)
+
+
+def test_batch_and_encode_requests():
+    f = ResourceListFactory.create(
+        [("memory", "1"), ("cpu", "1m"), ("nvidia.com/gpu", "1")]
+    )
+    reqs = [
+        {"cpu": "2", "memory": "4Gi"},
+        {"cpu": "500m", "memory": "1.5Gi", "nvidia.com/gpu": "1"},
+        {},
+        {"unknown/thing": "7", "cpu": "1"},
+    ]
+    got = f.encode_requests_batch(reqs, ceil=True)
+    expected = np.stack([f.from_map(r, ceil=True) for r in reqs])
+    assert (got == expected).all()
+
+
+def test_batch_speed_sanity():
+    import time
+
+    f = ResourceListFactory.create([("memory", "1"), ("cpu", "1m")])
+    reqs = [{"cpu": "1500m", "memory": "16Gi"}] * 50_000
+    t0 = time.time()
+    f.encode_requests_batch(reqs, ceil=True)
+    native_t = time.time() - t0
+    # 50k jobs in well under a second (the Fraction path takes ~5s)
+    assert native_t < 1.0, f"native batch too slow: {native_t:.2f}s"
