@@ -115,6 +115,18 @@ def cmd_jobs(args):
     _print(client.get_jobs(filters=filters, take=args.take))
 
 
+def cmd_logs(args):
+    client = connect(args.server)
+    for line in client.get_job_logs(args.job_id, args.tail):
+        print(line)
+
+
+def cmd_cordon(args):
+    client = connect(args.server)
+    client.cordon_node(args.node_id, uncordon=args.action == "uncordon")
+    print(f"{args.action}ed {args.node_id}")
+
+
 def cmd_report(args):
     client = connect(args.server)
     if args.kind == "scheduling":
@@ -213,6 +225,16 @@ def build_parser():
     j.add_argument("--state")
     j.add_argument("--take", type=int, default=100)
     j.set_defaults(fn=cmd_jobs)
+
+    lg = sub.add_parser("logs", help="stream job logs (binoculars)")
+    lg.add_argument("job_id")
+    lg.add_argument("--tail", type=int, default=100)
+    lg.set_defaults(fn=cmd_logs)
+
+    cd = sub.add_parser("node", help="cordon/uncordon a node")
+    cd.add_argument("action", choices=["cordon", "uncordon"])
+    cd.add_argument("node_id")
+    cd.set_defaults(fn=cmd_cordon)
 
     rep = sub.add_parser("report")
     rep.add_argument("kind", choices=["scheduling", "queue", "job"])

@@ -96,6 +96,8 @@ class ResourceListFactory:
     names: tuple[str, ...]
     scales: tuple[int, ...]  # power-of-ten per resource (host encoding)
     device_divisor: tuple[int, ...]  # host units per device unit (int32 lanes)
+    # True for pool-level floating resources (not attached to nodes).
+    floating: tuple[bool, ...] = ()
     name_to_index: dict[str, int] = field(default_factory=dict)
 
     @staticmethod
@@ -107,11 +109,14 @@ class ResourceListFactory:
         """supported/floating: [(name, resolution)], mirroring
         supportedResourceTypes + floatingResourceTypes config."""
         names, scales = [], []
-        for name, resolution in list(supported) + list(floating):
+        floating = list(floating)
+        floating_flags = []
+        for name, resolution in list(supported) + floating:
             if name in names:
                 raise ValueError(f"duplicate resource type {name!r}")
             names.append(name)
             scales.append(_resolution_to_scale(resolution))
+            floating_flags.append(len(floating_flags) >= len(supported))
         divisors = []
         device_divisors = device_divisors or {}
         for name, scale in zip(names, scales):
@@ -125,9 +130,13 @@ class ResourceListFactory:
             names=tuple(names),
             scales=tuple(scales),
             device_divisor=tuple(divisors),
+            floating=tuple(floating_flags),
         )
         factory.name_to_index.update({n: i for i, n in enumerate(names)})
         return factory
+
+    def floating_mask(self) -> np.ndarray:
+        return np.asarray(self.floating, dtype=bool)
 
     @property
     def num_resources(self) -> int:

@@ -111,6 +111,29 @@ class FakeExecutor:
                 finishes_at=now + self.startup_delay + runtime,
             )
 
+    # ---- binoculars surface (logs + cordon) ----
+
+    def get_logs(self, job_id: str, tail_lines: int = 100) -> list[str]:
+        """Synthesized pod logs for runs this executor has seen."""
+        for run in list(self.active.values()):
+            if run.job_id == job_id:
+                lines = [
+                    f"[{self.name}] starting job {job_id} (run {run.run_id})",
+                    f"[{self.name}] job {job_id} running since t={run.started:.1f}",
+                ]
+                return lines[-tail_lines:]
+        return [f"[{self.name}] no active run for {job_id} (finished or pending)"]
+
+    def cordon(self, node_id: str, cordoned: bool) -> bool:
+        """Mark a node unschedulable; reflected in the next heartbeat."""
+        from dataclasses import replace
+
+        for i, node in enumerate(self.nodes):
+            if node.id == node_id:
+                self.nodes[i] = replace(node, unschedulable=cordoned)
+                return True
+        return False
+
     def tick(self, now: float):
         """Advance pod lifecycle; emit state-transition events."""
         self.heartbeat(now)

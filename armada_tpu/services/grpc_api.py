@@ -76,12 +76,15 @@ def job_spec_from_dict(d: dict) -> JobSpec:
 class ApiServer:
     """Hosts submit/query/events/reports over one gRPC server."""
 
-    def __init__(self, submit, scheduler, query, log, submit_checker=None):
+    def __init__(
+        self, submit, scheduler, query, log, submit_checker=None, binoculars=None
+    ):
         self.submit = submit
         self.scheduler = scheduler
         self.query = query
         self.log = log
         self.submit_checker = submit_checker
+        self.binoculars = binoculars
 
     # ---- unary handlers ----
 
@@ -188,6 +191,24 @@ class ApiServer:
     def _job_report(self, req):
         return {"report": self.scheduler.reports.job_report(req["job_id"])}
 
+    def _get_logs(self, req):
+        if self.binoculars is None:
+            raise KeyError("binoculars not enabled")
+        return {
+            "lines": self.binoculars.get_logs(
+                req["job_id"], int(req.get("tail_lines", 100))
+            )
+        }
+
+    def _cordon_node(self, req):
+        if self.binoculars is None:
+            raise KeyError("binoculars not enabled")
+        if req.get("uncordon"):
+            self.binoculars.uncordon_node(req["node_id"])
+        else:
+            self.binoculars.cordon_node(req["node_id"])
+        return {}
+
     # ---- streaming ----
 
     def _watch_jobset(self, req, context):
@@ -242,6 +263,8 @@ class ApiServer:
             "SchedulingReport": self._scheduling_report,
             "QueueReport": self._queue_report,
             "JobReport": self._job_report,
+            "GetJobLogs": self._get_logs,
+            "CordonNode": self._cordon_node,
         }
 
     def serve(self, port: int = 0, max_workers: int = 8):
@@ -380,6 +403,14 @@ class ApiClient:
 
     def job_report(self, job_id):
         return self._call("JobReport", {"job_id": job_id})["report"]
+
+    def get_job_logs(self, job_id, tail_lines=100):
+        return self._call("GetJobLogs", {"job_id": job_id, "tail_lines": tail_lines})[
+            "lines"
+        ]
+
+    def cordon_node(self, node_id, uncordon=False):
+        self._call("CordonNode", {"node_id": node_id, "uncordon": uncordon})
 
     def watch_jobset(self, queue, jobset, from_offset=0, watch=True):
         fn = self.channel.unary_stream(

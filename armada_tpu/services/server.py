@@ -70,8 +70,16 @@ class ControlPlane:
                 )
             )
 
+        from .binoculars import BinocularsService
+
+        self.binoculars = BinocularsService(self.scheduler, self.executors)
         self.api = ApiServer(
-            self.submit, self.scheduler, self.query, self.log, self.submit_checker
+            self.submit,
+            self.scheduler,
+            self.query,
+            self.log,
+            self.submit_checker,
+            binoculars=self.binoculars,
         )
         self.grpc_server, self.grpc_port = self.api.serve(grpc_port)
         self.metrics_server = (

@@ -96,7 +96,12 @@ class RoundSnapshot:
     label_vocab: LabelVocab
 
     # --- totals ---
-    total_resources: np.ndarray  # int64[R] sum over nodes (+floating later)
+    total_resources: np.ndarray  # int64[R] node sums + floating pool totals
+    # Pool-level floating resources (docs/floating_resources.md): capped
+    # per pool, not present on nodes. Node columns for these resources are
+    # a large sentinel so node-fit checks ignore them.
+    floating_mask: np.ndarray  # bool[R]
+    floating_total: np.ndarray  # int64[R] (zero on non-floating columns)
 
     @property
     def num_nodes(self) -> int:
@@ -124,6 +129,11 @@ class RoundSnapshot:
         if idx >= len(self.priorities) or self.priorities[idx] != priority:
             raise KeyError(f"priority {priority} not in {self.priorities}")
         return int(idx)
+
+    def job_req_fit(self) -> np.ndarray:
+        """Requests for node-fit arithmetic: floating columns zeroed (those
+        are pool-level, never exchanged with node allocatable)."""
+        return np.where(self.floating_mask[None, :], 0, self.job_req)
 
     def drf_multipliers(self) -> np.ndarray:
         """float64[R] fairness multiplier per resource (0 = ignored)."""
@@ -166,6 +176,20 @@ def build_round_snapshot(
     node_total = factory.encode_requests_batch(
         [n.total_resources for n in nodes], ceil=False
     )
+    # Floating resources are not node resources: node-fit arithmetic uses
+    # requests with floating columns zeroed (job_req_fit), so node tensors
+    # never carry or exchange floating quantities; the pool-level cap is
+    # enforced by the solver's floating check.
+    floating_mask = factory.floating_mask()
+    if floating_mask.any():
+        node_total[:, floating_mask] = 0
+    floating_total = np.zeros(R, dtype=np.int64)
+    for fr in config.floating_resources:
+        i = factory.name_to_index.get(fr.name)
+        if i is None:
+            continue
+        qty = fr.pools.get(pool, {}).get(fr.name, 0)
+        floating_total[i] = factory.from_map({fr.name: qty}, ceil=False)[i]
     node_taint_bits = np.zeros((N, taint_vocab.n_words), dtype=np.uint32)
     node_label_bits = np.zeros((N, label_vocab.n_words), dtype=np.uint32)
     node_unschedulable = np.zeros(N, dtype=bool)
@@ -225,6 +249,7 @@ def build_round_snapshot(
     # Non-preemptible jobs are deducted at every priority row
     # (priorityCutoffFor, nodedb.go:1017-1032): neither evictor will remove
     # them, so higher-priority jobs must not over-pack past them.
+    req_fit = np.where(floating_mask[None, :], 0, job_req)
     for j, run in enumerate(running):
         n = job_node[j]
         if n >= 0:
@@ -232,7 +257,7 @@ def build_round_snapshot(
                 rows = priorities <= job_priority[j]
             else:
                 rows = np.ones(P, dtype=bool)
-            allocatable[rows, n, :] -= job_req[j]
+            allocatable[rows, n, :] -= req_fit[j]
 
     # --- queue accounting ---
     queue_weight = np.asarray([q.weight for q in queues], dtype=np.float64)
@@ -348,5 +373,9 @@ def build_round_snapshot(
         gang_uniformity_key=gang_uniformity_key,
         taint_vocab=taint_vocab,
         label_vocab=label_vocab,
-        total_resources=node_total.sum(axis=0),
+        total_resources=np.where(
+            floating_mask, floating_total, node_total.sum(axis=0)
+        ),
+        floating_mask=floating_mask,
+        floating_total=floating_total,
     )

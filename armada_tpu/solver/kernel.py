@@ -72,6 +72,7 @@ class Carry(NamedTuple):
     tokens: jax.Array  # float
     qtokens: jax.Array  # float[Q]
     scheduled_new: jax.Array  # float[R]
+    floating: jax.Array  # float[R] pool floating-resource allocation
     stop: jax.Array  # bool
     loops: jax.Array  # int32
 
@@ -134,13 +135,13 @@ def _static_ok(dev, j):
     tolerated = dev.job_tolerated[j]
     taints_ok = jnp.all((dev.node_taints & ~tolerated) == 0, axis=-1)
     sel_ok = bits_subset(dev.job_selector[j], dev.node_labels)
-    total_ok = jnp.all(dev.job_req[j] <= dev.node_total, axis=-1)
+    total_ok = jnp.all(dev.job_req_fit[j] <= dev.node_total, axis=-1)
     return taints_ok & sel_ok & total_ok & ~dev.node_unschedulable & dev.job_possible[j]
 
 
 def _select_at_row(dev, alloc, j, row, static_ok):
     """First-fit in best-fit order at one priority row (nodedb.go:713-752)."""
-    dyn = jnp.all(dev.job_req[j] <= alloc[row], axis=-1)
+    dyn = jnp.all(dev.job_req_fit[j] <= alloc[row], axis=-1)
     mask = static_ok & dyn
     keys = []
     for k in range(dev.order_res_idx.shape[0]):
@@ -166,7 +167,7 @@ def _fair_preemption(dev, carry, j, static_ok):
     order = jnp.lexsort((BIG - rank, node_key))
     n_sorted = node[order]
     a_sorted = active[order]
-    contrib = jnp.where(a_sorted[:, None], dev.job_req[order], 0).astype(
+    contrib = jnp.where(a_sorted[:, None], dev.job_req_fit[order], 0).astype(
         jnp.result_type(int)
     )
     c = jnp.cumsum(contrib, axis=0)
@@ -183,7 +184,7 @@ def _fair_preemption(dev, carry, j, static_ok):
     avail = carry.alloc[0, safe_node].astype(jnp.result_type(int)) + cwithin
     feasible = (
         a_sorted
-        & jnp.all(avail >= dev.job_req[j], axis=-1)
+        & jnp.all(avail >= dev.job_req_fit[j], axis=-1)
         & static_ok[safe_node]
     )
     rank_sorted = rank[order]
@@ -192,7 +193,7 @@ def _fair_preemption(dev, carry, j, static_ok):
     sel_rank = rank_sorted[idx]
     consumed = active & (node == sel_node) & (rank >= sel_rank) & found
     freed = jnp.sum(
-        jnp.where(consumed[:, None], dev.job_req, 0), axis=0
+        jnp.where(consumed[:, None], dev.job_req_fit, 0), axis=0
     ).astype(carry.alloc.dtype)
     new_alloc = carry.alloc.at[0, sel_node].add(jnp.where(found, freed, 0))
     new_rank = jnp.where(consumed, -2, rank)
@@ -213,7 +214,7 @@ def _select_node(dev, carry, j):
     home = carry.job_node[j]
     safe_home = jnp.clip(home, 0, alloc.shape[1] - 1)
     over_alloc = jnp.any(alloc[:, safe_home] < 0)
-    home_fit = jnp.all(dev.job_req[j] <= alloc[row_p, safe_home]) | (
+    home_fit = jnp.all(dev.job_req_fit[j] <= alloc[row_p, safe_home]) | (
         dev.node_unschedulable[safe_home] & over_alloc
     )
 
@@ -285,11 +286,11 @@ def _bind(dev, carry: Carry, j, n, at_prio) -> Carry:
     rows = jnp.where(
         preemptible, dev.priorities <= at_prio, jnp.ones_like(dev.priorities, bool)
     )
-    delta = jnp.where(rows[:, None], dev.job_req[j], 0).astype(carry.alloc.dtype)
+    delta = jnp.where(rows[:, None], dev.job_req_fit[j], 0).astype(carry.alloc.dtype)
     alloc = carry.alloc.at[:, n].add(-delta)
     was_evicted = carry.job_evicted[j]
     alloc = alloc.at[0, n].add(
-        jnp.where(was_evicted, dev.job_req[j], 0).astype(carry.alloc.dtype)
+        jnp.where(was_evicted, dev.job_req_fit[j], 0).astype(carry.alloc.dtype)
     )
     return carry._replace(
         alloc=alloc,
@@ -340,6 +341,15 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
         ),
     )
     blocked_code = jnp.where(all_ev, OK, blocked_code)
+    # Floating-resource pool caps apply to every gang, evicted included
+    # (IsWithinFloatingResourceLimits, gang_scheduler.go:144).
+    floating_over = jnp.any(
+        dev.floating_mask
+        & (carry.floating + _f(dev.slot_req[s]) > dev.floating_total)
+    )
+    blocked_code = jnp.where(
+        (blocked_code == OK) & floating_over, FAIL, blocked_code
+    )
 
     # Member-by-member placement.
     M = dev.slot_members.shape[1]
@@ -358,8 +368,10 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
         )
         return c, ok & (found | ~live)
 
+    # Dynamic trip count: singleton slots (the common case) pay for one
+    # member even when the batch contains wide gangs.
     attempted, ok = jax.lax.fori_loop(
-        0, M, member_body, (carry, blocked_code == OK)
+        0, dev.slot_count[s], member_body, (carry, blocked_code == OK)
     )
 
     # Commit or roll back (functional txn).
@@ -382,6 +394,11 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
     scheduled_new = jnp.where(
         ok & ~all_ev, new_carry.scheduled_new + req, new_carry.scheduled_new
     )
+    floating = jnp.where(
+        ok,
+        new_carry.floating + jnp.where(dev.floating_mask, req, 0.0),
+        new_carry.floating,
+    )
     # Member placement failures are gang-property reasons (JobDoesNotFit /
     # GangDoesNotFit, constraints.go:59-61).
     fail_code = jnp.where(blocked_code != OK, blocked_code, FAIL_GANG_PROPERTY)
@@ -392,6 +409,7 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
         tokens=tokens,
         qtokens=qtokens,
         scheduled_new=scheduled_new,
+        floating=floating,
         slot_state=new_carry.slot_state.at[s].set(
             jnp.where(ok, DONE, FAILED).astype(jnp.int8)
         ),
@@ -553,7 +571,7 @@ def _apply_evictions(dev, carry: Carry, evict_mask):
             True,
         )
         contrib = jnp.where(
-            (evict_mask & in_rows)[:, None], req, 0
+            (evict_mask & in_rows)[:, None], dev.job_req_fit, 0
         ).astype(alloc.dtype)
         add = jax.ops.segment_sum(contrib, node, num_segments=N)
         alloc = alloc.at[r].add(add)
@@ -573,10 +591,17 @@ def _apply_evictions(dev, carry: Carry, evict_mask):
         pc_seg,
         num_segments=dev.queue_weight.shape[0] * C,
     ).reshape(carry.qpc_alloc.shape)
+    floating_sub = jnp.sum(
+        jnp.where(
+            (evict_mask[:, None] & dev.floating_mask[None, :]), _f(req), 0.0
+        ),
+        axis=0,
+    )
     return carry._replace(
         alloc=alloc,
         qalloc=qalloc,
         qpc_alloc=carry.qpc_alloc - qpc_sub,
+        floating=carry.floating - floating_sub,
         job_evicted=carry.job_evicted | evict_mask,
     )
 
@@ -717,6 +742,15 @@ def solve_impl(dev: DeviceRound):
         tokens=jnp.asarray(dev.global_tokens, fdt),
         qtokens=_f(dev.queue_tokens),
         scheduled_new=jnp.zeros(R, fdt),
+        floating=jnp.sum(
+            jnp.where(
+                (dev.job_is_running & (dev.job_node >= 0))[:, None]
+                & dev.floating_mask[None, :],
+                _f(dev.job_req),
+                0.0,
+            ),
+            axis=0,
+        ),
         stop=jnp.zeros((), bool),
         loops=jnp.zeros((), jnp.int32),
     )

@@ -61,7 +61,8 @@ class DeviceRound:
     order_res_resolution: np.ndarray  # int32[K]
 
     # jobs
-    job_req: np.ndarray  # int32[J, R]
+    job_req: np.ndarray  # int32[J, R] full requests (costs, accounting)
+    job_req_fit: np.ndarray  # int32[J, R] floating columns zeroed (node fit)
     job_tolerated: np.ndarray  # uint32[J, Wt]
     job_selector: np.ndarray  # uint32[J, Wl]
     job_possible: np.ndarray  # bool[J]
@@ -99,6 +100,8 @@ class DeviceRound:
     total_resources: np.ndarray  # float[R]
     drf_multipliers: np.ndarray  # float[R]
     max_round_resources: np.ndarray  # float[R]
+    floating_mask: np.ndarray  # bool[R]
+    floating_total: np.ndarray  # float[R] pool caps (device units)
 
     # scalars (static or runtime)
     protected_fraction: float
@@ -161,6 +164,7 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         ),
         node_unschedulable=pad(dev.node_unschedulable, 0, Np, fill=True),
         job_req=pad(dev.job_req, 0, Jp),
+        job_req_fit=pad(dev.job_req_fit, 0, Jp),
         job_tolerated=pad(dev.job_tolerated, 0, Jp),
         job_selector=pad(dev.job_selector, 0, Jp),
         job_possible=pad(dev.job_possible, 0, Jp, fill=False),
@@ -200,6 +204,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     P = snap.num_priorities
 
     req_dev = factory.to_device(snap.job_req, ceil=True)
+    req_fit_dev = factory.to_device(snap.job_req_fit(), ceil=True)
     alloc_dev = factory.to_device(snap.allocatable, ceil=False)
     total_dev = factory.to_device(snap.node_total, ceil=False)
 
@@ -326,7 +331,10 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         queue_demand_pc[q, job_pc[j]] += req_dev[j]
 
     queue_pc_limit = np.full((Q, C, R), np.inf)
-    total_dev_sum = total_dev.astype(np.float64).sum(axis=0)
+    # Canonical pool totals in device units (floating columns = pool caps,
+    # not node sums) — shared by DRF, per-queue caps and round limits.
+    div = np.asarray(factory.device_divisor, dtype=np.float64)
+    total_dev_sum = snap.total_resources.astype(np.float64) / div
     for ci, name in enumerate(pc_names):
         pc = cfg.priority_classes[name]
         fractions = dict(pc.maximum_resource_fraction_per_queue)
@@ -341,6 +349,11 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         ri = factory.name_to_index.get(rname)
         if ri is not None:
             max_round[ri] = frac * total_dev_sum[ri]
+
+    floating_mask = snap.floating_mask
+    floating_total_dev = np.where(
+        floating_mask, snap.floating_total.astype(np.float64) / div, 0.0
+    )
 
     # Candidate-order resolutions in device units.
     order_res = []
@@ -363,6 +376,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         order_res_idx=snap.order_res_idx.astype(np.int32),
         order_res_resolution=np.asarray(order_res, dtype=np.int32),
         job_req=req_dev,
+        job_req_fit=req_fit_dev,
         job_tolerated=snap.job_tolerated,
         job_selector=snap.job_selector,
         job_possible=snap.job_possible,
@@ -389,9 +403,11 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         queue_pc_limit=queue_pc_limit,
         pc_priority=pc_priority,
         pc_preemptible=pc_preemptible,
-        total_resources=total_dev.astype(np.float64).sum(axis=0),
+        total_resources=total_dev_sum,
         drf_multipliers=mult,
         max_round_resources=max_round,
+        floating_mask=floating_mask,
+        floating_total=floating_total_dev,
         protected_fraction=cfg.protected_fraction_of_fair_share,
         max_lookback=cfg.max_queue_lookback,
         global_burst=limits.maximum_scheduling_burst,

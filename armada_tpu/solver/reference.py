@@ -49,6 +49,7 @@ R_QUEUE_RATE_LIMIT_GANG = "gang would exceed queue scheduling rate limit"
 R_GANG_NO_FIT = "unable to schedule gang since minimum cardinality not met"
 R_JOB_NO_FIT = "job does not fit on any node"
 R_QUEUE_LIMIT = "resource limit exceeded"
+R_FLOATING = "not enough floating resources available"
 
 
 def is_terminal(reason: str) -> bool:
@@ -89,6 +90,8 @@ class ReferenceSolver:
         queue_tokens: np.ndarray | None = None,
     ):
         self.snap = snap
+        # Floating columns zeroed for all node-fit / node-accounting math.
+        self.req_fit = snap.job_req_fit()
         cfg = snap.config
         self.protected_fraction = cfg.protected_fraction_of_fair_share
         self.max_lookback = cfg.max_queue_lookback
@@ -160,6 +163,13 @@ class ReferenceSolver:
         self.scheduled: set[int] = set()  # newly scheduled queued jobs
         self.rescheduled: set[int] = set()  # evicted-this-round, returned
         self.scheduled_new = np.zeros(snap.factory.num_resources, dtype=np.int64)
+        # Pool-level floating-resource allocation (bound jobs only).
+        self.pool_floating = np.zeros(snap.factory.num_resources, dtype=np.int64)
+        for j in range(snap.num_jobs):
+            if snap.job_is_running[j] and snap.job_node[j] >= 0:
+                self.pool_floating += np.where(
+                    snap.floating_mask, snap.job_req[j], 0
+                )
         self.unfeasible_keys: dict = {}
         self.job_reason = [""] * snap.num_jobs
         self.termination_reason = ""
@@ -178,6 +188,7 @@ class ReferenceSolver:
             set(self.scheduled),
             set(self.rescheduled),
             self.scheduled_new.copy(),
+            self.pool_floating.copy(),
             self.global_tokens,
             self.queue_tokens.copy(),
         )
@@ -195,6 +206,7 @@ class ReferenceSolver:
             self.scheduled,
             self.rescheduled,
             self.scheduled_new,
+            self.pool_floating,
             self.global_tokens,
             self.queue_tokens,
         ) = cp
@@ -217,10 +229,10 @@ class ReferenceSolver:
             required = required | extra_sel
         if (required & ~snap.node_label_bits[n]).any():
             return False
-        return bool((snap.job_req[j] <= snap.node_total[n]).all())
+        return bool((self.req_fit[j] <= snap.node_total[n]).all())
 
     def _dynamic_fit(self, j: int, n: int, row: int) -> bool:
-        return bool((self.snap.job_req[j] <= self.alloc[row, n]).all())
+        return bool((self.req_fit[j] <= self.alloc[row, n]).all())
 
     def _candidate_order(self, row: int) -> np.ndarray:
         """Best-fit order: ascending rounded allocatable at this priority over
@@ -306,9 +318,9 @@ class ReferenceSolver:
             if n not in avail:
                 avail[n] = self.alloc[0, n].copy()
                 pending[n] = []
-            avail[n] = avail[n] + snap.job_req[e]
+            avail[n] = avail[n] + self.req_fit[e]
             pending[n].append(e)
-            if not (snap.job_req[j] <= avail[n]).all():
+            if not (self.req_fit[j] <= avail[n]).all():
                 continue
             if not self._static_fit(j, n, extra_sel):
                 static_unmet.add(n)
@@ -316,7 +328,7 @@ class ReferenceSolver:
             # Permanently unbind the consumed evicted jobs: they can no
             # longer be re-scheduled (their home-node capacity is gone).
             for e2 in pending[n]:
-                self.alloc[0, n] += snap.job_req[e2]
+                self.alloc[0, n] += self.req_fit[e2]
                 del self.evict_index[e2]
                 max_priority = max(max_priority, int(self.sched_prio[e2]))
             return n, max_priority
@@ -335,10 +347,10 @@ class ReferenceSolver:
         snap = self.snap
         was_evicted = j in self.evicted
         rows = self._cutoff_rows(j, at_priority)
-        self.alloc[rows, n] -= snap.job_req[j]
+        self.alloc[rows, n] -= self.req_fit[j]
         if was_evicted:
             # The evicted job's own usage was still counted at EvictedPriority.
-            self.alloc[0, n] += snap.job_req[j]
+            self.alloc[0, n] += self.req_fit[j]
             self.evicted.discard(j)
             self.evict_index.pop(j, None)
         self.sched_prio[j] = at_priority
@@ -353,9 +365,10 @@ class ReferenceSolver:
         n = int(self.assigned_node[j])
         prio = int(self.sched_prio[j])
         rows = self._cutoff_rows(j, prio) & (snap.priorities > EVICTED_PRIORITY)
-        self.alloc[rows, n] += snap.job_req[j]
+        self.alloc[rows, n] += self.req_fit[j]
         self.evicted.add(j)
         self.extra_tolerated[j] = self.extra_tolerated[j] | snap.node_taint_bits[n]
+        self.pool_floating -= np.where(snap.floating_mask, snap.job_req[j], 0)
         q = int(snap.job_queue[j])
         if q >= 0:
             self.queue_alloc[q] -= snap.job_req[j]
@@ -757,6 +770,16 @@ class ReferenceSolver:
                 if np.any(np.asarray(allocated) > limit):
                     return self._fail(members, R_QUEUE_LIMIT)
 
+        # Floating-resource pool caps (IsWithinFloatingResourceLimits,
+        # gang_scheduler.go:144; applies to evicted gangs too).
+        if snap.floating_mask.any():
+            gang_req = snap.job_req[members].sum(axis=0)
+            over = snap.floating_mask & (
+                self.pool_floating + gang_req > snap.floating_total
+            )
+            if over.any():
+                return self._fail(members, R_FLOATING)
+
         ok, reason = self._try_schedule(members, all_evicted)
         if ok:
             if not all_evicted:
@@ -764,6 +787,7 @@ class ReferenceSolver:
                 self.queue_tokens[q] -= card
             for j in members:
                 was_evicted_round = j in self.rescheduled
+                self.pool_floating += np.where(snap.floating_mask, snap.job_req[j], 0)
                 self.queue_alloc[q] += snap.job_req[j]
                 key = (q, self.job_pc_name[j])
                 self.queue_pc_alloc[key] = (

@@ -1,0 +1,3 @@
+from .runner import TestSpec, TestSuiteRunner, run_spec_file
+
+__all__ = ["TestSpec", "TestSuiteRunner", "run_spec_file"]

@@ -130,6 +130,30 @@ def test_submit_checker_rejects_impossible():
         p.stop()
 
 
+def test_binoculars_logs_and_cordon(client, plane):
+    client.create_queue("bino")
+    ids = client.submit_jobs("bino", "set-b", [dict(JOB)])
+
+    def running():
+        j = plane.scheduler.jobdb.get(ids[0])
+        return j is not None and j.state.value == "running"
+
+    assert _wait(running)
+    lines = client.get_job_logs(ids[0])
+    assert lines and "fake-a" in lines[0]
+    # cordon the node the job runs on; next heartbeats mark it unschedulable
+    node_id = plane.scheduler.jobdb.get(ids[0]).latest_run.node_id
+    client.cordon_node(node_id)
+    assert _wait(
+        lambda: any(
+            n.id == node_id and n.unschedulable
+            for hb in plane.scheduler.executors.values()
+            for n in hb.nodes
+        )
+    )
+    client.cordon_node(node_id, uncordon=True)
+
+
 def test_file_lease_leader(tmp_path):
     path = str(tmp_path / "lease")
     a = FileLeaseLeader(path, lease_duration=0.5, identity="a")

@@ -1,0 +1,107 @@
+"""The declarative testsuite runner + simulator CLI + load tester, driven
+against a local ControlPlane over gRPC."""
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.services.grpc_api import ApiClient
+from armada_tpu.services.server import ControlPlane
+
+
+@pytest.fixture(scope="module")
+def plane():
+    p = ControlPlane(
+        SchedulingConfig(),
+        cycle_period=0.05,
+        fake_executors=[{"name": "ts-exec", "nodes": 4, "cpu": "16", "runtime": 1.0}],
+    ).start()
+    yield p
+    p.stop()
+
+
+def test_testsuite_basic_and_gang(plane):
+    from armada_tpu.testsuite import run_spec_file
+
+    client = ApiClient(plane.address)
+    for case in ("testsuite_cases/basic.yaml", "testsuite_cases/gang.yaml"):
+        res = run_spec_file(case, client)
+        assert res.passed, f"{res.name}: {res.reason}"
+
+
+def test_testsuite_detects_failure(plane, tmp_path):
+    from armada_tpu.testsuite import run_spec_file
+
+    spec = tmp_path / "impossible.yaml"
+    spec.write_text(
+        """
+name: impossible
+timeout: 3
+queue: ts-imp
+jobs:
+  - count: 1
+    requests: {cpu: "999", memory: 1Gi}
+expectedEvents:
+  - JobRunLeased
+"""
+    )
+    res = run_spec_file(str(spec), ApiClient(plane.address))
+    assert not res.passed
+    assert "timeout" in res.reason
+
+
+def test_load_tester(plane, capsys):
+    from armada_tpu.clients.load_tester import main
+
+    rc = main(
+        [
+            "--server",
+            plane.address,
+            "--queues",
+            "2",
+            "--jobs",
+            "20",
+            "--batch",
+            "10",
+            "--watch",
+            "--timeout",
+            "60",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"completed": 20' in out
+
+
+def test_simulator_cli(tmp_path, capsys):
+    from armada_tpu.sim.cli import main
+
+    cluster = tmp_path / "cluster.yaml"
+    cluster.write_text(
+        """
+name: c1
+nodeTemplates:
+  - count: 4
+    cpu: "16"
+    memory: 64Gi
+"""
+    )
+    workload = tmp_path / "workload.yaml"
+    workload.write_text(
+        """
+queues:
+  - name: qa
+    jobTemplates:
+      - id: t
+        number: 20
+        cpu: "1"
+        memory: 1Gi
+        runtimeMinimum: 30
+"""
+    )
+    rc = main(["--clusters", str(cluster), "--workload", str(workload), "--json"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import json
+
+    res = json.loads(out.strip().splitlines()[-1])
+    assert res["finished_jobs"] == 20
