@@ -360,12 +360,12 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
         live = (m < dev.slot_count[s]) & ok
         safe_j = jnp.clip(j, 0, dev.job_req.shape[0] - 1)
         node, found, _, new_alloc, new_rank = _select_node(dev, c, safe_j)
-        do = live & found
-        c2 = c._replace(alloc=new_alloc, evict_rank=new_rank)
-        c2 = _bind(dev, c2, safe_j, node, c2.job_prio[safe_j])
-        c = jax.tree_util.tree_map(
-            lambda a, b: jnp.where(do, b, a), c, c2
-        )
+
+        def do_bind(c):
+            c2 = c._replace(alloc=new_alloc, evict_rank=new_rank)
+            return _bind(dev, c2, safe_j, node, c2.job_prio[safe_j])
+
+        c = jax.lax.cond(live & found, do_bind, lambda c: c, c)
         return c, ok & (found | ~live)
 
     # Dynamic trip count: singleton slots (the common case) pay for one
@@ -480,15 +480,25 @@ def _schedule_pass(
     consider_priority: bool,
     prefer_large: bool,
 ):
-    """QueueScheduler.Schedule as a while_loop (queue_scheduler.go:91-276)."""
+    """QueueScheduler.Schedule as a while_loop (queue_scheduler.go:91-276).
+
+    Slot validity is maintained incrementally: within a pass it only changes
+    at the consumed slot, except when an only-evicted flag flips or an
+    unfeasible key is registered (then it is recomputed in full). Member
+    evictions never happen mid-pass, so the all-evicted flags are stable."""
     Q = dev.queue_slot_start.shape[0]
     S = dev.slot_members.shape[0]
 
-    def cond(c: Carry):
+    def cond(state):
+        c, valid = state
         return ~c.stop & (c.loops < S + 2)
 
-    def body(c: Carry):
-        valid, all_ev_flags = _slot_validity(dev, c, include_queued, use_key_skip)
+    # all-evicted flags are stable within a pass: evictions happen between
+    # passes, and a rescheduled member's slot is the one being consumed.
+    valid0, all_ev_flags = _slot_validity(dev, carry, include_queued, use_key_skip)
+
+    def body(state):
+        c, valid = state
         heads, has_head = _queue_heads(dev, valid)
 
         req_h = _f(dev.slot_req[heads])  # [Q, R]
@@ -546,14 +556,28 @@ def _schedule_pass(
             )
             return c2
 
+        flags_before = (c.only_ev_global, c.only_ev_queue, c.unfeasible)
         c = jax.lax.cond(any_head, attempt, lambda c: c._replace(stop=True), c)
-        return c._replace(loops=c.loops + 1)
+
+        flags_changed = (
+            (c.only_ev_global != flags_before[0])
+            | jnp.any(c.only_ev_queue != flags_before[1])
+            | jnp.any(c.unfeasible != flags_before[2])
+        )
+        valid = jnp.where(any_head, valid.at[sstar].set(False), valid)
+        valid = jax.lax.cond(
+            flags_changed,
+            lambda: _slot_validity(dev, c, include_queued, use_key_skip)[0],
+            lambda: valid,
+        )
+        return c._replace(loops=c.loops + 1), valid
 
     # Each iteration consumes one slot (or stops), so S+2 bounds the loop;
     # the counter restarts per pass (the reference's loopNumber is also
     # per-QueueScheduler, queue_scheduler.go:99).
     carry = carry._replace(stop=jnp.zeros((), bool), loops=jnp.zeros((), jnp.int32))
-    return jax.lax.while_loop(cond, body, carry)
+    carry, _ = jax.lax.while_loop(cond, body, (carry, valid0))
+    return carry
 
 
 def _apply_evictions(dev, carry: Carry, evict_mask):
