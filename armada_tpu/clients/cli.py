@@ -162,12 +162,16 @@ def cmd_server(args):
         backend=args.backend,
         grpc_port=args.port,
         metrics_port=args.metrics_port,
+        lookout_port=args.lookout_port,
         fake_executors=fakes,
         cycle_period=args.cycle_period,
     ).start()
-    print(f"serving on {plane.address}" + (
-        f", metrics on :{args.metrics_port}" if args.metrics_port else ""
-    ))
+    extras = []
+    if args.metrics_port:
+        extras.append(f"metrics on :{args.metrics_port}")
+    if plane.lookout:
+        extras.append(f"lookout UI on :{plane.lookout.port}")
+    print(", ".join([f"serving on {plane.address}"] + extras))
     try:
         import signal
 
@@ -244,6 +248,7 @@ def build_parser():
     srv = sub.add_parser("server", help="run a local control plane")
     srv.add_argument("--port", type=int, default=50051)
     srv.add_argument("--metrics-port", type=int, default=None)
+    srv.add_argument("--lookout-port", type=int, default=None)
     srv.add_argument("--config")
     srv.add_argument("--backend", default="oracle", choices=["oracle", "kernel"])
     srv.add_argument("--cycle-period", type=float, default=1.0)

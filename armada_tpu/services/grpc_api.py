@@ -191,6 +191,15 @@ class ApiServer:
     def _job_report(self, req):
         return {"report": self.scheduler.reports.job_report(req["job_id"])}
 
+    def _set_priority_override(self, req):
+        self.scheduler.set_priority_override(
+            req["queue"], req.get("priority_factor")
+        )
+        return {}
+
+    def _list_priority_overrides(self, req):
+        return {"overrides": dict(self.scheduler.priority_overrides)}
+
     def _get_logs(self, req):
         if self.binoculars is None:
             raise KeyError("binoculars not enabled")
@@ -265,6 +274,8 @@ class ApiServer:
             "JobReport": self._job_report,
             "GetJobLogs": self._get_logs,
             "CordonNode": self._cordon_node,
+            "SetPriorityOverride": self._set_priority_override,
+            "ListPriorityOverrides": self._list_priority_overrides,
         }
 
     def serve(self, port: int = 0, max_workers: int = 8):
@@ -403,6 +414,15 @@ class ApiClient:
 
     def job_report(self, job_id):
         return self._call("JobReport", {"job_id": job_id})["report"]
+
+    def set_priority_override(self, queue, priority_factor):
+        self._call(
+            "SetPriorityOverride",
+            {"queue": queue, "priority_factor": priority_factor},
+        )
+
+    def list_priority_overrides(self):
+        return self._call("ListPriorityOverrides", {})["overrides"]
 
     def get_job_logs(self, job_id, tail_lines=100):
         return self._call("GetJobLogs", {"job_id": job_id, "tail_lines": tail_lines})[

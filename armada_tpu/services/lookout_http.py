@@ -1,0 +1,197 @@
+"""Lookout: HTTP JSON API + single-page web UI over the job database.
+
+The reference serves a React/MUI UI (internal/lookoutui) against a REST API
+(internal/lookout) backed by its own Postgres materialization. Here the
+same surface is a JSON-over-HTTP gateway onto the QueryApi/reports (the
+grpc-gateway pattern, pkg/api/*.pb.gw.go) plus an embedded single-page UI:
+job table with filtering/grouping, queue overview, scheduling report.
+
+  GET /api/jobs?queue=&state=&skip=&take=
+  GET /api/groups?by=state|queue|jobset
+  GET /api/queues
+  GET /api/report
+  GET /api/job/<id>          (spec + runs)
+  GET /                      (the UI)
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+import urllib.parse
+from dataclasses import asdict
+
+from .queryapi import JobFilter, Order
+
+UI_HTML = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>armada-tpu lookout</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1a1d21}
+header{background:#101828;color:#fff;padding:10px 20px;display:flex;gap:16px;align-items:baseline}
+header h1{font-size:16px;margin:0} header span{color:#98a2b3;font-size:12px}
+main{padding:16px 20px;max-width:1200px;margin:auto}
+.controls{display:flex;gap:8px;margin-bottom:12px}
+input,select,button{padding:6px 8px;border:1px solid #d0d5dd;border-radius:6px;font-size:13px}
+button{background:#101828;color:#fff;cursor:pointer}
+table{width:100%;border-collapse:collapse;background:#fff;border-radius:8px;overflow:hidden;
+box-shadow:0 1px 2px rgba(0,0,0,.06);font-size:13px}
+th,td{padding:8px 10px;text-align:left;border-bottom:1px solid #eaecf0}
+th{background:#f9fafb;font-weight:600;font-size:12px;color:#475467}
+.state{padding:2px 8px;border-radius:10px;font-size:11px;font-weight:600}
+.state.queued{background:#eff8ff;color:#175cd3}.state.running{background:#ecfdf3;color:#067647}
+.state.leased{background:#fffaeb;color:#b54708}.state.succeeded{background:#f0fdf4;color:#15803d}
+.state.failed,.state.preempted{background:#fef3f2;color:#b42318}
+.state.cancelled{background:#f2f4f7;color:#475467}
+.cards{display:flex;gap:12px;margin-bottom:16px}
+.card{background:#fff;border-radius:8px;padding:12px 16px;box-shadow:0 1px 2px rgba(0,0,0,.06)}
+.card b{display:block;font-size:20px}.card span{font-size:12px;color:#475467}
+pre{background:#fff;padding:12px;border-radius:8px;font-size:12px;overflow:auto}
+</style></head><body>
+<header><h1>armada-tpu</h1><span>lookout</span></header>
+<main>
+<div class="cards" id="cards"></div>
+<div class="controls">
+<input id="q" placeholder="queue filter">
+<select id="st"><option value="">any state</option>
+<option>queued</option><option>leased</option><option>running</option>
+<option>succeeded</option><option>failed</option><option>cancelled</option><option>preempted</option></select>
+<button onclick="load()">refresh</button>
+<button onclick="toggleReport()">scheduling report</button>
+</div>
+<pre id="report" style="display:none"></pre>
+<table id="jobs"><thead><tr>
+<th>job</th><th>queue</th><th>jobset</th><th>state</th><th>node</th><th>executor</th><th>attempts</th>
+</tr></thead><tbody></tbody></table>
+</main>
+<script>
+async function jget(u){const r=await fetch(u);return r.json()}
+function esc(x){return String(x??'').replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
+async function load(){
+  const q=document.getElementById('q').value, st=document.getElementById('st').value;
+  const groups=await jget('/api/groups?by=state'+(q?'&queue='+encodeURIComponent(q):''));
+  document.getElementById('cards').innerHTML=groups.groups.map(g=>
+    `<div class="card"><b>${g.count}</b><span>${esc(g.name)}</span></div>`).join('');
+  let u='/api/jobs?take=200';if(q)u+='&queue='+encodeURIComponent(q);if(st)u+='&state='+st;
+  const data=await jget(u);
+  document.querySelector('#jobs tbody').innerHTML=data.jobs.map(j=>
+    `<tr><td>${esc(j.job_id)}</td><td>${esc(j.queue)}</td><td>${esc(j.jobset)}</td>
+     <td><span class="state ${esc(j.state)}">${esc(j.state)}</span></td>
+     <td>${esc(j.node)}</td><td>${esc(j.executor)}</td><td>${esc(j.attempts)}</td></tr>`).join('');
+}
+async function toggleReport(){
+  const el=document.getElementById('report');
+  if(el.style.display==='none'){el.textContent=(await jget('/api/report')).report;el.style.display='block'}
+  else el.style.display='none';
+}
+load();setInterval(load,3000);
+</script></body></html>
+"""
+
+
+class LookoutHttpServer:
+    def __init__(self, query, scheduler, submit, port: int = 0, bind: str = "127.0.0.1"):
+        self.query = query
+        self.scheduler = scheduler
+        self.submit = submit
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _json(self, obj, code=200):
+                body = json.dumps(obj, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                params = dict(urllib.parse.parse_qsl(parsed.query))
+                try:
+                    if parsed.path == "/" or parsed.path == "/index.html":
+                        body = UI_HTML.encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/html")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    elif parsed.path == "/api/jobs":
+                        filters = []
+                        if params.get("queue"):
+                            filters.append(JobFilter("queue", params["queue"]))
+                        if params.get("state"):
+                            filters.append(JobFilter("state", params["state"]))
+                        if params.get("jobset"):
+                            filters.append(JobFilter("jobset", params["jobset"]))
+                        rows, total = outer.query.get_jobs(
+                            filters,
+                            Order(
+                                params.get("order", "submitted"),
+                                params.get("direction", "desc"),
+                            ),
+                            int(params.get("skip", 0)),
+                            int(params.get("take", 100)),
+                        )
+                        self._json({"jobs": [asdict(r) for r in rows], "total": total})
+                    elif parsed.path == "/api/groups":
+                        filters = []
+                        if params.get("queue"):
+                            filters.append(JobFilter("queue", params["queue"]))
+                        self._json(
+                            {
+                                "groups": outer.query.group_jobs(
+                                    params.get("by", "state"), filters
+                                )
+                            }
+                        )
+                    elif parsed.path == "/api/queues":
+                        self._json(
+                            {
+                                "queues": [
+                                    {
+                                        "name": q.spec.name,
+                                        "priority_factor": q.spec.priority_factor,
+                                        "cordoned": q.cordoned,
+                                    }
+                                    for q in outer.submit.queues.values()
+                                ]
+                            }
+                        )
+                    elif parsed.path == "/api/report":
+                        self._json(
+                            {"report": outer.scheduler.reports.scheduling_report()}
+                        )
+                    elif parsed.path.startswith("/api/job/"):
+                        job_id = parsed.path.rsplit("/", 1)[1]
+                        spec = outer.query.get_job_spec(job_id)
+                        if spec is None:
+                            self._json({"error": "not found"}, 404)
+                        else:
+                            self._json(
+                                {
+                                    "spec": asdict(spec),
+                                    "runs": [
+                                        asdict(r)
+                                        for r in outer.query.get_job_runs(job_id)
+                                    ],
+                                }
+                            )
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:  # surface handler errors as 500s
+                    self._json({"error": str(e)}, 500)
+
+            def log_message(self, *a):
+                pass
+
+        # Loopback by default, matching the gRPC API posture; pass
+        # bind="0.0.0.0" explicitly to expose on the network.
+        self.server = http.server.ThreadingHTTPServer((bind, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.server.shutdown()

@@ -62,6 +62,7 @@ class SchedulerService:
         self.ingester = SchedulerIngester(log, self.jobdb)
         self.backend = backend
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
+        self.priority_overrides: dict[str, float] = {}
         self.executors: dict[str, ExecutorHeartbeat] = {}
         self.is_leader = is_leader
         self.cycle_count = 0
@@ -78,6 +79,28 @@ class SchedulerService:
 
     def upsert_queue(self, queue: QueueSpec):
         self.queues[queue.name] = queue
+
+    def set_priority_override(self, queue: str, priority_factor: float | None):
+        """External priority override (internal/scheduler/priorityoverride):
+        replaces the queue's priority factor for scheduling; None clears."""
+        if priority_factor is None:
+            self.priority_overrides.pop(queue, None)
+            return
+        import math
+
+        pf = float(priority_factor)
+        if not math.isfinite(pf) or pf <= 0:
+            raise ValueError(
+                f"priority factor must be finite and > 0, got {priority_factor!r}"
+            )
+        self.priority_overrides[queue] = pf
+
+    def _effective_queue(self, name: str) -> QueueSpec:
+        spec = self.queues.get(name, QueueSpec(name))
+        override = self.priority_overrides.get(name)
+        if override is not None:
+            spec = QueueSpec(name, override)
+        return spec
 
     def report_executor(self, hb: ExecutorHeartbeat):
         self.executors[hb.name] = hb
@@ -196,9 +219,7 @@ class SchedulerService:
             if j.id not in exclude
         ]
         queue_names = {j.queue for j in queued} | {r.job.queue for r in running}
-        queues = [
-            self.queues.get(name, QueueSpec(name)) for name in sorted(queue_names)
-        ]
+        queues = [self._effective_queue(name) for name in sorted(queue_names)]
         return nodes, queues, running, queued, node_executor, txn
 
     def _schedule_pool(

@@ -33,6 +33,7 @@ class ControlPlane:
         cycle_period: float = 1.0,
         grpc_port: int = 0,
         metrics_port: int | None = None,
+        lookout_port: int | None = None,
         fake_executors: list[dict] | None = None,
         enable_submit_check: bool = False,
     ):
@@ -85,6 +86,13 @@ class ControlPlane:
         self.metrics_server = (
             serve_metrics(self.metrics, metrics_port) if metrics_port else None
         )
+        self.lookout = None
+        if lookout_port is not None:
+            from .lookout_http import LookoutHttpServer
+
+            self.lookout = LookoutHttpServer(
+                self.query, self.scheduler, self.submit, lookout_port
+            )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -114,6 +122,8 @@ class ControlPlane:
         self.grpc_server.stop(grace=0.5)
         if self.metrics_server:
             self.metrics_server.shutdown()
+        if self.lookout:
+            self.lookout.stop()
 
     @property
     def address(self) -> str:

@@ -154,6 +154,46 @@ def test_binoculars_logs_and_cordon(client, plane):
     client.cordon_node(node_id, uncordon=True)
 
 
+def test_priority_override(client, plane):
+    client.create_queue("ovr", priority_factor=1.0)
+    client.set_priority_override("ovr", 5.0)
+    assert client.list_priority_overrides() == {"ovr": 5.0}
+    # effective queue weight now 1/5
+    eff = plane.scheduler._effective_queue("ovr")
+    assert eff.priority_factor == 5.0
+    client.set_priority_override("ovr", None)
+    assert client.list_priority_overrides() == {}
+
+
+def test_lookout_http(plane, client):
+    import json as _json
+    import urllib.request
+
+    from armada_tpu.services.lookout_http import LookoutHttpServer
+
+    import urllib.error
+
+    lk = LookoutHttpServer(plane.query, plane.scheduler, plane.submit, 0)
+    try:
+        client.create_queue("web")
+        ids = client.submit_jobs("web", "web-set", [dict(JOB) for _ in range(3)])
+        assert _wait(lambda: plane.scheduler.jobdb.get(ids[0]) is not None)
+        base = f"http://127.0.0.1:{lk.port}"
+        jobs = _json.load(urllib.request.urlopen(f"{base}/api/jobs?queue=web"))
+        assert jobs["total"] == 3
+        groups = _json.load(urllib.request.urlopen(f"{base}/api/groups?by=state"))
+        assert sum(g["count"] for g in groups["groups"]) >= 3
+        detail = _json.load(urllib.request.urlopen(f"{base}/api/job/{ids[0]}"))
+        assert detail["spec"]["id"] == ids[0]
+        html = urllib.request.urlopen(base).read().decode()
+        assert "armada-tpu" in html and "lookout" in html
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/api/job/nope")
+        assert exc.value.code == 404
+    finally:
+        lk.stop()
+
+
 def test_file_lease_leader(tmp_path):
     path = str(tmp_path / "lease")
     a = FileLeaseLeader(path, lease_duration=0.5, identity="a")
