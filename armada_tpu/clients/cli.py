@@ -165,6 +165,7 @@ def cmd_server(args):
         lookout_port=args.lookout_port,
         fake_executors=fakes,
         cycle_period=args.cycle_period,
+        data_dir=args.data_dir,
     ).start()
     extras = []
     if args.metrics_port:
@@ -249,6 +250,9 @@ def build_parser():
     srv.add_argument("--port", type=int, default=50051)
     srv.add_argument("--metrics-port", type=int, default=None)
     srv.add_argument("--lookout-port", type=int, default=None)
+    srv.add_argument(
+        "--data-dir", help="durable event-log directory (in-memory if unset)"
+    )
     srv.add_argument("--config")
     srv.add_argument("--backend", default="oracle", choices=["oracle", "kernel"])
     srv.add_argument("--cycle-period", type=float, default=1.0)

@@ -107,6 +107,21 @@ class JobRequeued(Event):
 
 
 @dataclass(frozen=True)
+class QueueUpsert(Event):
+    """Control-plane event: queue created/updated (the reference's
+    controlplaneevents.Event, pkg/controlplaneevents/events.proto)."""
+
+    name: str = ""
+    priority_factor: float = 1.0
+    cordoned: bool = False
+
+
+@dataclass(frozen=True)
+class QueueDelete(Event):
+    name: str = ""
+
+
+@dataclass(frozen=True)
 class EventSequence:
     """A batch of events for one (queue, jobset), the log's unit of
     publication (events.proto:66; jobset-keyed routing as in

@@ -36,9 +36,15 @@ class ControlPlane:
         lookout_port: int | None = None,
         fake_executors: list[dict] | None = None,
         enable_submit_check: bool = False,
+        data_dir: str | None = None,
     ):
         self.config = config or SchedulingConfig()
-        self.log = InMemoryEventLog()
+        if data_dir:
+            from ..events.file_log import FileEventLog
+
+            self.log = FileEventLog(data_dir)
+        else:
+            self.log = InMemoryEventLog()
         self.leader = StandaloneLeader()
         self.scheduler = SchedulerService(
             self.config, self.log, backend=backend, is_leader=self.leader
@@ -124,6 +130,8 @@ class ControlPlane:
             self.metrics_server.shutdown()
         if self.lookout:
             self.lookout.stop()
+        if hasattr(self.log, "close"):
+            self.log.close()
 
     @property
     def address(self) -> str:

@@ -19,10 +19,16 @@ from ..events import (
     CancelJob,
     CancelJobSet,
     EventSequence,
+    QueueDelete,
+    QueueUpsert,
     ReprioritiseJob,
     SubmitJob,
 )
 from ..events.model import new_id
+
+# Jobset key under which control-plane (queue CRUD) events are logged,
+# mirroring the reference's separate controlPlaneEvents topic.
+CONTROL_PLANE_JOBSET = "__control-plane__"
 
 
 class SubmissionError(ValueError):
@@ -46,6 +52,28 @@ class SubmitService:
         self.scheduler = scheduler  # optional: queue updates pushed through
         self.queues: dict[str, Queue] = {}
         self._dedup: dict[tuple, str] = {}  # (queue, dedup_id) -> job_id
+        self._replay()
+
+    def _replay(self):
+        """Rebuild queue registry and dedup index from the (durable) log —
+        the control-plane materialized view (queues in Postgres + dedup
+        table in the reference)."""
+        for entry in self.log.read(0, 10**9):
+            for event in entry.sequence.events:
+                if isinstance(event, QueueUpsert):
+                    spec = QueueSpec(event.name, event.priority_factor)
+                    self.queues[event.name] = Queue(spec=spec, cordoned=event.cordoned)
+                    if self.scheduler is not None:
+                        self.scheduler.upsert_queue(spec)
+                elif isinstance(event, QueueDelete):
+                    self.queues.pop(event.name, None)
+                elif isinstance(event, SubmitJob) and event.deduplication_id:
+                    self._dedup[
+                        (entry.sequence.queue, event.deduplication_id)
+                    ] = event.job.id
+
+    def _publish_queue_event(self, event):
+        self.log.publish(EventSequence.of("", CONTROL_PLANE_JOBSET, event))
 
     # ---- queue CRUD (internal/server/queue) ----
 
@@ -54,6 +82,14 @@ class SubmitService:
             raise SubmissionError(f"queue {spec.name!r} already exists")
         q = Queue(spec=spec, cordoned=cordoned)
         self.queues[spec.name] = q
+        self._publish_queue_event(
+            QueueUpsert(
+                created=_time.time(),
+                name=spec.name,
+                priority_factor=spec.priority_factor,
+                cordoned=cordoned,
+            )
+        )
         if self.scheduler is not None:
             self.scheduler.upsert_queue(spec)
         return q
@@ -72,11 +108,23 @@ class SubmitService:
             q.spec = QueueSpec(name, priority_factor)
         if cordoned is not None:
             q.cordoned = cordoned
+        self._publish_queue_event(
+            QueueUpsert(
+                created=_time.time(),
+                name=name,
+                priority_factor=q.spec.priority_factor,
+                cordoned=q.cordoned,
+            )
+        )
         if self.scheduler is not None:
             self.scheduler.upsert_queue(q.spec)
         return q
 
     def delete_queue(self, name: str):
+        if name in self.queues:
+            self._publish_queue_event(
+                QueueDelete(created=_time.time(), name=name)
+            )
         self.queues.pop(name, None)
 
     def get_queue(self, name: str) -> Queue | None:

@@ -27,8 +27,6 @@ memdb/iterator machinery):
 Parity notes: with JAX x64 enabled (tests), cost arithmetic is float64 and
 aggregate accounting is exact for realistic magnitudes; on TPU (x64 off)
 costs are float32 and parity becomes approximate in exotic tie cases.
-Node-uniformity gang label search is not yet vectorized (gangs with a
-uniformity label schedule as regular gangs here).
 """
 
 from __future__ import annotations
@@ -130,11 +128,12 @@ def _fair_shares(weights, demand_costs, total_is_zero):
     return fair_share, capped, uncapped
 
 
-def _static_ok(dev, j):
-    """StaticJobRequirementsMet over all nodes (nodematching.go:161-190)."""
+def _static_ok(dev, j, extra_sel):
+    """StaticJobRequirementsMet over all nodes (nodematching.go:161-190).
+    extra_sel: additional required label bits (gang uniformity value)."""
     tolerated = dev.job_tolerated[j]
     taints_ok = jnp.all((dev.node_taints & ~tolerated) == 0, axis=-1)
-    sel_ok = bits_subset(dev.job_selector[j], dev.node_labels)
+    sel_ok = bits_subset(dev.job_selector[j] | extra_sel, dev.node_labels)
     total_ok = jnp.all(dev.job_req_fit[j] <= dev.node_total, axis=-1)
     return taints_ok & sel_ok & total_ok & ~dev.node_unschedulable & dev.job_possible[j]
 
@@ -203,7 +202,7 @@ def _fair_preemption(dev, carry, j, static_ok):
     return sel_node, found, preempted_at, new_alloc, new_rank
 
 
-def _select_node(dev, carry, j):
+def _select_node(dev, carry, j, extra_sel):
     """SelectNodeForJobWithTxn (nodedb.go:423-503). Returns
     (node, found, preempted_at, new_alloc, new_evict_rank)."""
     prio = carry.job_prio[j]
@@ -218,7 +217,7 @@ def _select_node(dev, carry, j):
         dev.node_unschedulable[safe_home] & over_alloc
     )
 
-    static_ok = _static_ok(dev, j)
+    static_ok = _static_ok(dev, j, extra_sel)
 
     n0, f0 = _select_at_row(dev, alloc, j, 0, static_ok)
     np_, fp = _select_at_row(dev, alloc, j, row_p, static_ok)
@@ -351,28 +350,77 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
         (blocked_code == OK) & floating_over, FAIL, blocked_code
     )
 
-    # Member-by-member placement.
-    M = dev.slot_members.shape[1]
+    # Member-by-member placement; extra_sel constrains members to one
+    # uniformity-label value during the search.
+    fdt = jnp.result_type(float)
 
-    def member_body(m, state):
-        c, ok = state
-        j = dev.slot_members[s, m]
-        live = (m < dev.slot_count[s]) & ok
-        safe_j = jnp.clip(j, 0, dev.job_req.shape[0] - 1)
-        node, found, _, new_alloc, new_rank = _select_node(dev, c, safe_j)
+    def attempt_members(c0, extra_sel, start_ok):
+        def member_body(m, state):
+            c, ok, pat_sum = state
+            j = dev.slot_members[s, m]
+            live = (m < dev.slot_count[s]) & ok
+            safe_j = jnp.clip(j, 0, dev.job_req.shape[0] - 1)
+            node, found, pat, new_alloc, new_rank = _select_node(
+                dev, c, safe_j, extra_sel
+            )
 
-        def do_bind(c):
-            c2 = c._replace(alloc=new_alloc, evict_rank=new_rank)
-            return _bind(dev, c2, safe_j, node, c2.job_prio[safe_j])
+            def do_bind(c):
+                c2 = c._replace(alloc=new_alloc, evict_rank=new_rank)
+                return _bind(dev, c2, safe_j, node, c2.job_prio[safe_j])
 
-        c = jax.lax.cond(live & found, do_bind, lambda c: c, c)
-        return c, ok & (found | ~live)
+            c = jax.lax.cond(live & found, do_bind, lambda c: c, c)
+            pat_sum = pat_sum + jnp.where(live & found, _f(pat), 0.0)
+            return c, ok & (found | ~live), pat_sum
 
-    # Dynamic trip count: singleton slots (the common case) pay for one
-    # member even when the batch contains wide gangs.
-    attempted, ok = jax.lax.fori_loop(
-        0, dev.slot_count[s], member_body, (carry, blocked_code == OK)
-    )
+        # Dynamic trip count: singleton slots (the common case) pay for one
+        # member even when the batch contains wide gangs.
+        c1, ok, pat_sum = jax.lax.fori_loop(
+            0, dev.slot_count[s], member_body, (c0, start_ok, jnp.zeros((), fdt))
+        )
+        mean = pat_sum / jnp.maximum(card, 1.0)
+        return c1, ok, mean
+
+    # Uniformity key with no node values: unsatisfiable
+    # (gang_scheduler.go:171-175), encoded as a (-1,-1) range.
+    start_ok = (blocked_code == OK) & (dev.slot_uni_start[s] >= 0)
+    has_uni = dev.slot_uni_end[s] > dev.slot_uni_start[s]
+
+    def plain(c):
+        c1, ok, _ = attempt_members(c, jnp.zeros_like(dev.uni_value_bits[0]), start_ok)
+        return c1, ok
+
+    def uniform(c):
+        """Node-uniformity search (gang_scheduler.go:150-224): evaluate each
+        label value, keep the successful value with the best fit (lowest
+        mean preempted-at priority, first wins ties), then re-attempt and
+        commit that value."""
+
+        def eval_body(v, best):
+            best_v, best_mean, found_any = best
+            _, ok, mean = attempt_members(c, dev.uni_value_bits[v], start_ok)
+            better = ok & (~found_any | (mean < best_mean))
+            return (
+                jnp.where(better, v, best_v),
+                jnp.where(better, mean, best_mean),
+                found_any | ok,
+            )
+
+        best_v, _, found_any = jax.lax.fori_loop(
+            dev.slot_uni_start[s],
+            dev.slot_uni_end[s],
+            eval_body,
+            (jnp.int32(0), jnp.asarray(jnp.inf, fdt), jnp.zeros((), bool)),
+        )
+
+        def commit(c):
+            c1, ok, _ = attempt_members(c, dev.uni_value_bits[best_v], start_ok)
+            return c1, ok
+
+        return jax.lax.cond(
+            found_any, commit, lambda c: (c, jnp.zeros((), bool)), c
+        )
+
+    attempted, ok = jax.lax.cond(has_uni, uniform, plain, carry)
 
     # Commit or roll back (functional txn).
     new_carry = jax.tree_util.tree_map(

@@ -82,6 +82,12 @@ class DeviceRound:
     slot_req: np.ndarray  # int32[S, R]
     slot_key_group: np.ndarray  # int32[S] (-1 if N/A)
     slot_jobs_before: np.ndarray  # int32[S] queued jobs before this slot in its queue
+    # Gang node-uniformity search (gang_scheduler.go:150-224): per slot a
+    # range [start, end) into the uniformity-value table; start==end means
+    # no uniformity constraint. Each value is a selector bitset.
+    slot_uni_start: np.ndarray  # int32[S]
+    slot_uni_end: np.ndarray  # int32[S]
+    uni_value_bits: np.ndarray  # uint32[V, Wl]
     queue_slot_start: np.ndarray  # int32[Q]
     queue_slot_end: np.ndarray  # int32[Q]
 
@@ -182,6 +188,8 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         slot_req=pad(dev.slot_req, 0, Sp),
         slot_key_group=pad(dev.slot_key_group, 0, Sp, fill=-1),
         slot_jobs_before=pad(dev.slot_jobs_before, 0, Sp),
+        slot_uni_start=pad(dev.slot_uni_start, 0, Sp),
+        slot_uni_end=pad(dev.slot_uni_end, 0, Sp),
         queue_slot_start=pad(dev.queue_slot_start, 0, Qp),
         queue_slot_end=pad(dev.queue_slot_end, 0, Qp),
         queue_weight=pad(dev.queue_weight, 0, Qp),
@@ -281,6 +289,36 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
             }
         )
 
+    # Uniformity-value table: sorted values per uniformity key, as selector
+    # bitsets (mirrors the oracle's sorted-value iteration).
+    uni_ranges: dict[str, tuple[int, int]] = {}
+    uni_bits_rows: list[np.ndarray] = []
+    for s in slots:
+        members = s["members"]
+        g = int(snap.job_gang[members[0]])
+        key = (
+            snap.gang_uniformity_key[g]
+            if 0 <= g < snap.num_gangs and len(members) > 1 and not s["running"]
+            else ""
+        )
+        s["uniformity"] = key
+        if key and key not in uni_ranges:
+            values = sorted(
+                {v for (k, v) in snap.label_vocab.pairs if k == key}
+            )
+            start = len(uni_bits_rows)
+            for value in values:
+                bits, possible = snap.label_vocab.selector_bits({key: value})
+                if possible:
+                    uni_bits_rows.append(bits)
+            if len(uni_bits_rows) == start:
+                # No node carries this label: the gang can never satisfy its
+                # uniformity constraint ("no nodes with uniformity label",
+                # gang_scheduler.go:171-175). Sentinel (-1,-1) fails the slot.
+                uni_ranges[key] = (-1, -1)
+            else:
+                uni_ranges[key] = (start, len(uni_bits_rows))
+
     slots.sort(key=lambda s: (s["queue"], s["segment"], s["order"]))
     S = max(1, len(slots))
     M = max([1] + [len(s["members"]) for s in slots])
@@ -291,6 +329,8 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     slot_req = np.zeros((S, R), dtype=np.int32)
     slot_key_group = np.full(S, -1, dtype=np.int32)
     slot_jobs_before = np.zeros(S, dtype=np.int32)
+    slot_uni_start = np.zeros(S, dtype=np.int32)
+    slot_uni_end = np.zeros(S, dtype=np.int32)
     queue_slot_start = np.zeros(Q, dtype=np.int32)
     queue_slot_end = np.zeros(Q, dtype=np.int32)
 
@@ -313,6 +353,8 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         slot_req[i] = req_dev[members].sum(axis=0)
         slot_key_group[i] = s["key_group"]
         slot_jobs_before[i] = jobs_before
+        if s.get("uniformity"):
+            slot_uni_start[i], slot_uni_end[i] = uni_ranges[s["uniformity"]]
         if not s["running"]:
             jobs_before += len(members)
     if prev_queue >= 0:
@@ -394,6 +436,13 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         slot_req=slot_req,
         slot_key_group=slot_key_group,
         slot_jobs_before=slot_jobs_before,
+        slot_uni_start=slot_uni_start,
+        slot_uni_end=slot_uni_end,
+        uni_value_bits=(
+            np.stack(uni_bits_rows)
+            if uni_bits_rows
+            else np.zeros((1, snap.label_vocab.n_words), dtype=np.uint32)
+        ),
         queue_slot_start=queue_slot_start,
         queue_slot_end=queue_slot_end,
         queue_weight=snap.queue_weight,

@@ -262,6 +262,70 @@ def test_parity_urgency_preemption():
     )
 
 
+def test_parity_gang_uniformity():
+    # Two zones; zone-b can host the whole gang, zone-a cannot. The
+    # uniformity search must place all members in one zone.
+    nodes = [
+        NodeSpec(id="a0", pool="default", labels={"zone": "a"},
+                 total_resources={"cpu": "16", "memory": "64Gi"}),
+        NodeSpec(id="b0", pool="default", labels={"zone": "b"},
+                 total_resources={"cpu": "32", "memory": "128Gi"}),
+        NodeSpec(id="b1", pool="default", labels={"zone": "b"},
+                 total_resources={"cpu": "32", "memory": "128Gi"}),
+    ]
+    gang = Gang(id="g", cardinality=3, node_uniformity_label="zone")
+    queued = [
+        JobSpec(id=f"g{i}", queue="q", requests={"cpu": "16", "memory": "16Gi"},
+                submitted_ts=i, gang=gang)
+        for i in range(3)
+    ]
+    snap, oracle, out = assert_parity(
+        SchedulingConfig(), nodes, [QueueSpec("q")], [], queued, "uniformity"
+    )
+    assert oracle.scheduled_mask.sum() == 3
+    placed = {snap.node_ids[n] for n in oracle.assigned_node[:3]}
+    assert placed <= {"b0", "b1"}  # all in zone b
+
+
+def test_parity_gang_uniformity_impossible():
+    # No single zone fits the gang -> nothing scheduled, singleton proceeds.
+    nodes = [
+        NodeSpec(id=f"{z}0", pool="default", labels={"zone": z},
+                 total_resources={"cpu": "16", "memory": "64Gi"})
+        for z in ("a", "b")
+    ]
+    gang = Gang(id="g", cardinality=3, node_uniformity_label="zone")
+    queued = [
+        JobSpec(id=f"g{i}", queue="q", requests={"cpu": "8", "memory": "8Gi"},
+                submitted_ts=i, gang=gang)
+        for i in range(3)
+    ] + [JobSpec(id="solo", queue="q", requests={"cpu": "2", "memory": "2Gi"},
+                 submitted_ts=10)]
+    snap, oracle, out = assert_parity(
+        SchedulingConfig(), nodes, [QueueSpec("q")], [], queued, "uniformity-fail"
+    )
+    assert oracle.scheduled_mask.sum() == 1  # only the singleton
+
+
+def test_parity_gang_uniformity_unknown_label():
+    # Uniformity label no node carries: the gang must never schedule.
+    nodes = [
+        NodeSpec(id=f"n{i}", pool="default",
+                 total_resources={"cpu": "32", "memory": "128Gi"})
+        for i in range(2)
+    ]
+    gang = Gang(id="g", cardinality=2, node_uniformity_label="rack")
+    queued = [
+        JobSpec(id=f"g{i}", queue="q", requests={"cpu": "1", "memory": "1Gi"},
+                submitted_ts=i, gang=gang)
+        for i in range(2)
+    ]
+    snap, oracle, out = assert_parity(
+        SchedulingConfig(), nodes, [QueueSpec("q")], [], queued, "uniformity-unknown"
+    )
+    assert oracle.scheduled_mask.sum() == 0
+
+
 def test_parity_gang_atomicity():
     nodes = [
         NodeSpec(id=f"n{i}", pool="default", total_resources={"cpu": "32", "memory": "128Gi"})
