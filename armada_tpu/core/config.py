@@ -104,6 +104,13 @@ class SchedulingConfig:
     consider_priority_class_priority: bool = True
     executor_timeout_s: float = 600.0
     max_unacknowledged_jobs_per_executor: int = 2500
+    # Short-job penalty (scheduling/short_job_penalty.go): jobs that finish
+    # faster than this still count against their queue's cost until the
+    # window passes, discouraging churn. 0 disables.
+    short_job_penalty_s: float = 0.0
+    # Terminal jobs older than this are pruned from the in-memory store
+    # (the reference's lookout/scheduler DB pruners).
+    terminal_job_retention_s: float = 24 * 3600.0
 
     def resource_factory(self) -> ResourceListFactory:
         return ResourceListFactory.create(

@@ -149,6 +149,12 @@ class FileEventLog(EventLog):
         seg_index = len(self._entries) // self.segment_size
         path = os.path.join(self.dir, f"seg-{seg_index:08d}.log")
         if self._fh is not None:
+            # fsync before rollover: a later-fsynced successor segment must
+            # never survive a tail loss in its predecessor (that would be a
+            # mid-log gap, which recovery refuses to repair).
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._unsynced = 0
             self._fh.close()
         self._fh = open(path, "ab")
 
@@ -168,7 +174,15 @@ class FileEventLog(EventLog):
                 "c": zlib.crc32(json.dumps(payload).encode()),
                 "s": payload,
             }
-            self._fh.write(json.dumps(rec).encode() + b"\n")
+            # On a partial write (e.g. ENOSPC) roll the file back to the
+            # record boundary so a later append can't concatenate onto torn
+            # bytes mid-file.
+            pos = self._fh.tell()
+            try:
+                self._fh.write(json.dumps(rec).encode() + b"\n")
+            except OSError:
+                self._fh.truncate(pos)
+                raise
             self._unsynced += 1
             if self._unsynced >= self.sync_every:
                 self._fh.flush()

@@ -67,26 +67,26 @@ def _apply_event(txn, seq: ev.EventSequence, event) -> None:
     elif isinstance(event, ev.JobRunRunning):
         run = job.latest_run
         if run and run.id == event.run_id:
-            run = replace(run, state=RunState.RUNNING)
+            run = replace(run, state=RunState.RUNNING, started=event.created)
             txn.upsert(job.with_(state=JobState.RUNNING, runs=job.runs[:-1] + (run,)))
     elif isinstance(event, ev.JobRunSucceeded):
         run = job.latest_run
         if run and run.id == event.run_id:
-            run = replace(run, state=RunState.SUCCEEDED)
+            run = replace(run, state=RunState.SUCCEEDED, finished=event.created)
             txn.upsert(job.with_(runs=job.runs[:-1] + (run,)))
     elif isinstance(event, ev.JobSucceeded):
         txn.upsert(job.with_(state=JobState.SUCCEEDED))
     elif isinstance(event, ev.JobRunPreempted):
         run = job.latest_run
         if run and run.id == event.run_id:
-            run = replace(run, state=RunState.PREEMPTED)
+            run = replace(run, state=RunState.PREEMPTED, finished=event.created)
             txn.upsert(
                 job.with_(state=JobState.PREEMPTED, runs=job.runs[:-1] + (run,))
             )
     elif isinstance(event, ev.JobRunErrors):
         run = job.latest_run
         if run and run.id == event.run_id:
-            run = replace(run, state=RunState.FAILED)
+            run = replace(run, state=RunState.FAILED, finished=event.created)
             failed_nodes = job.failed_nodes + ((run.node_id,) if run.node_id else ())
             txn.upsert(
                 job.with_(runs=job.runs[:-1] + (run,), failed_nodes=failed_nodes,

@@ -60,6 +60,8 @@ class JobRun:
     scheduled_at_priority: int = 0
     state: RunState = RunState.LEASED
     attempt: int = 0
+    started: float = 0.0  # JobRunRunning time
+    finished: float = 0.0  # terminal-event time
 
 
 @dataclass(frozen=True)
@@ -227,6 +229,28 @@ class JobDb:
 
     def get(self, job_id: str) -> Job | None:
         return self._jobs.get(job_id)
+
+    def prune_terminal(self, older_than: float) -> int:
+        """Delete terminal jobs whose last activity predates `older_than`
+        (the lookout/scheduler DB pruners of the reference). Returns count."""
+        txn = self.write_txn()
+        try:
+            pruned = 0
+            for job in list(txn.all_jobs()):
+                if not job.state.terminal:
+                    continue
+                run = job.latest_run
+                last = max(
+                    job.submitted, run.finished if run else 0.0, run.started if run else 0.0
+                )
+                if last < older_than:
+                    txn.delete(job.id)
+                    pruned += 1
+            txn.commit()
+            return pruned
+        except Exception:
+            txn.abort()
+            raise
 
     def __len__(self) -> int:
         return len(self._jobs)

@@ -63,6 +63,7 @@ class SchedulerService:
         self.backend = backend
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
         self.priority_overrides: dict[str, float] = {}
+        self.cordoned_queues: set[str] = set()
         self.executors: dict[str, ExecutorHeartbeat] = {}
         self.is_leader = is_leader
         self.cycle_count = 0
@@ -71,14 +72,22 @@ class SchedulerService:
 
         self.reports = SchedulingReportsRepository()
         self.metrics = None  # set via attach_metrics
+        from ..utils.logging import get_logger
+
+        self.log_ = get_logger("armada_tpu.scheduler")
 
     def attach_metrics(self, metrics):
         self.metrics = metrics
 
     # ---- control-plane inputs ----
 
-    def upsert_queue(self, queue: QueueSpec):
+    def upsert_queue(self, queue: QueueSpec, cordoned: bool | None = None):
         self.queues[queue.name] = queue
+        if cordoned is not None:
+            if cordoned:
+                self.cordoned_queues.add(queue.name)
+            else:
+                self.cordoned_queues.discard(queue.name)
 
     def set_priority_override(self, queue: str, priority_factor: float | None):
         """External priority override (internal/scheduler/priorityoverride):
@@ -142,6 +151,11 @@ class SchedulerService:
                     if isinstance(event, JobRunLeased):
                         leased_this_cycle.add(event.job_id)
             sequences += pool_seqs
+
+        # Periodic pruning of old terminal jobs keeps the jobdb (and the
+        # penalty scan) bounded, like the reference's DB pruners.
+        if self.cycle_count % 600 == 599:
+            self.jobdb.prune_terminal(now - self.config.terminal_job_retention_s)
 
         if token is not None and not self.is_leader.validate(token):
             return []  # lost leadership mid-cycle: nothing published
@@ -213,25 +227,68 @@ class SchedulerService:
                     scheduled_at_priority=run.scheduled_at_priority,
                 )
             )
-        queued = [
-            j.spec.with_(priority=j.priority)
-            for j in txn.queued_jobs()
-            if j.id not in exclude
-        ]
+        queued_jobs = [j for j in txn.queued_jobs() if j.id not in exclude]
+        queued = [j.spec.with_(priority=j.priority) for j in queued_jobs]
+        # Retry anti-affinity: nodes where earlier attempts failed
+        # (scheduler.go:589-636).
+        excluded_nodes = {
+            j.id: list(j.failed_nodes) for j in queued_jobs if j.failed_nodes
+        }
         queue_names = {j.queue for j in queued} | {r.job.queue for r in running}
         queues = [self._effective_queue(name) for name in sorted(queue_names)]
-        return nodes, queues, running, queued, node_executor, txn
+        return nodes, queues, running, queued, node_executor, txn, excluded_nodes
+
+    def _short_job_penalties(self, txn, pool: str, now: float) -> dict:
+        """Requests of recently finished short jobs, per queue: they count
+        against the queue's ordering cost until started + window passes
+        (short_job_penalty.go)."""
+        window = self.config.short_job_penalty_s
+        if not window:
+            return {}
+        from ..core.resources import parse_quantity
+
+        penalties: dict[str, dict] = {}
+        for job in txn.all_jobs():
+            # Any terminal state except preemption counts (the reference
+            # penalizes failed/cancelled churn too, short_job_penalty.go).
+            if not job.state.terminal or job.state == JobState.PREEMPTED:
+                continue
+            run = job.latest_run
+            if run is None or run.pool != pool or not run.started:
+                continue
+            if run.finished - run.started >= window:
+                continue  # not a short job
+            if now >= run.started + window:
+                continue  # penalty window passed
+            bucket = penalties.setdefault(job.queue, {})
+            for name, qty in job.spec.requests.items():
+                bucket[name] = bucket.get(name, 0) + parse_quantity(qty)
+        return penalties
 
     def _schedule_pool(
         self, pool: str, now: float, exclude: set[str] = frozenset()
     ) -> list[EventSequence]:
-        nodes, queues, running, queued, node_executor, txn = self._build_pool_inputs(
-            pool, exclude
-        )
+        (
+            nodes,
+            queues,
+            running,
+            queued,
+            node_executor,
+            txn,
+            excluded_nodes,
+        ) = self._build_pool_inputs(pool, exclude)
         if not nodes or not (queued or running):
             return []
         snap = build_round_snapshot(
-            self.config, pool, nodes, queues, running, queued
+            self.config,
+            pool,
+            nodes,
+            queues,
+            running,
+            queued,
+            excluded_nodes=excluded_nodes,
+            cordoned_queues=self.cordoned_queues,
+            short_job_penalty=self._short_job_penalties(txn, pool, now),
         )
         solve_started = _time.time()
         result = self._solve(snap)
@@ -242,6 +299,13 @@ class SchedulerService:
             "scheduled": int(result["scheduled_mask"].sum()),
             "preempted": int(result["preempted_mask"].sum()),
         }
+        self.log_.with_fields(
+            cycle=self.cycle_count, pool=pool, stage="scheduling-round",
+            jobs=snap.num_jobs, nodes=snap.num_nodes,
+            scheduled=self.last_cycle_stats["scheduled"],
+            preempted=self.last_cycle_stats["preempted"],
+            solve_s=round(_time.time() - solve_started, 4),
+        ).info("scheduling round complete")
         self._record_round(pool, snap, result, solve_started)
 
         by_jobset: dict[tuple, list] = {}

@@ -64,7 +64,7 @@ class SubmitService:
                     spec = QueueSpec(event.name, event.priority_factor)
                     self.queues[event.name] = Queue(spec=spec, cordoned=event.cordoned)
                     if self.scheduler is not None:
-                        self.scheduler.upsert_queue(spec)
+                        self.scheduler.upsert_queue(spec, cordoned=event.cordoned)
                 elif isinstance(event, QueueDelete):
                     self.queues.pop(event.name, None)
                 elif isinstance(event, SubmitJob) and event.deduplication_id:
@@ -91,7 +91,7 @@ class SubmitService:
             )
         )
         if self.scheduler is not None:
-            self.scheduler.upsert_queue(spec)
+            self.scheduler.upsert_queue(spec, cordoned=cordoned)
         return q
 
     def update_queue(
@@ -117,7 +117,7 @@ class SubmitService:
             )
         )
         if self.scheduler is not None:
-            self.scheduler.upsert_queue(q.spec)
+            self.scheduler.upsert_queue(q.spec, cordoned=q.cordoned)
         return q
 
     def delete_queue(self, name: str):

@@ -59,8 +59,12 @@ class RoundSnapshot:
     # --- queues ---
     queue_names: list
     queue_weight: np.ndarray  # float64[Q]
+    queue_cordoned: np.ndarray  # bool[Q] (no new gangs schedule from these)
     queue_allocated: np.ndarray  # int64[Q, R] (running jobs in this pool)
     queue_demand: np.ndarray  # int64[Q, R] (running + queued)
+    # Short-job penalty: requests of recently-finished short jobs, included
+    # in candidate-ordering costs only (short_job_penalty.go).
+    queue_short_penalty: np.ndarray  # int64[Q, R]
 
     # --- jobs (running + queued, one table) ---
     job_ids: list
@@ -74,6 +78,9 @@ class RoundSnapshot:
     job_is_running: np.ndarray  # bool[J]
     job_node: np.ndarray  # int32[J]: bound node (running) or NO_NODE
     job_order: np.ndarray  # int64[J]: within-queue order rank (lower first)
+    # Nodes previous attempts failed on (retry anti-affinity,
+    # scheduler.go:589-636): up to maxRetries node indices, -1 padded.
+    job_excluded_nodes: np.ndarray  # int32[J, K]
     job_gang: np.ndarray  # int32[J] -> gang table index
     # Raw gang identity per job ("" if none), for gang-aware eviction of
     # running jobs (which do not get gang table rows).
@@ -152,7 +159,14 @@ def build_round_snapshot(
     queues: list[QueueSpec],
     running: list[RunningJob],
     queued: list[JobSpec],
+    excluded_nodes: dict | None = None,
+    cordoned_queues: set | None = None,
+    short_job_penalty: dict | None = None,
 ) -> RoundSnapshot:
+    """excluded_nodes: {job_id: [node_id, ...]} — nodes earlier attempts
+    failed on; those nodes are infeasible for the retry. cordoned_queues:
+    queue names whose new gangs must not schedule (QueueCordoned).
+    short_job_penalty: {queue_name: {resource: qty}} anti-churn cost."""
     factory = config.resource_factory()
     R = factory.num_resources
     priorities = np.asarray(priority_levels(config.priority_classes), dtype=np.int32)
@@ -220,15 +234,41 @@ def build_round_snapshot(
     queue_index = {q.name: i for i, q in enumerate(queues)}
     Q = len(queues)
 
+    # Vectorized fast paths: the common case (no taints, no selectors) skips
+    # per-job bitset work entirely; priority-class attributes resolve via a
+    # small name table; queue indices via one dict pass.
+    has_taints = bool(taint_vocab.taints)
+    tolerated_cache: dict = {}
+    selector_cache: dict = {}
     for j, job in enumerate(jobs):
-        job_tolerated[j] = taint_vocab.tolerated_bits(job.tolerations)
-        bits, possible = label_vocab.selector_bits(job.node_selector)
-        job_selector[j] = bits
-        job_possible[j] = possible
+        if has_taints and job.tolerations:
+            cached = tolerated_cache.get(job.tolerations)
+            if cached is None:
+                cached = taint_vocab.tolerated_bits(job.tolerations)
+                tolerated_cache[job.tolerations] = cached
+            job_tolerated[j] = cached
+        if job.node_selector:
+            sel_key = tuple(sorted(job.node_selector.items()))
+            cached = selector_cache.get(sel_key)
+            if cached is None:
+                cached = label_vocab.selector_bits(job.node_selector)
+                selector_cache[sel_key] = cached
+            job_selector[j], job_possible[j] = cached
         job_queue[j] = queue_index.get(job.queue, -1)
-        pc = config.priority_class(job.priority_class)
-        job_priority[j] = pc.priority
-        job_preemptible[j] = pc.preemptible
+
+    pc_priority_by_name = {
+        name: pc.priority for name, pc in config.priority_classes.items()
+    }
+    pc_preempt_by_name = {
+        name: pc.preemptible for name, pc in config.priority_classes.items()
+    }
+    default_pc = config.default_priority_class
+    pc_names_per_job = [
+        j.priority_class if j.priority_class in pc_priority_by_name else default_pc
+        for j in jobs
+    ]
+    job_priority[:] = [pc_priority_by_name[n] for n in pc_names_per_job]
+    job_preemptible[:] = [pc_preempt_by_name[n] for n in pc_names_per_job]
 
     for j, run in enumerate(running):
         job_is_running[j] = True
@@ -237,13 +277,24 @@ def build_round_snapshot(
 
     # Within-queue order: (job priority number asc, submitted ts asc, id asc),
     # the jobdb FairShareOrder (jobdb/jobdb.go:27-31). Encoded as a dense rank
-    # so both oracle and kernel sort identically.
-    order_tuples = sorted(
-        range(J), key=lambda j: (jobs[j].priority, jobs[j].submitted_ts, jobs[j].id)
-    )
-    job_order = np.zeros(J, dtype=np.int64)
-    for rank, j in enumerate(order_tuples):
-        job_order[j] = rank
+    # so both oracle and kernel sort identically. np.lexsort: last key primary.
+    jprio = np.asarray([j.priority for j in jobs], dtype=np.int64)
+    jts = np.asarray([j.submitted_ts for j in jobs], dtype=np.float64)
+    jids = np.asarray([j.id for j in jobs])
+    perm = np.lexsort((jids, jts, jprio))
+    job_order = np.empty(J, dtype=np.int64)
+    job_order[perm] = np.arange(J)
+
+    # Retry anti-affinity: K columns of excluded node indices per job.
+    K = max(1, int(config.max_retries))
+    job_excluded_nodes = np.full((J, K), -1, dtype=np.int32)
+    if excluded_nodes:
+        for j, job in enumerate(jobs):
+            bad = excluded_nodes.get(job.id)
+            if not bad:
+                continue
+            idxs = [node_index[n] for n in bad if n in node_index][:K]
+            job_excluded_nodes[j, : len(idxs)] = idxs
 
     # --- bind running jobs ---
     # Non-preemptible jobs are deducted at every priority row
@@ -259,61 +310,89 @@ def build_round_snapshot(
                 rows = np.ones(P, dtype=bool)
             allocatable[rows, n, :] -= req_fit[j]
 
-    # --- queue accounting ---
+    # --- queue accounting (segment sums) ---
     queue_weight = np.asarray([q.weight for q in queues], dtype=np.float64)
     queue_allocated = np.zeros((Q, R), dtype=np.int64)
     queue_demand = np.zeros((Q, R), dtype=np.int64)
-    for j in range(J):
-        q = job_queue[j]
-        if q < 0:
-            continue
-        if job_is_running[j]:
-            queue_allocated[q] += job_req[j]
-        queue_demand[q] += job_req[j]
+    if J and Q:
+        valid_q = job_queue >= 0
+        qidx = np.where(valid_q, job_queue, 0)
+        for r in range(R):
+            queue_demand[:, r] = np.bincount(
+                qidx, weights=np.where(valid_q, job_req[:, r], 0), minlength=Q
+            )[:Q]
+            queue_allocated[:, r] = np.bincount(
+                qidx,
+                weights=np.where(valid_q & job_is_running, job_req[:, r], 0),
+                minlength=Q,
+            )[:Q]
 
     # --- gangs ---
+    # Only queued jobs group into gang rows: the queue iterator in the
+    # reference sees gangs among queued work only (queue_scheduler.go:277);
+    # running gang members are handled by the gang-aware eviction pass.
+    # Singletons (the overwhelmingly common case) are built in bulk; only
+    # true gang members take the per-job path.
+    is_gang_member = np.asarray(
+        [
+            job.gang is not None and job.gang.cardinality > 1 and not job_is_running[j]
+            for j, job in enumerate(jobs)
+        ],
+        dtype=bool,
+    )
+    singles = np.flatnonzero(~is_gang_member).astype(np.int32)
+    n_single = len(singles)
+
     gang_key_to_idx: dict = {}
     gang_rows: list[dict] = []
-    job_gang = np.full(J, NO_GANG, dtype=np.int32)
-    for j, job in enumerate(jobs):
-        if job.gang is not None and job.gang.cardinality > 1 and not job_is_running[j]:
-            # Only queued jobs group into gang rows: the queue iterator in the
-            # reference sees gangs among queued work only
-            # (queue_scheduler.go:277); running gang members are handled by
-            # the gang-aware eviction pass, not re-grouped here.
-            key = (job.queue, job.gang.id)
-            card = job.gang.cardinality
-            uniformity = job.gang.node_uniformity_label
-        else:
-            key = ("", f"__single__{j}")
-            card = 1
-            uniformity = ""
+    for j in np.flatnonzero(is_gang_member):
+        job = jobs[j]
+        key = (job.queue, job.gang.id)
         g = gang_key_to_idx.get(key)
         if g is None:
             g = len(gang_rows)
             gang_key_to_idx[key] = g
             gang_rows.append(
-                {"queue": int(job_queue[j]), "card": card, "members": [],
-                 "uniformity": uniformity}
+                {
+                    "queue": int(job_queue[j]),
+                    "card": job.gang.cardinality,
+                    "members": [],
+                    "uniformity": job.gang.node_uniformity_label,
+                }
             )
-        gang_rows[g]["members"].append(j)
-        job_gang[j] = g
+        gang_rows[g]["members"].append(int(j))
 
-    G = len(gang_rows)
-    gang_queue = np.asarray([g["queue"] for g in gang_rows], dtype=np.int32)
-    gang_card = np.asarray([g["card"] for g in gang_rows], dtype=np.int32)
-    gang_uniformity_key = [g["uniformity"] for g in gang_rows]
+    G = n_single + len(gang_rows)
+    job_gang = np.full(J, NO_GANG, dtype=np.int32)
+    job_gang[singles] = np.arange(n_single, dtype=np.int32)
+
+    gang_queue = np.zeros(G, dtype=np.int32)
+    gang_card = np.ones(G, dtype=np.int32)
+    gang_uniformity_key = [""] * n_single + [g["uniformity"] for g in gang_rows]
     gang_member_offsets = np.zeros(G + 1, dtype=np.int32)
-    members_flat: list[int] = []
     gang_total_req = np.zeros((G, R), dtype=np.int64)
     gang_order = np.zeros(G, dtype=np.int64)
     gang_complete = np.zeros(G, dtype=bool)
-    for g, row in enumerate(gang_rows):
+
+    # Bulk singleton rows.
+    gang_queue[:n_single] = job_queue[singles]
+    gang_member_offsets[1 : n_single + 1] = np.arange(1, n_single + 1)
+    gang_total_req[:n_single] = job_req[singles]
+    gang_order[:n_single] = job_order[singles]
+    gang_complete[:n_single] = True
+    members_flat: list[int] = list(singles)
+
+    for gi, row in enumerate(gang_rows):
+        g = n_single + gi
         # Members in queue order; a gang becomes schedulable when its last
         # member is reached (QueuedGangIterator, queue_scheduler.go:277).
         members = sorted(row["members"], key=lambda j: job_order[j])
+        for m in members:
+            job_gang[m] = g
         members_flat.extend(members)
         gang_member_offsets[g + 1] = len(members_flat)
+        gang_queue[g] = row["queue"]
+        gang_card[g] = row["card"]
         gang_total_req[g] = job_req[members].sum(axis=0)
         gang_order[g] = max(job_order[m] for m in members)
         gang_complete[g] = len(members) == row["card"]
@@ -347,6 +426,13 @@ def build_round_snapshot(
         order_res_resolution=order_res_resolution,
         queue_names=[q.name for q in queues],
         queue_weight=queue_weight,
+        queue_cordoned=np.asarray(
+            [q.name in (cordoned_queues or set()) for q in queues], dtype=bool
+        ),
+        queue_short_penalty=factory.encode_requests_batch(
+            [(short_job_penalty or {}).get(q.name, {}) for q in queues],
+            ceil=True,
+        ),
         queue_allocated=queue_allocated,
         queue_demand=queue_demand,
         job_ids=[job.id for job in jobs],
@@ -360,6 +446,7 @@ def build_round_snapshot(
         job_is_running=job_is_running,
         job_node=job_node,
         job_order=job_order,
+        job_excluded_nodes=job_excluded_nodes,
         job_gang=job_gang,
         job_gang_id=[j.gang.id if j.gang is not None else "" for j in jobs],
         job_pc_name=[config.priority_class(j.priority_class).name for j in jobs],

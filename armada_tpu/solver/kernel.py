@@ -135,7 +135,19 @@ def _static_ok(dev, j, extra_sel):
     taints_ok = jnp.all((dev.node_taints & ~tolerated) == 0, axis=-1)
     sel_ok = bits_subset(dev.job_selector[j] | extra_sel, dev.node_labels)
     total_ok = jnp.all(dev.job_req_fit[j] <= dev.node_total, axis=-1)
-    return taints_ok & sel_ok & total_ok & ~dev.node_unschedulable & dev.job_possible[j]
+    # Retry anti-affinity: nodes earlier attempts failed on are infeasible.
+    n_idx = jnp.arange(dev.node_total.shape[0], dtype=jnp.int32)
+    excl_ok = jnp.all(
+        n_idx[:, None] != dev.job_excluded_nodes[j][None, :], axis=-1
+    )
+    return (
+        taints_ok
+        & sel_ok
+        & total_ok
+        & excl_ok
+        & ~dev.node_unschedulable
+        & dev.job_possible[j]
+    )
 
 
 def _select_at_row(dev, alloc, j, row, static_ok):
@@ -321,12 +333,13 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
     qgang_too_big = dev.queue_burst < card
     qtokens_short = carry.qtokens[q] < card
     pc_over = jnp.any(carry.qpc_alloc[q, pc] > dev.queue_pc_limit[q, pc])
+    cordoned = dev.queue_cordoned[q]
 
     blocked_code = jnp.where(
         over_round | no_tokens,
         FAIL_TERMINAL,
         jnp.where(
-            qno_tokens,
+            qno_tokens | cordoned,
             FAIL_QUEUE_TERMINAL,
             jnp.where(
                 gang_too_big,
@@ -550,11 +563,13 @@ def _schedule_pass(
         heads, has_head = _queue_heads(dev, valid)
 
         req_h = _f(dev.slot_req[heads])  # [Q, R]
-        cur = _drf_cost(c.qalloc, dev.total_resources, dev.drf_multipliers)
+        qalloc_cost = c.qalloc + _f(dev.queue_short_penalty)
+        cur = _drf_cost(qalloc_cost, dev.total_resources, dev.drf_multipliers)
         w = jnp.maximum(dev.queue_weight, 1e-12)
         current = cur / w
         proposed = (
-            _drf_cost(c.qalloc + req_h, dev.total_resources, dev.drf_multipliers) / w
+            _drf_cost(qalloc_cost + req_h, dev.total_resources, dev.drf_multipliers)
+            / w
         )
         size = (
             _drf_cost(req_h, dev.total_resources, dev.drf_multipliers)
@@ -692,7 +707,8 @@ def _assign_evict_ranks(dev, carry: Carry, budgets, prefer_large: bool):
     eligible0 = (carry.slot_state == PENDING) & slot_all_ev & (dev.slot_count > 0)
 
     w = jnp.maximum(dev.queue_weight, 1e-12)
-    cur = _drf_cost(carry.qalloc, dev.total_resources, dev.drf_multipliers) / w
+    qalloc_cost = carry.qalloc + _f(dev.queue_short_penalty)
+    cur = _drf_cost(qalloc_cost, dev.total_resources, dev.drf_multipliers) / w
 
     def cond(state):
         _, _, remaining, i = state
@@ -705,7 +721,7 @@ def _assign_evict_ranks(dev, carry: Carry, budgets, prefer_large: bool):
         req_h = _f(dev.slot_req[heads])
         proposed = (
             _drf_cost(
-                carry.qalloc + req_h, dev.total_resources, dev.drf_multipliers
+                qalloc_cost + req_h, dev.total_resources, dev.drf_multipliers
             )
             / w
         )

@@ -73,6 +73,7 @@ class DeviceRound:
     job_node: np.ndarray  # int32[J]
     job_key_group: np.ndarray  # int32[J]
     job_pc: np.ndarray  # int32[J] priority-class index
+    job_excluded_nodes: np.ndarray  # int32[J, K] retry anti-affinity
 
     # slots
     slot_members: np.ndarray  # int32[S, M] (-1 pad)
@@ -93,8 +94,10 @@ class DeviceRound:
 
     # queues
     queue_weight: np.ndarray  # float[Q]
+    queue_cordoned: np.ndarray  # bool[Q]
     queue_name_rank: np.ndarray  # int32[Q]
     queue_alloc0: np.ndarray  # sum[Q, R] running allocation (device units)
+    queue_short_penalty: np.ndarray  # sum[Q, R] anti-churn cost add-on
     queue_demand_pc: np.ndarray  # sum[Q, C, R] demand by priority class
     queue_pc_limit: np.ndarray  # float[Q, C, R] caps (+inf none)
 
@@ -127,6 +130,13 @@ jax.tree_util.register_dataclass(
     ],
     meta_fields=list(_META_FIELDS),
 )
+
+
+def _shrink(arr: np.ndarray, kept: np.ndarray, size: int, fill=0) -> np.ndarray:
+    """Filter rows by index list, re-padding to `size` rows."""
+    out = np.full((size, *arr.shape[1:]), fill, dtype=arr.dtype)
+    out[: len(kept)] = arr[kept]
+    return out
 
 
 def _pow2(n: int, floor: int = 8) -> int:
@@ -181,6 +191,7 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         job_node=pad(dev.job_node, 0, Jp, fill=NO_NODE),
         job_key_group=pad(dev.job_key_group, 0, Jp, fill=-1),
         job_pc=pad(dev.job_pc, 0, Jp),
+        job_excluded_nodes=pad(dev.job_excluded_nodes, 0, Jp, fill=-1),
         slot_members=pad(pad(dev.slot_members, 1, Mp, fill=-1), 0, Sp, fill=-1),
         slot_count=pad(dev.slot_count, 0, Sp),
         slot_queue=pad(dev.slot_queue, 0, Sp, fill=-1),
@@ -193,10 +204,12 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         queue_slot_start=pad(dev.queue_slot_start, 0, Qp),
         queue_slot_end=pad(dev.queue_slot_end, 0, Qp),
         queue_weight=pad(dev.queue_weight, 0, Qp),
+        queue_cordoned=pad(dev.queue_cordoned, 0, Qp, fill=False),
         queue_name_rank=np.concatenate(
             [np.asarray(dev.queue_name_rank), np.arange(Q, Qp, dtype=np.int32)]
         ),
         queue_alloc0=pad(dev.queue_alloc0, 0, Qp),
+        queue_short_penalty=pad(dev.queue_short_penalty, 0, Qp),
         queue_demand_pc=pad(dev.queue_demand_pc, 0, Qp),
         queue_pc_limit=pad(dev.queue_pc_limit, 0, Qp, fill=np.inf),
         queue_tokens=pad(dev.queue_tokens, 0, Qp),
@@ -228,100 +241,124 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     )
     job_pc = np.asarray([pc_index[n] for n in snap.job_pc_name], dtype=np.int32)
 
-    # Scheduling-key groups over non-running jobs.
-    key_to_group: dict = {}
+    # Scheduling-key groups over non-running jobs: one np.unique over the
+    # byte-record of (queue, priority, pc, requests, tolerations, selector).
     job_key_group = np.full(J, -1, dtype=np.int32)
-    for j in range(J):
-        if snap.job_is_running[j]:
-            continue
-        key = (
-            int(snap.job_queue[j]),
-            snap.job_req[j].tobytes(),
-            snap.job_tolerated[j].tobytes(),
-            snap.job_selector[j].tobytes(),
-            int(snap.job_priority[j]),
-            snap.job_pc_name[j],
+    qm = np.flatnonzero(~snap.job_is_running)
+    if len(qm):
+        rec = np.concatenate(
+            [
+                snap.job_queue[qm, None].astype(np.int64),
+                snap.job_priority[qm, None].astype(np.int64),
+                job_pc[qm, None].astype(np.int64),
+                snap.job_req[qm].astype(np.int64),
+                snap.job_tolerated[qm].astype(np.int64),
+                snap.job_selector[qm].astype(np.int64),
+            ],
+            axis=1,
         )
-        g = key_to_group.setdefault(key, len(key_to_group))
-        job_key_group[j] = g
-    num_key_groups = max(1, len(key_to_group))
+        _, inverse = np.unique(
+            np.ascontiguousarray(rec), axis=0, return_inverse=True
+        )
+        job_key_group[qm] = inverse.astype(np.int32)
+        num_key_groups = int(inverse.max()) + 1
+    else:
+        num_key_groups = 1
 
     # ---- slots ----
     # Segment 0: running gangs (eviction candidates), grouped by gang id.
     # Segment 1: queued gangs from the snapshot gang table (complete only).
-    slots: list[dict] = []
+    # Built as flat candidate arrays: queue, segment, order, member-range.
+    cand_queue: list = []
+    cand_segment: list = []
+    cand_order: list = []
+    cand_running: list = []
+    cand_kg: list = []
+    cand_uni: list = []
+    cand_member_lists: list = []
+
     running_groups: dict = {}
-    for j in range(J):
-        if not snap.job_is_running[j] or snap.job_queue[j] < 0:
+    for j in np.flatnonzero(snap.job_is_running):
+        j = int(j)
+        if snap.job_queue[j] < 0:
             continue
         gid = snap.job_gang_id[j]
         key = (int(snap.job_queue[j]), gid) if gid else (int(snap.job_queue[j]), f"__r{j}")
         running_groups.setdefault(key, []).append(j)
     for (q, _), members in running_groups.items():
         members = sorted(members, key=lambda x: snap.job_order[x])
-        slots.append(
-            {
-                "queue": q,
-                "segment": 0,
-                "order": max(snap.job_order[m] for m in members),
-                "members": members,
-                "running": True,
-                "key_group": -1,
-            }
-        )
-    for g in range(snap.num_gangs):
+        cand_queue.append(q)
+        cand_segment.append(0)
+        cand_order.append(int(max(snap.job_order[m] for m in members)))
+        cand_running.append(True)
+        cand_kg.append(-1)
+        cand_uni.append("")
+        cand_member_lists.append(members)
+
+    # Queued gangs straight off the gang table (first member of a queued
+    # gang row is never running: running jobs get their own rows).
+    g_first = (
+        snap.gang_members[snap.gang_member_offsets[:-1]]
+        if snap.num_gangs
+        else np.zeros(0, dtype=np.int32)
+    )
+    g_mask = (
+        snap.gang_complete
+        & (snap.gang_queue >= 0)
+        & ~snap.job_is_running[g_first]
+    )
+    for g in np.flatnonzero(g_mask):
+        g = int(g)
         members = snap.gang_members[
             snap.gang_member_offsets[g] : snap.gang_member_offsets[g + 1]
         ].tolist()
-        if snap.job_is_running[members[0]] or snap.gang_queue[g] < 0:
-            continue  # running jobs got slots above; unknown queues skipped
-        if not snap.gang_complete[g]:
-            continue  # incomplete gangs never yield (queue_scheduler.go:357)
-        kg = int(job_key_group[members[0]]) if len(members) == 1 else -1
-        slots.append(
-            {
-                "queue": int(snap.gang_queue[g]),
-                "segment": 1,
-                "order": int(snap.gang_order[g]),
-                "members": members,
-                "running": False,
-                "key_group": kg,
-            }
+        cand_queue.append(int(snap.gang_queue[g]))
+        cand_segment.append(1)
+        cand_order.append(int(snap.gang_order[g]))
+        cand_running.append(False)
+        cand_kg.append(int(job_key_group[members[0]]) if len(members) == 1 else -1)
+        cand_uni.append(
+            snap.gang_uniformity_key[g] if len(members) > 1 else ""
         )
+        cand_member_lists.append(members)
 
-    # Uniformity-value table: sorted values per uniformity key, as selector
-    # bitsets (mirrors the oracle's sorted-value iteration).
+    # Uniformity-value table: sorted values per key, as selector bitsets
+    # (mirrors the oracle's sorted-value iteration).
     uni_ranges: dict[str, tuple[int, int]] = {}
     uni_bits_rows: list[np.ndarray] = []
-    for s in slots:
-        members = s["members"]
-        g = int(snap.job_gang[members[0]])
-        key = (
-            snap.gang_uniformity_key[g]
-            if 0 <= g < snap.num_gangs and len(members) > 1 and not s["running"]
-            else ""
-        )
-        s["uniformity"] = key
-        if key and key not in uni_ranges:
-            values = sorted(
-                {v for (k, v) in snap.label_vocab.pairs if k == key}
-            )
-            start = len(uni_bits_rows)
-            for value in values:
-                bits, possible = snap.label_vocab.selector_bits({key: value})
-                if possible:
-                    uni_bits_rows.append(bits)
-            if len(uni_bits_rows) == start:
-                # No node carries this label: the gang can never satisfy its
-                # uniformity constraint ("no nodes with uniformity label",
-                # gang_scheduler.go:171-175). Sentinel (-1,-1) fails the slot.
-                uni_ranges[key] = (-1, -1)
-            else:
-                uni_ranges[key] = (start, len(uni_bits_rows))
+    for key in {u for u in cand_uni if u}:
+        values = sorted({v for (k, v) in snap.label_vocab.pairs if k == key})
+        start = len(uni_bits_rows)
+        for value in values:
+            bits, possible = snap.label_vocab.selector_bits({key: value})
+            if possible:
+                uni_bits_rows.append(bits)
+        if len(uni_bits_rows) == start:
+            # No node carries this label: the gang can never satisfy its
+            # uniformity constraint ("no nodes with uniformity label",
+            # gang_scheduler.go:171-175). Sentinel (-1,-1) fails the slot.
+            uni_ranges[key] = (-1, -1)
+        else:
+            uni_ranges[key] = (start, len(uni_bits_rows))
 
-    slots.sort(key=lambda s: (s["queue"], s["segment"], s["order"]))
-    S = max(1, len(slots))
-    M = max([1] + [len(s["members"]) for s in slots])
+    n_cand = len(cand_queue)
+    S = max(1, n_cand)
+    counts = np.asarray([len(m) for m in cand_member_lists], dtype=np.int32)
+    M = int(counts.max()) if n_cand else 1
+    M = max(1, M)
+
+    order_perm = (
+        np.lexsort(
+            (
+                np.asarray(cand_order, dtype=np.int64),
+                np.asarray(cand_segment, dtype=np.int8),
+                np.asarray(cand_queue, dtype=np.int32),
+            )
+        )
+        if n_cand
+        else np.zeros(0, dtype=np.int64)
+    )
+
     slot_members = np.full((S, M), -1, dtype=np.int32)
     slot_count = np.zeros(S, dtype=np.int32)
     slot_queue = np.full(S, -1, dtype=np.int32)
@@ -334,31 +371,66 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     queue_slot_start = np.zeros(Q, dtype=np.int32)
     queue_slot_end = np.zeros(Q, dtype=np.int32)
 
-    jobs_before = 0
-    prev_queue = -1
-    for i, s in enumerate(slots):
-        q = s["queue"]
-        if q != prev_queue:
-            jobs_before = 0
-            if prev_queue >= 0:
-                queue_slot_end[prev_queue] = i
-            if 0 <= q < Q:
-                queue_slot_start[q] = i
-            prev_queue = q
-        members = s["members"]
-        slot_members[i, : len(members)] = members
-        slot_count[i] = len(members)
-        slot_queue[i] = q
-        slot_is_running[i] = s["running"]
-        slot_req[i] = req_dev[members].sum(axis=0)
-        slot_key_group[i] = s["key_group"]
-        slot_jobs_before[i] = jobs_before
-        if s.get("uniformity"):
-            slot_uni_start[i], slot_uni_end[i] = uni_ranges[s["uniformity"]]
-        if not s["running"]:
-            jobs_before += len(members)
-    if prev_queue >= 0:
-        queue_slot_end[prev_queue] = len(slots)
+    if n_cand:
+        slot_queue[:n_cand] = np.asarray(cand_queue, dtype=np.int32)[order_perm]
+        slot_count[:n_cand] = counts[order_perm]
+        slot_is_running[:n_cand] = np.asarray(cand_running, dtype=bool)[order_perm]
+        slot_key_group[:n_cand] = np.asarray(cand_kg, dtype=np.int32)[order_perm]
+
+        # Member ranges flattened in sorted-slot order.
+        sorted_lists = [cand_member_lists[i] for i in order_perm]
+        flat = np.asarray(
+            [m for lst in sorted_lists for m in lst], dtype=np.int32
+        )
+        starts = np.zeros(n_cand, dtype=np.int64)
+        starts[1:] = np.cumsum(slot_count[:n_cand])[:-1]
+        rows = np.repeat(np.arange(n_cand), slot_count[:n_cand])
+        cols = np.arange(len(flat)) - starts[rows]
+        slot_members[rows, cols.astype(np.int64)] = flat
+        slot_req[:n_cand] = np.add.reduceat(
+            req_dev[flat].astype(np.int64), starts
+        ).astype(np.int32)
+
+        for i, uni in enumerate(np.asarray(cand_uni, dtype=object)[order_perm]):
+            if uni:
+                slot_uni_start[i], slot_uni_end[i] = uni_ranges[uni]
+
+        # Lookback accounting: queued jobs in earlier slots of the same
+        # queue. Exclusive cumsum of queued member counts, rebased per queue.
+        qcounts = np.where(slot_is_running[:n_cand], 0, slot_count[:n_cand])
+        cs = np.cumsum(qcounts) - qcounts
+        sq = slot_queue[:n_cand]
+        first_of_queue = np.searchsorted(sq, sq, side="left")
+        slot_jobs_before[:n_cand] = (cs - cs[first_of_queue]).astype(np.int32)
+
+        queue_slot_start[:] = np.searchsorted(sq, np.arange(Q), side="left")
+        queue_slot_end[:] = np.searchsorted(sq, np.arange(Q), side="right")
+
+        # Queued slots past the lookback horizon can never yield this round
+        # (stopYieldingNewJobsIfLimitHit): drop them to shrink S. Dropped
+        # slots are only ever at the tail of a queue's queued segment, so
+        # prefix counts and queue ranges stay consistent after rebasing.
+        lookback = cfg.max_queue_lookback
+        if lookback and n_cand:
+            keep = slot_is_running[:n_cand] | (
+                slot_jobs_before[:n_cand] < lookback
+            )
+            if not keep.all():
+                kept = np.flatnonzero(keep)
+                n_new = len(kept)
+                S = max(1, n_new)
+                slot_members = _shrink(slot_members, kept, S)
+                slot_count = _shrink(slot_count, kept, S)
+                sq = slot_queue[:n_cand][keep]
+                slot_queue = _shrink(slot_queue, kept, S, fill=-1)
+                slot_is_running = _shrink(slot_is_running, kept, S)
+                slot_req = _shrink(slot_req, kept, S)
+                slot_key_group = _shrink(slot_key_group, kept, S, fill=-1)
+                slot_jobs_before = _shrink(slot_jobs_before, kept, S)
+                slot_uni_start = _shrink(slot_uni_start, kept, S)
+                slot_uni_end = _shrink(slot_uni_end, kept, S)
+                queue_slot_start[:] = np.searchsorted(sq, np.arange(Q), side="left")
+                queue_slot_end[:] = np.searchsorted(sq, np.arange(Q), side="right")
 
     # ---- queue tensors ----
     queue_name_rank = np.argsort(np.argsort(snap.queue_names)).astype(np.int32)
@@ -429,6 +501,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         job_node=snap.job_node.astype(np.int32),
         job_key_group=job_key_group,
         job_pc=job_pc,
+        job_excluded_nodes=snap.job_excluded_nodes,
         slot_members=slot_members,
         slot_count=slot_count,
         slot_queue=slot_queue,
@@ -446,8 +519,12 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         queue_slot_start=queue_slot_start,
         queue_slot_end=queue_slot_end,
         queue_weight=snap.queue_weight,
+        queue_cordoned=snap.queue_cordoned,
         queue_name_rank=queue_name_rank,
         queue_alloc0=queue_alloc0,
+        queue_short_penalty=factory.to_device(
+            snap.queue_short_penalty, ceil=True
+        ).astype(np.int64),
         queue_demand_pc=queue_demand_pc,
         queue_pc_limit=queue_pc_limit,
         pc_priority=pc_priority,

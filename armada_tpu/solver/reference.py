@@ -50,6 +50,7 @@ R_GANG_NO_FIT = "unable to schedule gang since minimum cardinality not met"
 R_JOB_NO_FIT = "job does not fit on any node"
 R_QUEUE_LIMIT = "resource limit exceeded"
 R_FLOATING = "not enough floating resources available"
+R_QUEUE_CORDONED = "queue cordoned"
 
 
 def is_terminal(reason: str) -> bool:
@@ -57,7 +58,7 @@ def is_terminal(reason: str) -> bool:
 
 
 def is_queue_terminal(reason: str) -> bool:
-    return reason == R_QUEUE_RATE_LIMIT
+    return reason in (R_QUEUE_RATE_LIMIT, R_QUEUE_CORDONED)
 
 
 def reason_is_property_of_gang(reason: str) -> bool:
@@ -221,6 +222,8 @@ class ReferenceSolver:
             return False
         if snap.node_unschedulable[n]:
             return False
+        if n in snap.job_excluded_nodes[j]:
+            return False  # retry anti-affinity (scheduler.go:589-636)
         tolerated = snap.job_tolerated[j] | self.extra_tolerated[j]
         if (snap.node_taint_bits[n] & ~tolerated).any():
             return False
@@ -401,7 +404,9 @@ class ReferenceSolver:
         )
 
     def _queue_cost(self, q: int, extra=None) -> float:
-        alloc = self.queue_alloc[q]
+        # Candidate-ordering costs include the short-job penalty
+        # (GetAllocationInclShortJobPenalty, queue_scheduler.go:553-554).
+        alloc = self.queue_alloc[q] + self.snap.queue_short_penalty[q]
         if extra is not None:
             alloc = alloc + extra
         return float(
@@ -750,6 +755,9 @@ class ReferenceSolver:
             # CheckRoundConstraints
             if (self.scheduled_new > self.max_round_resources).any():
                 return self._fail(members, R_MAX_ROUND_RESOURCES)
+            # Queue cordoned (constraints.go:131-134)
+            if snap.queue_cordoned[q]:
+                return self._fail(members, R_QUEUE_CORDONED)
             # CheckJobConstraints: rate limits + per-queue-per-PC caps
             if self.global_tokens < 1:
                 return self._fail(members, R_GLOBAL_RATE_LIMIT)

@@ -1,0 +1,62 @@
+"""Structured logging for the control plane.
+
+The reference threads cycleNumber/stage fields through its contexts
+(armadacontext, scheduler.go:175, preempting_queue_scheduler.go:93). Here a
+stdlib-logging adapter carries the same structured fields; handlers render
+them as key=value suffixes.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+class _KvFormatter(logging.Formatter):
+    def format(self, record):
+        base = super().format(record)
+        extras = getattr(record, "kv", None)
+        if extras:
+            kv = " ".join(f"{k}={v}" for k, v in extras.items())
+            return f"{base} {kv}"
+        return base
+
+
+def get_logger(name: str = "armada_tpu", **fields) -> "StructuredLogger":
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            _KvFormatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return StructuredLogger(logger, fields)
+
+
+class StructuredLogger:
+    """Logger with bound fields (the WithLogField pattern)."""
+
+    def __init__(self, logger: logging.Logger, fields: dict):
+        self._logger = logger
+        self._fields = dict(fields)
+
+    def with_fields(self, **fields) -> "StructuredLogger":
+        merged = {**self._fields, **fields}
+        return StructuredLogger(self._logger, merged)
+
+    def _log(self, level, msg, *args):
+        self._logger.log(level, msg, *args, extra={"kv": self._fields})
+
+    def info(self, msg, *args):
+        self._log(logging.INFO, msg, *args)
+
+    def warning(self, msg, *args):
+        self._log(logging.WARNING, msg, *args)
+
+    def error(self, msg, *args):
+        self._log(logging.ERROR, msg, *args)
+
+    def debug(self, msg, *args):
+        self._log(logging.DEBUG, msg, *args)

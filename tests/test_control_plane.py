@@ -164,6 +164,45 @@ def test_gang_schedules_atomically_e2e():
     assert len(nodes) == 4
 
 
+def test_multi_pool_scheduling():
+    """Two executor pools; jobs schedule only onto their selector-matched
+    pool, and each pool runs its own round (scheduling_algo.go:147-188)."""
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log)
+    submit = SubmitService(config, log, scheduler=sched)
+    cpu_exec = FakeExecutor(
+        "cpu-cluster", log, sched,
+        nodes=make_nodes("cpu-cluster", count=2, cpu="16", memory="64Gi",
+                         labels={"kind": "cpu"}, pool="cpu-pool"),
+        pool="cpu-pool",
+    )
+    gpu_exec = FakeExecutor(
+        "gpu-cluster", log, sched,
+        nodes=make_nodes("gpu-cluster", count=2, cpu="16", memory="64Gi",
+                         labels={"kind": "gpu"}, pool="gpu-pool"),
+        pool="gpu-pool",
+    )
+    submit.create_queue(QueueSpec("team"))
+    submit.submit(
+        "team", "s",
+        [job(0, node_selector={"kind": "gpu"}), job(1, node_selector={"kind": "cpu"})],
+        now=0.0,
+    )
+    cpu_exec.tick(0.0)
+    gpu_exec.tick(0.0)
+    sched.cycle(now=1.0)
+    txn = sched.jobdb.read_txn()
+    j0, j1 = txn.get("job-0000"), txn.get("job-0001")
+    assert j0.latest_run.executor == "gpu-cluster"
+    assert j0.latest_run.pool == "gpu-pool"
+    assert j1.latest_run.executor == "cpu-cluster"
+    assert j1.latest_run.pool == "cpu-pool"
+
+
 def test_cancel_jobset():
     config, log, sched, submit, executor = mk_stack()
     submit.create_queue(QueueSpec("team"))

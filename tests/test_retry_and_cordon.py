@@ -1,0 +1,128 @@
+"""Retry node anti-affinity + cordoned queues, solver parity + end-to-end."""
+
+import numpy as np
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, QueueSpec
+from armada_tpu.snapshot.round import build_round_snapshot
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round, prep_device_round
+from armada_tpu.solver.reference import ReferenceSolver
+
+
+def nodes(n=2):
+    return [
+        NodeSpec(id=f"n{i}", pool="default",
+                 total_resources={"cpu": "8", "memory": "32Gi"})
+        for i in range(n)
+    ]
+
+
+def job(i, **kw):
+    return JobSpec(id=f"j{i}", queue="q", requests={"cpu": "1", "memory": "1Gi"},
+                   submitted_ts=float(i), **kw)
+
+
+def both(cfg, ns, qs, queued, **kw):
+    snap = build_round_snapshot(cfg, "default", ns, qs, [], queued, **kw)
+    oracle = ReferenceSolver(snap).solve()
+    out = solve_round(pad_device_round(prep_device_round(snap)))
+    J = snap.num_jobs
+    assert (oracle.assigned_node == out["assigned_node"][:J]).all()
+    assert (oracle.scheduled_mask == out["scheduled_mask"][:J]).all()
+    return snap, oracle
+
+
+def test_excluded_nodes_respected():
+    # n0 excluded for j0: must land on n1 (n0 is best-fit otherwise since
+    # both are identical and n0 has lower id rank)
+    snap, res = both(
+        SchedulingConfig(), nodes(2), [QueueSpec("q")], [job(0)],
+        excluded_nodes={"j0": ["n0"]},
+    )
+    assert res.scheduled_mask[0]
+    assert snap.node_ids[res.assigned_node[0]] == "n1"
+
+
+def test_all_nodes_excluded_blocks():
+    snap, res = both(
+        SchedulingConfig(), nodes(2), [QueueSpec("q")], [job(0)],
+        excluded_nodes={"j0": ["n0", "n1"]},
+    )
+    assert res.scheduled_mask.sum() == 0
+
+
+def test_cordoned_queue_blocks_new_jobs():
+    snap, res = both(
+        SchedulingConfig(),
+        nodes(2),
+        [QueueSpec("q"), QueueSpec("open")],
+        [job(0), job(1).with_(queue="open")],
+        cordoned_queues={"q"},
+    )
+    j0 = snap.job_ids.index("j0")
+    j1 = snap.job_ids.index("j1")
+    assert not res.scheduled_mask[j0]
+    assert res.scheduled_mask[j1]
+
+
+def test_e2e_failed_node_retry_avoids_node():
+    """An executor-timeout retry must not land on the failed node."""
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.jobdb import JobState
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+        executor_timeout_s=10.0,
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log)
+    submit = SubmitService(config, log, scheduler=sched)
+    submit.create_queue(QueueSpec("team"))
+    ex_a = FakeExecutor("ex-a", log, sched,
+                        nodes=make_nodes("ex-a", count=1, cpu="8"), pool="default")
+    ex_b = FakeExecutor("ex-b", log, sched,
+                        nodes=make_nodes("ex-b", count=1, cpu="8"), pool="default")
+    submit.submit("team", "s", [job(0).with_(queue="team")], now=0.0)
+    ex_a.tick(0.0)
+    ex_b.tick(0.0)
+    sched.cycle(now=1.0)
+    first_node = sched.jobdb.get("j0").latest_run.node_id
+
+    # the executor that got the job goes silent; the other keeps beating
+    survivor = ex_b if first_node.startswith("ex-a") else ex_a
+    survivor.tick(11.5)
+    sched.cycle(now=12.0)  # expiry -> requeue with failed node recorded
+    sched.cycle(now=12.5)  # reschedule
+    j = sched.jobdb.get("j0")
+    assert j.state in (JobState.LEASED, JobState.RUNNING)
+    second_node = j.latest_run.node_id
+    assert second_node != first_node
+    assert first_node in j.failed_nodes
+
+
+def test_e2e_cordoned_queue():
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.jobdb import JobState
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    config = SchedulingConfig()
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log)
+    submit = SubmitService(config, log, scheduler=sched)
+    submit.create_queue(QueueSpec("frozen"), cordoned=True)
+    ex = FakeExecutor("ex", log, sched, nodes=make_nodes("ex", count=2, cpu="8"))
+    submit.submit("frozen", "s", [job(0).with_(queue="frozen")], now=0.0)
+    ex.tick(0.0)
+    sched.cycle(now=1.0)
+    assert sched.jobdb.get("j0").state == JobState.QUEUED
+    # uncordon -> schedules
+    submit.update_queue("frozen", cordoned=False)
+    sched.cycle(now=2.0)
+    assert sched.jobdb.get("j0").state == JobState.LEASED
