@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..core.config import SchedulingConfig
-from ..core.types import Gang, JobSpec, QueueSpec
+from ..core.types import Gang, JobSpec, NodeSpec, QueueSpec
 from ..events import InMemoryEventLog
 from ..jobdb import JobState
 from ..services.fake_executor import FakeExecutor, make_nodes
@@ -128,8 +128,6 @@ class Simulator:
             nodes = []
             for ti, tmpl in enumerate(spec.node_templates):
                 for i in range(tmpl.count):
-                    from ..core.types import NodeSpec
-
                     resources = {"cpu": tmpl.cpu, "memory": tmpl.memory}
                     if tmpl.gpu not in ("0", 0, ""):
                         resources["nvidia.com/gpu"] = tmpl.gpu
@@ -182,6 +180,7 @@ class Simulator:
                             priority=tmpl.queue_priority,
                             priority_class=tmpl.priority_class,
                             requests=requests,
+                            node_selector=dict(tmpl.node_selector),
                             gang=gang if tmpl.gang_cardinality > 0 else None,
                         )
                     )
