@@ -51,6 +51,67 @@ class Toleration:
 
 
 @dataclass(frozen=True)
+class MatchExpression:
+    """One node-affinity requirement (core/v1 NodeSelectorRequirement)."""
+
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: tuple = ()
+
+    def matches(self, node_labels: dict) -> bool:
+        value = node_labels.get(self.key)
+        if self.operator == "In":
+            return value is not None and str(value) in self.values
+        if self.operator == "NotIn":
+            # k8s labels.Requirement: NotIn matches when the key is absent.
+            return value is None or str(value) not in self.values
+        if self.operator == "Exists":
+            return value is not None
+        if self.operator == "DoesNotExist":
+            return value is None
+        if self.operator == "Gt":
+            try:
+                return value is not None and int(value) > int(self.values[0])
+            except (ValueError, IndexError):
+                return False
+        if self.operator == "Lt":
+            try:
+                return value is not None and int(value) < int(self.values[0])
+            except (ValueError, IndexError):
+                return False
+        # Unknown operators match nothing (submission validates upstream;
+        # the scheduler must not crash on one malformed job).
+        return False
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    """AND of match expressions (one term of a NodeSelector)."""
+
+    expressions: tuple = ()  # tuple[MatchExpression, ...]
+
+    def matches(self, node_labels: dict) -> bool:
+        # k8s MatchNodeSelectorTerms: a nil/empty term matches no objects.
+        if not self.expressions:
+            return False
+        return all(e.matches(node_labels) for e in self.expressions)
+
+
+@dataclass(frozen=True)
+class Affinity:
+    """requiredDuringSchedulingIgnoredDuringExecution node affinity:
+    OR over terms (core/v1 NodeSelector; MatchNodeSelectorTerms in the
+    reference, nodematching.go:242-255)."""
+
+    terms: tuple = ()  # tuple[NodeSelectorTerm, ...]
+
+    def matches(self, node_labels: dict) -> bool:
+        if not self.terms:
+            return True
+        return any(t.matches(node_labels) for t in self.terms)
+
+
+@dataclass(frozen=True)
 class Gang:
     """Gang (all-or-nothing) membership, from job annotations in the
     reference (gangId/gangCardinality/gangNodeUniformityLabel)."""
@@ -72,6 +133,7 @@ class JobSpec:
     requests: dict = field(default_factory=dict)
     node_selector: dict = field(default_factory=dict)  # label -> required value
     tolerations: tuple[Toleration, ...] = ()
+    affinity: Affinity | None = None
     gang: Gang | None = None
     submitted_ts: float = 0.0
     annotations: dict = field(default_factory=dict)

@@ -21,7 +21,14 @@ import threading
 import zlib
 from dataclasses import asdict
 
-from ..core.types import Gang, JobSpec, Toleration
+from ..core.types import (
+    Affinity,
+    Gang,
+    JobSpec,
+    MatchExpression,
+    NodeSelectorTerm,
+    Toleration,
+)
 from . import model
 from .log import EventLog, LogEntry
 from .model import EventSequence
@@ -59,6 +66,25 @@ def _decode_event(d: dict):
             requests=j.get("requests", {}),
             node_selector=j.get("node_selector", {}),
             tolerations=tuple(Toleration(**t) for t in j.get("tolerations", ())),
+            affinity=(
+                Affinity(
+                    terms=tuple(
+                        NodeSelectorTerm(
+                            expressions=tuple(
+                                MatchExpression(
+                                    key=e["key"],
+                                    operator=e["operator"],
+                                    values=tuple(e.get("values", ())),
+                                )
+                                for e in term.get("expressions", ())
+                            )
+                        )
+                        for term in j["affinity"].get("terms", ())
+                    )
+                )
+                if j.get("affinity")
+                else None
+            ),
             gang=Gang(**gang) if gang else None,
             submitted_ts=j.get("submitted_ts", 0.0),
             annotations=j.get("annotations", {}),

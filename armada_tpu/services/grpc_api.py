@@ -17,7 +17,15 @@ import time as _time
 
 import grpc
 
-from ..core.types import Gang, JobSpec, QueueSpec, Toleration
+from ..core.types import (
+    Affinity,
+    Gang,
+    JobSpec,
+    MatchExpression,
+    NodeSelectorTerm,
+    QueueSpec,
+    Toleration,
+)
 from ..jobdb import JobState
 from .queryapi import JobFilter, Order
 
@@ -59,6 +67,23 @@ def job_spec_from_dict(d: dict) -> JobSpec:
         )
         for t in d.get("tolerations", ())
     )
+    affinity = None
+    if d.get("affinity"):
+        affinity = Affinity(
+            terms=tuple(
+                NodeSelectorTerm(
+                    expressions=tuple(
+                        MatchExpression(
+                            key=e["key"],
+                            operator=e.get("operator", "In"),
+                            values=tuple(str(v) for v in e.get("values", ())),
+                        )
+                        for e in term
+                    )
+                )
+                for term in d["affinity"]
+            )
+        )
     return JobSpec(
         id=d.get("id", ""),
         queue=d.get("queue", ""),
@@ -68,6 +93,7 @@ def job_spec_from_dict(d: dict) -> JobSpec:
         requests=dict(d.get("requests", {})),
         node_selector=dict(d.get("node_selector", {})),
         tolerations=tolerations,
+        affinity=affinity,
         gang=gang,
         annotations=dict(d.get("annotations", {})),
     )

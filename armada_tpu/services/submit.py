@@ -179,6 +179,15 @@ class SubmitService:
                 f"job {job.id}: unknown priority class {pc_name!r}"
             )
         job = job.with_(priority_class=pc_name)
+        if job.affinity is not None:
+            valid_ops = {"In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"}
+            for term in job.affinity.terms:
+                for expr in term.expressions:
+                    if expr.operator not in valid_ops:
+                        raise SubmissionError(
+                            f"job {job.id}: unknown affinity operator "
+                            f"{expr.operator!r}"
+                        )
         if job.gang is not None:
             if job.gang.cardinality < 1:
                 raise SubmissionError(f"job {job.id}: gang cardinality < 1")

@@ -81,6 +81,11 @@ class RoundSnapshot:
     # Nodes previous attempts failed on (retry anti-affinity,
     # scheduler.go:589-636): up to maxRetries node indices, -1 padded.
     job_excluded_nodes: np.ndarray  # int32[J, K]
+    # Node-affinity groups: jobs sharing an affinity expression share a
+    # precomputed allowed-node bitmask (NodeAffinityRequirementsMet,
+    # nodematching.go:242-255). -1 = no affinity.
+    job_affinity_group: np.ndarray  # int32[J]
+    affinity_allowed: np.ndarray  # uint32[A, ceil(N/32)] allowed-node bits
     job_gang: np.ndarray  # int32[J] -> gang table index
     # Raw gang identity per job ("" if none), for gang-aware eviction of
     # running jobs (which do not get gang table rows).
@@ -285,6 +290,30 @@ def build_round_snapshot(
     job_order = np.empty(J, dtype=np.int64)
     job_order[perm] = np.arange(J)
 
+    # Node-affinity groups: unique expressions evaluated once per node.
+    job_affinity_group = np.full(J, -1, dtype=np.int32)
+    affinity_map: dict = {}
+    aff_words = max(1, (N + 31) // 32)
+    affinity_rows: list[np.ndarray] = []
+    for j, job in enumerate(jobs):
+        if job.affinity is None or not job.affinity.terms:
+            continue
+        a = affinity_map.get(job.affinity)
+        if a is None:
+            a = len(affinity_rows)
+            affinity_map[job.affinity] = a
+            bits = np.zeros(aff_words, dtype=np.uint32)
+            for i, node in enumerate(nodes):
+                if job.affinity.matches(node.labels):
+                    bits[i // 32] |= np.uint32(1 << (i % 32))
+            affinity_rows.append(bits)
+        job_affinity_group[j] = a
+    affinity_allowed = (
+        np.stack(affinity_rows)
+        if affinity_rows
+        else np.zeros((1, aff_words), dtype=np.uint32)
+    )
+
     # Retry anti-affinity: K columns of excluded node indices per job.
     K = max(1, int(config.max_retries))
     job_excluded_nodes = np.full((J, K), -1, dtype=np.int32)
@@ -447,6 +476,8 @@ def build_round_snapshot(
         job_node=job_node,
         job_order=job_order,
         job_excluded_nodes=job_excluded_nodes,
+        job_affinity_group=job_affinity_group,
+        affinity_allowed=affinity_allowed,
         job_gang=job_gang,
         job_gang_id=[j.gang.id if j.gang is not None else "" for j in jobs],
         job_pc_name=[config.priority_class(j.priority_class).name for j in jobs],

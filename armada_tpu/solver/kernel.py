@@ -140,11 +140,19 @@ def _static_ok(dev, j, extra_sel):
     excl_ok = jnp.all(
         n_idx[:, None] != dev.job_excluded_nodes[j][None, :], axis=-1
     )
+    # Node affinity: one precomputed allowed-node bit per (group, node).
+    a = dev.job_affinity_group[j]
+    safe_a = jnp.clip(a, 0, dev.affinity_allowed.shape[0] - 1)
+    aff_bits = dev.affinity_allowed[safe_a]
+    aff_ok = (a < 0) | (
+        (aff_bits[n_idx // 32] >> (n_idx % 32).astype(jnp.uint32)) & 1
+    ).astype(bool)
     return (
         taints_ok
         & sel_ok
         & total_ok
         & excl_ok
+        & aff_ok
         & ~dev.node_unschedulable
         & dev.job_possible[j]
     )

@@ -74,6 +74,8 @@ class DeviceRound:
     job_key_group: np.ndarray  # int32[J]
     job_pc: np.ndarray  # int32[J] priority-class index
     job_excluded_nodes: np.ndarray  # int32[J, K] retry anti-affinity
+    job_affinity_group: np.ndarray  # int32[J]
+    affinity_allowed: np.ndarray  # uint32[A, ceil(N/32)]
 
     # slots
     slot_members: np.ndarray  # int32[S, M] (-1 pad)
@@ -192,6 +194,12 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         job_key_group=pad(dev.job_key_group, 0, Jp, fill=-1),
         job_pc=pad(dev.job_pc, 0, Jp),
         job_excluded_nodes=pad(dev.job_excluded_nodes, 0, Jp, fill=-1),
+        job_affinity_group=pad(dev.job_affinity_group, 0, Jp, fill=-1),
+        affinity_allowed=pad(
+            pad(dev.affinity_allowed, 1, (Np + 31) // 32),
+            0,
+            _pow2(dev.affinity_allowed.shape[0], 1),
+        ),
         slot_members=pad(pad(dev.slot_members, 1, Mp, fill=-1), 0, Sp, fill=-1),
         slot_count=pad(dev.slot_count, 0, Sp),
         slot_queue=pad(dev.slot_queue, 0, Sp, fill=-1),
@@ -502,6 +510,8 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         job_key_group=job_key_group,
         job_pc=job_pc,
         job_excluded_nodes=snap.job_excluded_nodes,
+        job_affinity_group=snap.job_affinity_group,
+        affinity_allowed=snap.affinity_allowed,
         slot_members=slot_members,
         slot_count=slot_count,
         slot_queue=slot_queue,

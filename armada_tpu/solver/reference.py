@@ -224,6 +224,11 @@ class ReferenceSolver:
             return False
         if n in snap.job_excluded_nodes[j]:
             return False  # retry anti-affinity (scheduler.go:589-636)
+        a = snap.job_affinity_group[j]
+        if a >= 0 and not (
+            snap.affinity_allowed[a, n // 32] >> np.uint32(n % 32)
+        ) & np.uint32(1):
+            return False  # node affinity (nodematching.go:242-255)
         tolerated = snap.job_tolerated[j] | self.extra_tolerated[j]
         if (snap.node_taint_bits[n] & ~tolerated).any():
             return False

@@ -1,0 +1,144 @@
+"""Node-affinity expressions (In/NotIn/Exists/DoesNotExist/Gt/Lt), with
+kernel/oracle parity. NodeAffinityRequirementsMet in the reference
+(nodematching.go:242-255)."""
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import (
+    Affinity,
+    JobSpec,
+    MatchExpression,
+    NodeSelectorTerm,
+    NodeSpec,
+    QueueSpec,
+)
+from armada_tpu.snapshot.round import build_round_snapshot
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round, prep_device_round
+from armada_tpu.solver.reference import ReferenceSolver
+
+
+def nodes():
+    return [
+        NodeSpec(id="n-a1", pool="default", labels={"zone": "a", "gen": "7"},
+                 total_resources={"cpu": "8", "memory": "32Gi"}),
+        NodeSpec(id="n-a2", pool="default", labels={"zone": "a", "gen": "5"},
+                 total_resources={"cpu": "8", "memory": "32Gi"}),
+        NodeSpec(id="n-b1", pool="default", labels={"zone": "b", "gen": "6"},
+                 total_resources={"cpu": "8", "memory": "32Gi"}),
+        NodeSpec(id="n-x", pool="default", labels={},
+                 total_resources={"cpu": "8", "memory": "32Gi"}),
+    ]
+
+
+def solve(jobs):
+    snap = build_round_snapshot(
+        SchedulingConfig(), "default", nodes(), [QueueSpec("q")], [], jobs
+    )
+    oracle = ReferenceSolver(snap).solve()
+    out = solve_round(pad_device_round(prep_device_round(snap)))
+    J = snap.num_jobs
+    assert (oracle.assigned_node == out["assigned_node"][:J]).all()
+    assert (oracle.scheduled_mask == out["scheduled_mask"][:J]).all()
+    return snap, oracle
+
+
+def aff_job(i, *terms):
+    return JobSpec(
+        id=f"j{i}", queue="q", requests={"cpu": "1", "memory": "1Gi"},
+        submitted_ts=float(i),
+        affinity=Affinity(terms=tuple(NodeSelectorTerm(expressions=t) for t in terms)),
+    )
+
+
+def placed(snap, res, jid):
+    j = snap.job_ids.index(jid)
+    assert res.scheduled_mask[j], f"{jid} not scheduled"
+    return snap.node_ids[res.assigned_node[j]]
+
+
+def test_in_operator():
+    snap, res = solve([aff_job(0, (MatchExpression("zone", "In", ("b",)),))])
+    assert placed(snap, res, "j0") == "n-b1"
+
+
+def test_notin_matches_absent_key():
+    # k8s NotIn matches nodes lacking the key too (labels.Requirement)
+    snap, res = solve([aff_job(0, (MatchExpression("zone", "NotIn", ("a",)),))])
+    assert placed(snap, res, "j0") in ("n-b1", "n-x")
+
+
+def test_empty_term_matches_nothing():
+    # k8s MatchNodeSelectorTerms: an empty term matches no objects
+    snap, res = solve([aff_job(0, ())])
+    assert res.scheduled_mask.sum() == 0
+
+
+def test_unknown_operator_rejected_at_submission():
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.submit import SubmissionError, SubmitService
+
+    submit = SubmitService(SchedulingConfig(), InMemoryEventLog())
+    submit.create_queue(QueueSpec("q"))
+    bad = aff_job(0, (MatchExpression("zone", "Equals", ("a",)),))
+    with pytest.raises(SubmissionError):
+        submit.submit("q", "s", [bad])
+
+
+def test_exists_and_doesnotexist():
+    snap, res = solve([aff_job(0, (MatchExpression("zone", "DoesNotExist"),))])
+    assert placed(snap, res, "j0") == "n-x"
+    snap, res = solve([aff_job(1, (MatchExpression("gen", "Exists"),))])
+    assert placed(snap, res, "j1") in ("n-a1", "n-a2", "n-b1")
+
+
+def test_gt_lt_numeric():
+    snap, res = solve([aff_job(0, (MatchExpression("gen", "Gt", ("6",)),))])
+    assert placed(snap, res, "j0") == "n-a1"  # gen 7 only
+    snap, res = solve([aff_job(1, (MatchExpression("gen", "Lt", ("6",)),))])
+    assert placed(snap, res, "j1") == "n-a2"  # gen 5 only
+
+
+def test_terms_are_or_expressions_are_and():
+    # (zone=a AND gen>6) OR (zone=b)
+    snap, res = solve([
+        aff_job(
+            0,
+            (MatchExpression("zone", "In", ("a",)), MatchExpression("gen", "Gt", ("6",))),
+            (MatchExpression("zone", "In", ("b",)),),
+        )
+    ])
+    assert placed(snap, res, "j0") in ("n-a1", "n-b1")
+
+
+def test_unsatisfiable_affinity_blocks():
+    snap, res = solve([aff_job(0, (MatchExpression("zone", "In", ("nowhere",)),))])
+    assert res.scheduled_mask.sum() == 0
+
+
+def test_affinity_groups_shared():
+    jobs = [aff_job(i, (MatchExpression("zone", "In", ("b",)),)) for i in range(4)]
+    snap, res = solve(jobs)
+    # all share one affinity group
+    groups = set(snap.job_affinity_group.tolist())
+    assert groups == {0}
+    assert res.scheduled_mask.sum() == 4
+    assert all(
+        snap.node_ids[res.assigned_node[j]] == "n-b1" for j in range(4)
+    )
+
+
+def test_affinity_over_grpc():
+    from armada_tpu.services.grpc_api import job_spec_from_dict
+
+    spec = job_spec_from_dict(
+        {
+            "requests": {"cpu": "1"},
+            "affinity": [[{"key": "zone", "operator": "In", "values": ["b"]}]],
+        }
+    )
+    assert spec.affinity.terms[0].expressions[0].key == "zone"
+    assert spec.affinity.matches({"zone": "b"})
+    assert not spec.affinity.matches({"zone": "a"})
