@@ -1,0 +1,128 @@
+"""Broadside: concurrent ingest + query load bench for the job-state store.
+
+The reference's broadside (internal/broadside/orchestrator/doc.go) load-tests
+the lookout database with pluggable backends, concurrent ingest and query
+actors, and JSON latency-percentile reports. Same shape here against a live
+control plane's gRPC surface:
+
+  python -m armada_tpu.clients.broadside --server HOST:PORT \
+      --duration 10 --ingest-actors 2 --query-actors 4 [--batch 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+from .grpc_client import connect
+from .load_tester import percentile
+
+
+def _actor(stop, make_fn, server, latencies, errors):
+    # One channel per actor (connection setup must not pollute op latency).
+    fn = make_fn(connect(server))
+    while not stop.is_set():
+        t0 = time.time()
+        try:
+            fn()
+            latencies.append(time.time() - t0)
+        except Exception:
+            errors.append(time.time())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="armada-tpu-broadside")
+    ap.add_argument("--server", default="127.0.0.1:50051")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--ingest-actors", type=int, default=2)
+    ap.add_argument("--query-actors", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=50)
+    args = ap.parse_args(argv)
+
+    client = connect(args.server)
+    try:
+        client.create_queue("broadside")
+    except Exception:
+        pass
+
+    stop = threading.Event()
+    ingest_lat: list[float] = []
+    query_lat: list[float] = []
+    group_lat: list[float] = []
+    errors: list[float] = []
+    threads = []
+
+    job = {"requests": {"cpu": "1", "memory": "1Gi"}}
+
+    def make_ingest(client):
+        return lambda: client.submit_jobs(
+            "broadside", f"bs-{threading.get_ident()}",
+            [dict(job) for _ in range(args.batch)],
+        )
+
+    def make_query(client):
+        return lambda: client.get_jobs(
+            filters=[{"field": "queue", "value": "broadside"}], take=100
+        )
+
+    def make_group(client):
+        return lambda: client.group_jobs(
+            "state", filters=[{"field": "queue", "value": "broadside"}]
+        )
+
+    for _ in range(args.ingest_actors):
+        threads.append(
+            threading.Thread(
+                target=_actor,
+                args=(stop, make_ingest, args.server, ingest_lat, errors),
+                daemon=True,
+            )
+        )
+    for i in range(args.query_actors):
+        make_fn, lat = (make_query, query_lat) if i % 2 == 0 else (make_group, group_lat)
+        threads.append(
+            threading.Thread(
+                target=_actor,
+                args=(stop, make_fn, args.server, lat, errors),
+                daemon=True,
+            )
+        )
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(args.duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    wall = time.time() - t0
+
+    def stats(lat):
+        return {
+            "ops": len(lat),
+            "ops_per_s": round(len(lat) / wall, 1),
+            "p50_ms": round(percentile(lat, 50) * 1000, 2),
+            "p99_ms": round(percentile(lat, 99) * 1000, 2),
+        }
+
+    print(
+        json.dumps(
+            {
+                "duration_s": round(wall, 1),
+                "ingest": {**stats(ingest_lat), "jobs_per_s": round(
+                    len(ingest_lat) * args.batch / wall, 1
+                )},
+                "get_jobs": stats(query_lat),
+                "group_jobs": stats(group_lat),
+                "errors": len(errors),
+            }
+        )
+    )
+    return 0 if not errors else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

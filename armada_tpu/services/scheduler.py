@@ -55,6 +55,7 @@ class SchedulerService:
         backend: str = "oracle",
         queues: list[QueueSpec] | None = None,
         is_leader=lambda: True,
+        runner=None,
     ):
         self.config = config
         self.log = log
@@ -75,6 +76,10 @@ class SchedulerService:
         from ..utils.logging import get_logger
 
         self.log_ = get_logger("armada_tpu.scheduler")
+        from .runner import SyncRunner
+
+        # Sync or async scheduling runner (runner/types.go seam).
+        self.runner = runner if runner is not None else SyncRunner()
 
     def attach_metrics(self, metrics):
         self.metrics = metrics
@@ -104,9 +109,10 @@ class SchedulerService:
             )
         self.priority_overrides[queue] = pf
 
-    def _effective_queue(self, name: str) -> QueueSpec:
+    def _effective_queue(self, name: str, overrides: dict | None = None) -> QueueSpec:
+        overrides = overrides if overrides is not None else self.priority_overrides
         spec = self.queues.get(name, QueueSpec(name))
-        override = self.priority_overrides.get(name)
+        override = overrides.get(name)
         if override is not None:
             spec = QueueSpec(name, override)
         return spec
@@ -136,21 +142,25 @@ class SchedulerService:
         sequences: list[EventSequence] = []
         sequences += self._expire_stale_executors(now)
 
-        pools = {hb.pool for hb in self.executors.values()} or {
-            p.name for p in self.config.pools
-        }
-        # Pools schedule sequentially against the same jobdb snapshot; jobs
-        # leased by an earlier pool are excluded from later pools (the
-        # reference writes each pool's results into the jobdb txn,
-        # scheduling_algo.go:147-188).
-        leased_this_cycle: set[str] = set()
-        for pool in sorted(pools):
-            pool_seqs = self._schedule_pool(pool, now, exclude=leased_this_cycle)
-            for seq in pool_seqs:
-                for event in seq.events:
-                    if isinstance(event, JobRunLeased):
-                        leased_this_cycle.add(event.job_id)
-            sequences += pool_seqs
+        # Scheduling through the runner seam: sync solves inline; async
+        # applies the previous solve's result first and only starts the next
+        # solve AFTER those results are published and ingested (otherwise the
+        # new solve would see already-leased jobs as still queued and lease
+        # them twice). A failed background solve must not abort the cycle:
+        # expiry events still publish, and the next cycle solves again.
+        try:
+            finished = self.runner.poll()
+            if finished is not None:
+                sequences += finished
+        except Exception as e:
+            self.log_.with_fields(cycle=self.cycle_count).error(
+                "background solve failed: %r", e
+            )
+        if self.runner.idle and self.runner.synchronous:
+            self.runner.submit(lambda now=now: self._schedule_all_pools(now))
+            finished = self.runner.poll()
+            if finished is not None:
+                sequences += finished
 
         # Periodic pruning of old terminal jobs keeps the jobdb (and the
         # penalty scan) bounded, like the reference's DB pruners.
@@ -162,7 +172,38 @@ class SchedulerService:
         for seq in sequences:
             self.log.publish(seq)
         self.ingester.sync()  # optimistic immediate apply (same process)
+
+        if self.runner.idle and not self.runner.synchronous:
+            self.runner.submit(lambda now=now: self._schedule_all_pools(now))
         self.cycle_count += 1
+        return sequences
+
+    def _schedule_all_pools(self, now: float) -> list[EventSequence]:
+        """Per-pool rounds against one jobdb snapshot; jobs leased by an
+        earlier pool are excluded from later pools (the reference writes
+        each pool's results into the jobdb txn, scheduling_algo.go:147-188).
+
+        All shared mutable inputs are snapshotted up front: this may run on
+        the async runner's background thread while gRPC/cycle threads mutate
+        the originals."""
+        executors = dict(self.executors)
+        cordoned = set(self.cordoned_queues)
+        overrides = dict(self.priority_overrides)
+        pools = {hb.pool for hb in executors.values()} or {
+            p.name for p in self.config.pools
+        }
+        sequences: list[EventSequence] = []
+        leased_this_cycle: set[str] = set()
+        for pool in sorted(pools):
+            pool_seqs = self._schedule_pool(
+                pool, now, exclude=leased_this_cycle,
+                executors=executors, cordoned=cordoned, overrides=overrides,
+            )
+            for seq in pool_seqs:
+                for event in seq.events:
+                    if isinstance(event, JobRunLeased):
+                        leased_this_cycle.add(event.job_id)
+            sequences += pool_seqs
         return sequences
 
     def _expire_stale_executors(self, now: float) -> list[EventSequence]:
@@ -204,10 +245,17 @@ class SchedulerService:
             )
         return sequences
 
-    def _build_pool_inputs(self, pool: str, exclude: set[str] = frozenset()):
+    def _build_pool_inputs(
+        self,
+        pool: str,
+        exclude: set[str] = frozenset(),
+        executors: dict | None = None,
+        overrides: dict | None = None,
+    ):
+        executors = executors if executors is not None else dict(self.executors)
         nodes: list[NodeSpec] = []
         node_executor: dict[str, str] = {}
-        for hb in self.executors.values():
+        for hb in executors.values():
             if hb.pool != pool:
                 continue
             for node in hb.nodes:
@@ -235,7 +283,9 @@ class SchedulerService:
             j.id: list(j.failed_nodes) for j in queued_jobs if j.failed_nodes
         }
         queue_names = {j.queue for j in queued} | {r.job.queue for r in running}
-        queues = [self._effective_queue(name) for name in sorted(queue_names)]
+        queues = [
+            self._effective_queue(name, overrides) for name in sorted(queue_names)
+        ]
         return nodes, queues, running, queued, node_executor, txn, excluded_nodes
 
     def _short_job_penalties(self, txn, pool: str, now: float) -> dict:
@@ -266,7 +316,13 @@ class SchedulerService:
         return penalties
 
     def _schedule_pool(
-        self, pool: str, now: float, exclude: set[str] = frozenset()
+        self,
+        pool: str,
+        now: float,
+        exclude: set[str] = frozenset(),
+        executors: dict | None = None,
+        cordoned: set | None = None,
+        overrides: dict | None = None,
     ) -> list[EventSequence]:
         (
             nodes,
@@ -276,7 +332,7 @@ class SchedulerService:
             node_executor,
             txn,
             excluded_nodes,
-        ) = self._build_pool_inputs(pool, exclude)
+        ) = self._build_pool_inputs(pool, exclude, executors, overrides)
         if not nodes or not (queued or running):
             return []
         snap = build_round_snapshot(
@@ -287,7 +343,7 @@ class SchedulerService:
             running,
             queued,
             excluded_nodes=excluded_nodes,
-            cordoned_queues=self.cordoned_queues,
+            cordoned_queues=cordoned if cordoned is not None else self.cordoned_queues,
             short_job_penalty=self._short_job_penalties(txn, pool, now),
         )
         solve_started = _time.time()

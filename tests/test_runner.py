@@ -1,0 +1,94 @@
+"""Sync vs async scheduling runners (runner/{sync,async}.go seam)."""
+
+import time
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, QueueSpec
+from armada_tpu.events import InMemoryEventLog
+from armada_tpu.jobdb import JobState
+from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+from armada_tpu.services.runner import AsyncRunner, SyncRunner
+from armada_tpu.services.scheduler import SchedulerService
+from armada_tpu.services.submit import SubmitService
+
+
+def test_async_runner_state_machine():
+    r = AsyncRunner()
+    assert r.idle
+    started = time.time()
+    r.submit(lambda: (time.sleep(0.2), "result")[1])
+    assert time.time() - started < 0.1  # submit returns immediately
+    assert not r.idle
+    assert r.poll() is None  # still running
+    assert r.wait(5.0)
+    assert r.poll() == "result"
+    assert r.idle
+
+
+def test_async_runner_surfaces_errors():
+    r = AsyncRunner()
+
+    def boom():
+        raise RuntimeError("solve failed")
+
+    r.submit(boom)
+    r.wait(5.0)
+    try:
+        r.poll()
+        assert False, "expected error"
+    except RuntimeError as e:
+        assert "solve failed" in str(e)
+    assert r.idle  # recovered
+
+
+def _stack(runner):
+    config = SchedulingConfig()
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, runner=runner)
+    submit = SubmitService(config, log, scheduler=sched)
+    submit.create_queue(QueueSpec("q"))
+    ex = FakeExecutor("ex", log, sched, nodes=make_nodes("ex", count=2, cpu="8"))
+    return sched, submit, ex
+
+
+def test_async_scheduling_end_to_end():
+    sched, submit, ex = _stack(AsyncRunner())
+    submit.submit(
+        "q", "s",
+        [JobSpec(id=f"j{i}", queue="q", requests={"cpu": "1", "memory": "1Gi"})
+         for i in range(4)],
+        now=0.0,
+    )
+    ex.tick(0.0)
+    # Cycle 1 kicks off the background solve; results land on a later cycle.
+    sched.cycle(now=1.0)
+    sched.runner.wait(10.0)
+    sched.cycle(now=2.0)
+    txn = sched.jobdb.read_txn()
+    leased = [j for j in txn.all_jobs() if j.state == JobState.LEASED]
+    assert len(leased) == 4
+
+
+def test_sync_and_async_agree():
+    results = {}
+    for name, runner in [("sync", SyncRunner()), ("async", AsyncRunner())]:
+        sched, submit, ex = _stack(runner)
+        submit.submit(
+            "q", "s",
+            [JobSpec(id=f"j{i}", queue="q",
+                     requests={"cpu": "2", "memory": "1Gi"}, submitted_ts=i)
+             for i in range(6)],
+            now=0.0,
+        )
+        ex.tick(0.0)
+        for t in (1.0, 2.0, 3.0):
+            sched.cycle(now=t)
+            if hasattr(sched.runner, "wait"):
+                sched.runner.wait(10.0)
+        sched.cycle(now=4.0)
+        txn = sched.jobdb.read_txn()
+        results[name] = {
+            j.id: (j.state.value, j.latest_run.node_id if j.latest_run else "")
+            for j in txn.all_jobs()
+        }
+    assert results["sync"] == results["async"]

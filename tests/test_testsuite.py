@@ -72,6 +72,20 @@ def test_load_tester(plane, capsys):
     assert '"completed": 20' in out
 
 
+def test_broadside(plane, capsys):
+    import json
+
+    from armada_tpu.clients.broadside import main
+
+    rc = main(["--server", plane.address, "--duration", "2",
+               "--ingest-actors", "1", "--query-actors", "2", "--batch", "5"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    report = json.loads(out)
+    assert rc == 0 and report["errors"] == 0
+    assert report["ingest"]["ops"] > 0
+    assert report["get_jobs"]["ops"] > 0
+
+
 def test_simulator_cli(tmp_path, capsys):
     from armada_tpu.sim.cli import main
 
