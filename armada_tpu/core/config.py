@@ -111,6 +111,23 @@ class SchedulingConfig:
     # Terminal jobs older than this are pruned from the in-memory store
     # (the reference's lookout/scheduler DB pruners).
     terminal_job_retention_s: float = 24 * 3600.0
+    # Market-driven scheduling (experimental in the reference,
+    # scheduling_algo.go:795-813): candidates ordered by bid price instead
+    # of fair share; every bound job is evictable each round; a spot price
+    # is recorded once scheduled cost crosses the cutoff fraction.
+    market_driven: bool = False
+    spot_price_cutoff: float = 0.0
+
+    # Regex classifier for run errors -> failure category
+    # (internal/executor/categorizer/classifier.go): first match wins.
+    error_categories: tuple = (
+        # Specific rules precede general ones (first match wins).
+        (r"(?i)executor .* timed out", "lost-executor"),
+        (r"(?i)out of memory|oom", "oom"),
+        (r"(?i)timed out|timeout|deadline", "timeout"),
+        (r"(?i)image.*pull|pull.*image", "image-pull"),
+        (r"(?i)evicted|preempt", "preempted"),
+    )
 
     def resource_factory(self) -> ResourceListFactory:
         return ResourceListFactory.create(

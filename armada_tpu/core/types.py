@@ -137,6 +137,22 @@ class JobSpec:
     gang: Gang | None = None
     submitted_ts: float = 0.0
     annotations: dict = field(default_factory=dict)
+    # Market mode: bid price per pool (pkg/bidstore; job.GetBidPrice).
+    bid_prices: dict = field(default_factory=dict)
+
+    def bid_price(self, pool: str) -> float:
+        """Bid for this pool; malformed user-supplied values count as 0
+        (one bad annotation must not abort scheduling rounds)."""
+        for key in (pool, ""):
+            if key in self.bid_prices:
+                try:
+                    return float(self.bid_prices[key])
+                except (TypeError, ValueError):
+                    return 0.0
+        try:
+            return float(self.annotations.get("armadaproject.io/bidPrice", 0.0))
+        except (TypeError, ValueError):
+            return 0.0
 
     def with_(self, **kw) -> "JobSpec":
         return replace(self, **kw)

@@ -12,19 +12,20 @@ idempotent application).
 
 from __future__ import annotations
 
+import re
 from dataclasses import replace
 
 from .. import events as ev
 from .jobdb import Job, JobDb, JobRun, JobState, RunState
 
 
-def apply_entry(txn, entry) -> None:
+def apply_entry(txn, entry, error_rules=()) -> None:
     seq: ev.EventSequence = entry.sequence
     for event in seq.events:
-        _apply_event(txn, seq, event)
+        _apply_event(txn, seq, event, error_rules)
 
 
-def _apply_event(txn, seq: ev.EventSequence, event) -> None:
+def _apply_event(txn, seq: ev.EventSequence, event, error_rules=()) -> None:
     if isinstance(event, ev.SubmitJob):
         if txn.get(event.job.id) is not None:
             return  # idempotent replay
@@ -90,20 +91,37 @@ def _apply_event(txn, seq: ev.EventSequence, event) -> None:
             failed_nodes = job.failed_nodes + ((run.node_id,) if run.node_id else ())
             txn.upsert(
                 job.with_(runs=job.runs[:-1] + (run,), failed_nodes=failed_nodes,
-                          error=event.error)
+                          error=event.error,
+                          error_category=categorize_error(event.error, error_rules))
             )
     elif isinstance(event, ev.JobRequeued):
         txn.upsert(job.with_(state=JobState.QUEUED))
     elif isinstance(event, ev.JobErrors):
-        txn.upsert(job.with_(state=JobState.FAILED, error=event.error))
+        txn.upsert(
+            job.with_(
+                state=JobState.FAILED,
+                error=event.error,
+                error_category=categorize_error(event.error, error_rules),
+            )
+        )
+
+
+def categorize_error(error: str, rules) -> str:
+    """First-match regex classification of a run error
+    (internal/executor/categorizer/classifier.go)."""
+    for pattern, category in rules or ():
+        if re.search(pattern, error or ""):
+            return category
+    return "uncategorised" if error else ""
 
 
 class SchedulerIngester:
     """Cursor-tracked consumer materializing the log into a JobDb."""
 
-    def __init__(self, log, jobdb: JobDb):
+    def __init__(self, log, jobdb: JobDb, error_rules=()):
         self.log = log
         self.jobdb = jobdb
+        self.error_rules = error_rules
         self.cursor = 0
 
     def sync(self, limit: int = 10_000) -> int:
@@ -116,7 +134,7 @@ class SchedulerIngester:
             txn = self.jobdb.write_txn()
             try:
                 for entry in entries:
-                    apply_entry(txn, entry)
+                    apply_entry(txn, entry, self.error_rules)
                 txn.commit()
             except Exception:
                 txn.abort()

@@ -79,6 +79,7 @@ class Job:
     # scheduler.go:589-636).
     failed_nodes: tuple = ()
     error: str = ""
+    error_category: str = ""
 
     @property
     def id(self) -> str:

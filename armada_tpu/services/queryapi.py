@@ -44,6 +44,7 @@ class JobRow:
     executor: str
     attempts: int
     error: str
+    error_category: str
 
     @staticmethod
     def from_job(job) -> "JobRow":
@@ -60,6 +61,7 @@ class JobRow:
             executor=run.executor if run else "",
             attempts=job.num_attempts,
             error=job.error,
+            error_category=job.error_category,
         )
 
 

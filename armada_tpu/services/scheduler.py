@@ -60,7 +60,9 @@ class SchedulerService:
         self.config = config
         self.log = log
         self.jobdb = JobDb()
-        self.ingester = SchedulerIngester(log, self.jobdb)
+        self.ingester = SchedulerIngester(
+            log, self.jobdb, error_rules=config.error_categories
+        )
         self.backend = backend
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
         self.priority_overrides: dict[str, float] = {}

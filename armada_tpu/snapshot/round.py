@@ -92,6 +92,8 @@ class RoundSnapshot:
     job_gang_id: list
     # Resolved priority-class name per job (after defaulting).
     job_pc_name: list
+    # Market mode: bid price per job for this snapshot's pool.
+    job_bid: np.ndarray  # float64[J]
 
     # --- gangs (every job belongs to exactly one; singletons common) ---
     gang_queue: np.ndarray  # int32[G]
@@ -286,7 +288,13 @@ def build_round_snapshot(
     jprio = np.asarray([j.priority for j in jobs], dtype=np.int64)
     jts = np.asarray([j.submitted_ts for j in jobs], dtype=np.float64)
     jids = np.asarray([j.id for j in jobs])
-    perm = np.lexsort((jids, jts, jprio))
+    job_bid = np.asarray([j.bid_price(pool) for j in jobs], dtype=np.float64)
+    if config.market_driven:
+        # PriceOrder (jobdb MarketJobPriorityComparer): highest bid first,
+        # then submit time, then id.
+        perm = np.lexsort((jids, jts, -job_bid))
+    else:
+        perm = np.lexsort((jids, jts, jprio))
     job_order = np.empty(J, dtype=np.int64)
     job_order[perm] = np.arange(J)
 
@@ -481,6 +489,7 @@ def build_round_snapshot(
         job_gang=job_gang,
         job_gang_id=[j.gang.id if j.gang is not None else "" for j in jobs],
         job_pc_name=[config.priority_class(j.priority_class).name for j in jobs],
+        job_bid=job_bid,
         gang_queue=gang_queue,
         gang_card=gang_card,
         gang_member_offsets=gang_member_offsets,

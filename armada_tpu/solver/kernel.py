@@ -71,6 +71,9 @@ class Carry(NamedTuple):
     qtokens: jax.Array  # float[Q]
     scheduled_new: jax.Array  # float[R]
     floating: jax.Array  # float[R] pool floating-resource allocation
+    # Market mode: cumulative gang cost until the spot price is set.
+    spot_cost: jax.Array  # float[R]
+    spot_price: jax.Array  # float scalar (nan until set)
     stop: jax.Array  # bool
     loops: jax.Array  # int32
 
@@ -468,6 +471,21 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
         new_carry.floating + jnp.where(dev.floating_mask, req, 0.0),
         new_carry.floating,
     )
+    if dev.market_driven:
+        unset = jnp.isnan(new_carry.spot_price)
+        spot_cost = jnp.where(
+            ok & unset, new_carry.spot_cost + req, new_carry.spot_cost
+        )
+        crossed = (
+            _drf_cost(spot_cost, dev.total_resources, dev.drf_multipliers)
+            > dev.spot_price_cutoff
+        )
+        spot_price = jnp.where(
+            ok & unset & crossed, dev.slot_price[s], new_carry.spot_price
+        )
+    else:
+        spot_cost = new_carry.spot_cost
+        spot_price = new_carry.spot_price
     # Member placement failures are gang-property reasons (JobDoesNotFit /
     # GangDoesNotFit, constraints.go:59-61).
     fail_code = jnp.where(blocked_code != OK, blocked_code, FAIL_GANG_PROPERTY)
@@ -479,6 +497,8 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
         qtokens=qtokens,
         scheduled_new=scheduled_new,
         floating=floating,
+        spot_cost=spot_cost,
+        spot_price=spot_price,
         slot_state=new_carry.slot_state.at[s].set(
             jnp.where(ok, DONE, FAILED).astype(jnp.int8)
         ),
@@ -586,15 +606,19 @@ def _schedule_pass(
         pcp = jax.vmap(lambda s: _slot_min_prio(dev, c, s))(heads)
 
         keys = []
-        if consider_priority:
+        if dev.market_driven:
+            # Highest gang price first (market_iterator.go).
+            keys.append(-dev.slot_price[heads])
+        elif consider_priority:
             keys.append(-pcp)
-        if prefer_large:
-            over = (proposed > budgets).astype(jnp.int32)
-            k1 = jnp.where(over == 1, proposed, current)
-            k2 = jnp.where(over == 1, 0.0, -size)
-            keys += [over, k1, k2]
-        else:
-            keys.append(proposed)
+        if not dev.market_driven:
+            if prefer_large:
+                over = (proposed > budgets).astype(jnp.int32)
+                k1 = jnp.where(over == 1, proposed, current)
+                k2 = jnp.where(over == 1, 0.0, -size)
+                keys += [over, k1, k2]
+            else:
+                keys.append(proposed)
         keys.append(dev.queue_name_rank)
 
         qstar, any_head = lex_argmin(keys, has_head)
@@ -738,7 +762,9 @@ def _assign_evict_ranks(dev, carry: Carry, budgets, prefer_large: bool):
             * dev.queue_weight
         )
         keys = []
-        if prefer_large:
+        if dev.market_driven:
+            keys.append(-dev.slot_price[heads])
+        elif prefer_large:
             over = (proposed > budgets).astype(jnp.int32)
             keys += [over, jnp.where(over == 1, proposed, cur),
                      jnp.where(over == 1, 0.0, -size)]
@@ -847,6 +873,8 @@ def solve_impl(dev: DeviceRound):
             ),
             axis=0,
         ),
+        spot_cost=jnp.zeros(R, fdt),
+        spot_price=jnp.asarray(jnp.nan, fdt),
         stop=jnp.zeros((), bool),
         loops=jnp.zeros((), jnp.int32),
     )
@@ -869,13 +897,20 @@ def solve_impl(dev: DeviceRound):
     fraction = jnp.where(fs > 0, actual_cost / fs, jnp.inf)
     evict_queue = fraction > dev.protected_fraction
     qidx = jnp.clip(dev.job_queue, 0, Q - 1)
-    evict0 = (
-        dev.job_is_running
-        & dev.job_preemptible
-        & (dev.job_queue >= 0)
-        & (carry.job_node >= 0)
-        & evict_queue[qidx]
-    )
+    if dev.market_driven:
+        # Market mode: everything bound is evictable; price order decides
+        # who returns (preempting_queue_scheduler.go:117-119).
+        evict0 = (
+            dev.job_is_running & (dev.job_queue >= 0) & (carry.job_node >= 0)
+        )
+    else:
+        evict0 = (
+            dev.job_is_running
+            & dev.job_preemptible
+            & (dev.job_queue >= 0)
+            & (carry.job_node >= 0)
+            & evict_queue[qidx]
+        )
     evict0 = _gang_complete_mask(dev, carry, evict0)
     carry = _apply_evictions(dev, carry, evict0)
     carry = _assign_evict_ranks(dev, carry, budgets, dev.prefer_large)
@@ -952,6 +987,7 @@ def solve_impl(dev: DeviceRound):
         "demand_capped_fair_share": demand_capped,
         "uncapped_fair_share": uncapped,
         "num_loops": carry.loops,
+        "spot_price": carry.spot_price,
     }
 
 

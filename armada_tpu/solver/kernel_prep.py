@@ -37,6 +37,7 @@ _META_FIELDS = (
     "queue_burst",
     "prefer_large",
     "num_key_groups",
+    "market_driven",
 )
 
 
@@ -90,6 +91,7 @@ class DeviceRound:
     # no uniformity constraint. Each value is a selector bitset.
     slot_uni_start: np.ndarray  # int32[S]
     slot_uni_end: np.ndarray  # int32[S]
+    slot_price: np.ndarray  # float[S] market gang price (min member bid)
     uni_value_bits: np.ndarray  # uint32[V, Wl]
     queue_slot_start: np.ndarray  # int32[Q]
     queue_slot_end: np.ndarray  # int32[Q]
@@ -123,6 +125,9 @@ class DeviceRound:
     queue_tokens: np.ndarray  # float[Q]
     prefer_large: bool
     num_key_groups: int
+    market_driven: bool
+    spot_price_cutoff: np.ndarray  # float scalar
+    job_bid: np.ndarray  # float64[J]
 
 
 jax.tree_util.register_dataclass(
@@ -209,6 +214,8 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         slot_jobs_before=pad(dev.slot_jobs_before, 0, Sp),
         slot_uni_start=pad(dev.slot_uni_start, 0, Sp),
         slot_uni_end=pad(dev.slot_uni_end, 0, Sp),
+        slot_price=pad(dev.slot_price, 0, Sp),
+        job_bid=pad(dev.job_bid, 0, Jp),
         queue_slot_start=pad(dev.queue_slot_start, 0, Qp),
         queue_slot_end=pad(dev.queue_slot_end, 0, Qp),
         queue_weight=pad(dev.queue_weight, 0, Qp),
@@ -355,11 +362,18 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     M = int(counts.max()) if n_cand else 1
     M = max(1, M)
 
+    # Market mode merges evicted and queued candidates by price-rank order
+    # (MarketDrivenMultiJobsIterator) instead of evicted-first chaining.
+    seg_for_sort = (
+        np.zeros(n_cand, dtype=np.int8)
+        if cfg.market_driven
+        else np.asarray(cand_segment, dtype=np.int8)
+    )
     order_perm = (
         np.lexsort(
             (
                 np.asarray(cand_order, dtype=np.int64),
-                np.asarray(cand_segment, dtype=np.int8),
+                seg_for_sort,
                 np.asarray(cand_queue, dtype=np.int32),
             )
         )
@@ -376,6 +390,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     slot_jobs_before = np.zeros(S, dtype=np.int32)
     slot_uni_start = np.zeros(S, dtype=np.int32)
     slot_uni_end = np.zeros(S, dtype=np.int32)
+    slot_price = np.zeros(S, dtype=np.float64)
     queue_slot_start = np.zeros(Q, dtype=np.int32)
     queue_slot_end = np.zeros(Q, dtype=np.int32)
 
@@ -398,6 +413,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         slot_req[:n_cand] = np.add.reduceat(
             req_dev[flat].astype(np.int64), starts
         ).astype(np.int32)
+        slot_price[:n_cand] = np.minimum.reduceat(snap.job_bid[flat], starts)
 
         for i, uni in enumerate(np.asarray(cand_uni, dtype=object)[order_perm]):
             if uni:
@@ -437,6 +453,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
                 slot_jobs_before = _shrink(slot_jobs_before, kept, S)
                 slot_uni_start = _shrink(slot_uni_start, kept, S)
                 slot_uni_end = _shrink(slot_uni_end, kept, S)
+                slot_price = _shrink(slot_price, kept, S)
                 queue_slot_start[:] = np.searchsorted(sq, np.arange(Q), side="left")
                 queue_slot_end[:] = np.searchsorted(sq, np.arange(Q), side="right")
 
@@ -521,6 +538,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         slot_jobs_before=slot_jobs_before,
         slot_uni_start=slot_uni_start,
         slot_uni_end=slot_uni_end,
+        slot_price=slot_price,
         uni_value_bits=(
             np.stack(uni_bits_rows)
             if uni_bits_rows
@@ -552,4 +570,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         queue_tokens=np.full(Q, float(limits.maximum_per_queue_scheduling_burst)),
         prefer_large=cfg.enable_prefer_large_job_ordering,
         num_key_groups=num_key_groups,
+        market_driven=cfg.market_driven,
+        spot_price_cutoff=np.float64(cfg.spot_price_cutoff),
+        job_bid=snap.job_bid,
     )

@@ -98,6 +98,8 @@ class ReferenceSolver:
         self.max_lookback = cfg.max_queue_lookback
         self.consider_priority = cfg.consider_priority_class_priority
         self.prefer_large = cfg.enable_prefer_large_job_ordering
+        self.market_driven = cfg.market_driven
+        self.spot_price_cutoff = cfg.spot_price_cutoff
         limits = cfg.rate_limits
         self.global_burst = limits.maximum_scheduling_burst
         self.queue_burst = limits.maximum_per_queue_scheduling_burst
@@ -175,6 +177,8 @@ class ReferenceSolver:
         self.job_reason = [""] * snap.num_jobs
         self.termination_reason = ""
         self.num_loops = 0
+        self.spot_price: float | None = None
+        self.sched_cost_accum = np.zeros(snap.factory.num_resources, dtype=np.int64)
 
     def _checkpoint(self):
         return (
@@ -441,10 +445,16 @@ class ReferenceSolver:
                 continue
             if j in self.evicted:
                 continue
-            if not snap.job_preemptible[j]:
-                continue
             q = int(snap.job_queue[j])
             if q < 0:
+                continue
+            if self.market_driven:
+                # Market mode: every bound job is evictable each round;
+                # price order decides who returns
+                # (preempting_queue_scheduler.go:117-119).
+                to_evict.append(j)
+                continue
+            if not snap.job_preemptible[j]:
                 continue
             if evict_queue[q]:
                 to_evict.append(j)
@@ -596,9 +606,21 @@ class ReferenceSolver:
                     ),
                     key=lambda j: snap.job_order[j],
                 )
-            streams[q] = _QueueStream(
-                jobs=ev + qd, is_evicted=[True] * len(ev) + [False] * len(qd)
-            )
+            if self.market_driven:
+                # Market mode merges evicted and queued by price order
+                # (MarketDrivenMultiJobsIterator), not evicted-first.
+                merged = sorted(
+                    [(j, True) for j in ev] + [(j, False) for j in qd],
+                    key=lambda item: snap.job_order[item[0]],
+                )
+                streams[q] = _QueueStream(
+                    jobs=[j for j, _ in merged],
+                    is_evicted=[e for _, e in merged],
+                )
+            else:
+                streams[q] = _QueueStream(
+                    jobs=ev + qd, is_evicted=[True] * len(ev) + [False] * len(qd)
+                )
         return streams
 
     def _evicted_gang_cardinality(self) -> dict:
@@ -724,10 +746,21 @@ class ReferenceSolver:
                     only_evicted_queues.add(q)
             self.num_loops += 1
 
+    def _gang_price(self, members) -> float:
+        """A gang's market price: the lowest member bid (the price-setting
+        member, queue_scheduler.go:145-160)."""
+        return float(min(self.snap.job_bid[m] for m in members))
+
     def _pq_less(self, a, b, consider_priority: bool, budgets) -> bool:
-        """QueueCandidateGangIteratorPQ.Less (queue_scheduler.go:628-674)."""
-        (qa, _, _, prop_a, cur_a, size_a, pcp_a) = a
-        (qb, _, _, prop_b, cur_b, size_b, pcp_b) = b
+        """QueueCandidateGangIteratorPQ.Less (queue_scheduler.go:628-674);
+        market mode orders by highest gang price (market_iterator.go)."""
+        (qa, ma, _, prop_a, cur_a, size_a, pcp_a) = a
+        (qb, mb, _, prop_b, cur_b, size_b, pcp_b) = b
+        if self.market_driven:
+            pa, pb = self._gang_price(ma), self._gang_price(mb)
+            if pa != pb:
+                return pa > pb
+            return self.snap.queue_names[qa] < self.snap.queue_names[qb]
         if consider_priority and pcp_a != pcp_b:
             return pcp_a > pcp_b
         if self.prefer_large:
@@ -798,6 +831,15 @@ class ReferenceSolver:
             if not all_evicted:
                 self.global_tokens -= card
                 self.queue_tokens[q] -= card
+            if self.market_driven and self.spot_price is None:
+                self.sched_cost_accum += snap.job_req[members].sum(axis=0)
+                total_cost = drf.unweighted_cost(
+                    self.sched_cost_accum.astype(np.float64), self.total, self.mult
+                )
+                if total_cost > self.spot_price_cutoff:
+                    # Spot price: the lowest bid in the crossing gang
+                    # (queue_scheduler.go:145-160).
+                    self.spot_price = self._gang_price(members)
             for j in members:
                 was_evicted_round = j in self.rescheduled
                 self.pool_floating += np.where(snap.floating_mask, snap.job_req[j], 0)
@@ -985,4 +1027,5 @@ class ReferenceSolver:
             termination_reason=self.termination_reason or "no remaining candidate jobs",
             unschedulable_reason=self.job_reason,
             num_loops=self.num_loops,
+            spot_price=self.spot_price,
         )

@@ -32,6 +32,8 @@ class RoundResult:
     # Per-job unschedulable reason ("" if scheduled or not considered).
     unschedulable_reason: list = field(default_factory=list)
     num_loops: int = 0
+    # Market mode: spot price set this round (None if not crossed/off).
+    spot_price: float | None = None
 
     def placements(self, snap) -> dict:
         """{job_id: node_id} for jobs scheduled this round."""

@@ -1,0 +1,44 @@
+"""Run-error regex categorizer (internal/executor/categorizer)."""
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.jobdb.ingest import categorize_error
+
+
+def test_categorize_rules():
+    rules = SchedulingConfig().error_categories
+    assert categorize_error("container killed: Out Of Memory", rules) == "oom"
+    assert categorize_error("request timed out after 30s", rules) == "timeout"
+    assert categorize_error("executor ex-a timed out", rules) == "lost-executor"
+    assert categorize_error("Failed to pull image foo:latest", rules) == "image-pull"
+    assert categorize_error("mystery explosion", rules) == "uncategorised"
+    assert categorize_error("", rules) == ""
+
+
+def test_category_lands_in_jobdb_and_query():
+    from armada_tpu.core.types import JobSpec, QueueSpec
+    from armada_tpu.events import (
+        EventSequence,
+        InMemoryEventLog,
+        JobRunErrors,
+        JobRunLeased,
+        SubmitJob,
+    )
+    from armada_tpu.services.queryapi import QueryApi
+    from armada_tpu.services.scheduler import SchedulerService
+
+    config = SchedulingConfig()
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log)
+    log.publish(EventSequence.of("q", "s", SubmitJob(
+        created=0.0, job=JobSpec(id="j1", queue="q", jobset="s",
+                                 requests={"cpu": "1"}))))
+    log.publish(EventSequence.of("q", "s", JobRunLeased(
+        created=1.0, job_id="j1", run_id="r1", executor="e", node_id="n",
+        pool="p", scheduled_at_priority=1000)))
+    log.publish(EventSequence.of("q", "s", JobRunErrors(
+        created=2.0, job_id="j1", run_id="r1",
+        error="OOMKilled: out of memory", retryable=False)))
+    sched.ingester.sync()
+    assert sched.jobdb.get("j1").error_category == "oom"
+    rows, _ = QueryApi(sched.jobdb).get_jobs()
+    assert rows[0].error_category == "oom"

@@ -31,7 +31,7 @@ def assert_parity(cfg, nodes, queues, running, queued, label=""):
     out = {
         k: v[:J] if k.startswith(("assigned", "scheduled", "preempted")) else v[:Q]
         for k, v in out.items()
-        if k != "num_loops"
+        if k not in ("num_loops", "spot_price")
     }
     o_nodes = oracle.assigned_node
     k_nodes = out["assigned_node"]
