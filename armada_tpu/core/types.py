@@ -141,18 +141,22 @@ class JobSpec:
     bid_prices: dict = field(default_factory=dict)
 
     def bid_price(self, pool: str) -> float:
-        """Bid for this pool; malformed user-supplied values count as 0
-        (one bad annotation must not abort scheduling rounds)."""
+        """Bid for this pool; malformed or non-finite user-supplied values
+        count as 0 (one bad annotation must not abort scheduling rounds or
+        poison price ordering)."""
+        import math
+
+        def clean(x) -> float:
+            try:
+                v = float(x)
+            except (TypeError, ValueError):
+                return 0.0
+            return v if math.isfinite(v) else 0.0
+
         for key in (pool, ""):
             if key in self.bid_prices:
-                try:
-                    return float(self.bid_prices[key])
-                except (TypeError, ValueError):
-                    return 0.0
-        try:
-            return float(self.annotations.get("armadaproject.io/bidPrice", 0.0))
-        except (TypeError, ValueError):
-            return 0.0
+                return clean(self.bid_prices[key])
+        return clean(self.annotations.get("armadaproject.io/bidPrice", 0.0))
 
     def with_(self, **kw) -> "JobSpec":
         return replace(self, **kw)

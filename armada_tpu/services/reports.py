@@ -31,6 +31,7 @@ class RoundReport:
     num_jobs: int
     num_nodes: int
     termination_reason: str = ""
+    spot_price: float | None = None  # market mode
     queues: dict = field(default_factory=dict)  # queue -> QueueReport
     job_reasons: dict = field(default_factory=dict)  # job_id -> reason
 
@@ -41,6 +42,8 @@ class RoundReport:
             f"jobs considered: {self.num_jobs}, nodes: {self.num_nodes}",
             f"termination: {self.termination_reason}",
         ]
+        if self.spot_price is not None:
+            lines.append(f"spot price: {self.spot_price}")
         for q in sorted(self.queues):
             r = self.queues[q]
             lines.append(

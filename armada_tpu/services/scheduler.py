@@ -404,6 +404,8 @@ class SchedulerService:
             from ..solver.kernel import solve_round
             from ..solver.kernel_prep import pad_device_round, prep_device_round
 
+            import numpy as np
+
             out = solve_round(pad_device_round(prep_device_round(snap)))
             J, Q = snap.num_jobs, snap.num_queues
             return {
@@ -415,11 +417,17 @@ class SchedulerService:
                 "demand_capped_fair_share": out["demand_capped_fair_share"][:Q],
                 "unschedulable_reason": None,
                 "termination_reason": "",
+                "spot_price": (
+                    None
+                    if np.isnan(float(out["spot_price"]))
+                    else float(out["spot_price"])
+                ),
             }
         from ..solver.reference import ReferenceSolver
 
         res = ReferenceSolver(snap).solve()
         return {
+            "spot_price": res.spot_price,
             "assigned_node": res.assigned_node,
             "scheduled_priority": res.scheduled_priority,
             "scheduled_mask": res.scheduled_mask,
@@ -446,6 +454,7 @@ class SchedulerService:
             num_jobs=snap.num_jobs,
             num_nodes=snap.num_nodes,
             termination_reason=result.get("termination_reason", ""),
+            spot_price=result.get("spot_price"),
         )
         sched_by_q = {}
         preempt_by_q = {}

@@ -32,6 +32,10 @@ from .vocab import LabelVocab, TaintVocab, referenced_label_keys
 
 NO_NODE = -1
 NO_GANG = -1
+# Market price for running non-preemptible jobs (the reference's
+# pricing.NonPreemptibleRunningPrice): large and finite so spot prices and
+# orderings stay well-defined.
+NON_PREEMPTIBLE_RUNNING_PRICE = 1e18
 
 
 @dataclass
@@ -290,9 +294,18 @@ def build_round_snapshot(
     jids = np.asarray([j.id for j in jobs])
     job_bid = np.asarray([j.bid_price(pool) for j in jobs], dtype=np.float64)
     if config.market_driven:
-        # PriceOrder (jobdb MarketJobPriorityComparer): highest bid first,
-        # then submit time, then id.
-        perm = np.lexsort((jids, jts, -job_bid))
+        # Running non-preemptible jobs carry an effectively infinite price
+        # (pricing.NonPreemptibleRunningPrice): they always win rescheduling.
+        job_bid = np.where(
+            job_is_running & ~job_preemptible,
+            NON_PREEMPTIBLE_RUNNING_PRICE,
+            job_bid,
+        )
+        # MarketJobPriorityComparer (comparison.go MarketSchedulingOrderCompare):
+        # priority-class priority first, then highest bid, then running jobs
+        # before queued at equal price (anti-churn), then submit time, id.
+        running_rank = np.where(job_is_running, 0, 1)
+        perm = np.lexsort((jids, jts, running_rank, -job_bid, -job_priority))
     else:
         perm = np.lexsort((jids, jts, jprio))
     job_order = np.empty(J, dtype=np.int64)

@@ -77,6 +77,47 @@ def test_spot_price_set_at_cutoff():
     assert res.spot_price == 7.0
 
 
+def test_non_preemptible_running_always_wins():
+    # A running non-preemptible job carries an effectively infinite price:
+    # market eviction still evicts it, but it always reschedules first.
+    cfg = SchedulingConfig(
+        priority_classes={
+            "solid": PriorityClass("solid", 1000, preemptible=False),
+            "m": PriorityClass("m", 1000, preemptible=True),
+        },
+        default_priority_class="m",
+        market_driven=True,
+    )
+    running = [
+        RunningJob(
+            job=JobSpec(id="solid0", queue="q", priority_class="solid",
+                        requests={"cpu": "6", "memory": "1Gi"},
+                        bid_prices={"default": 0.1}),
+            node_id="n0",
+            scheduled_at_priority=1000,
+        )
+    ]
+    queued = [bid_job(1, 999.0, cpu="6")]
+    snap, res = both(cfg, [node()], [QueueSpec("q")], running, queued)
+    assert res.preempted_mask.sum() == 0  # the non-preemptible job survived
+    solid = snap.job_ids.index("solid0")
+    assert res.assigned_node[solid] == 0
+
+
+def test_equal_bid_prefers_running():
+    # Anti-churn: at equal price a running job keeps its slot over a queued
+    # job submitted earlier (market_iterator.go:218-222).
+    running = [
+        RunningJob(job=bid_job(0, 5.0, cpu="6"), node_id="n0",
+                   scheduled_at_priority=1000)
+    ]
+    queued = [bid_job(1, 5.0, cpu="6").with_(submitted_ts=0.0)]
+    snap, res = both(MKT, [node()], [QueueSpec("q")], running, queued)
+    assert res.preempted_mask.sum() == 0
+    assert res.assigned_node[snap.job_ids.index("j0")] == 0
+    assert not res.scheduled_mask[snap.job_ids.index("j1")]
+
+
 def test_two_queues_price_order_interleaves():
     queued = [bid_job(0, 3.0, queue="a"), bid_job(1, 9.0, queue="b"),
               bid_job(2, 6.0, queue="a"), bid_job(3, 1.0, queue="b")]
