@@ -10,7 +10,16 @@ immutability machinery (columnar stores handle that here).
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field, replace
+
+def _clean_price(x) -> float:
+    try:
+        v = float(x)
+    except (TypeError, ValueError):
+        return 0.0
+    return v if math.isfinite(v) else 0.0
+
 
 NO_SCHEDULE = "NoSchedule"
 NO_EXECUTE = "NoExecute"
@@ -144,19 +153,10 @@ class JobSpec:
         """Bid for this pool; malformed or non-finite user-supplied values
         count as 0 (one bad annotation must not abort scheduling rounds or
         poison price ordering)."""
-        import math
-
-        def clean(x) -> float:
-            try:
-                v = float(x)
-            except (TypeError, ValueError):
-                return 0.0
-            return v if math.isfinite(v) else 0.0
-
         for key in (pool, ""):
             if key in self.bid_prices:
-                return clean(self.bid_prices[key])
-        return clean(self.annotations.get("armadaproject.io/bidPrice", 0.0))
+                return _clean_price(self.bid_prices[key])
+        return _clean_price(self.annotations.get("armadaproject.io/bidPrice", 0.0))
 
     def with_(self, **kw) -> "JobSpec":
         return replace(self, **kw)
@@ -201,3 +201,6 @@ class RunningJob:
     job: JobSpec
     node_id: str
     scheduled_at_priority: int
+    # When the active run was leased (market anti-churn ordering:
+    # longer-running jobs reschedule first, comparison.go:148-153).
+    leased_ts: float = 0.0

@@ -64,6 +64,14 @@ class JobRunLeased(Event):
 
 
 @dataclass(frozen=True)
+class JobRunPending(Event):
+    """Pod created on the cluster, not yet running (lease acknowledged)."""
+
+    job_id: str = ""
+    run_id: str = ""
+
+
+@dataclass(frozen=True)
 class JobRunRunning(Event):
     job_id: str = ""
     run_id: str = ""

@@ -63,8 +63,14 @@ def _apply_event(txn, seq: ev.EventSequence, event, error_rules=()) -> None:
             scheduled_at_priority=event.scheduled_at_priority,
             state=RunState.LEASED,
             attempt=job.num_attempts,
+            leased=event.created,
         )
         txn.upsert(job.with_(state=JobState.LEASED, runs=job.runs + (run,)))
+    elif isinstance(event, ev.JobRunPending):
+        run = job.latest_run
+        if run and run.id == event.run_id and run.state == RunState.LEASED:
+            run = replace(run, state=RunState.PENDING)
+            txn.upsert(job.with_(state=JobState.PENDING, runs=job.runs[:-1] + (run,)))
     elif isinstance(event, ev.JobRunRunning):
         run = job.latest_run
         if run and run.id == event.run_id:

@@ -60,6 +60,7 @@ class JobRun:
     scheduled_at_priority: int = 0
     state: RunState = RunState.LEASED
     attempt: int = 0
+    leased: float = 0.0  # JobRunLeased time
     started: float = 0.0  # JobRunRunning time
     finished: float = 0.0  # terminal-event time
 

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from ..core.types import NodeSpec
 from ..events import (
     EventSequence,
+    JobRunPending,
     JobRunRunning,
     JobRunSucceeded,
     JobSucceeded,
@@ -101,6 +102,14 @@ class FakeExecutor:
             if run.id in self._seen_runs:
                 continue
             self._seen_runs.add(run.id)
+            # Pod created: leased -> pending (job-lifecycle-events.md).
+            self.log.publish(
+                EventSequence.of(
+                    job.queue,
+                    job.jobset,
+                    JobRunPending(created=now, job_id=job.id, run_id=run.id),
+                )
+            )
             runtime = float(self.runtime_for(job.id))
             self.active[run.id] = _ActiveRun(
                 run_id=run.id,

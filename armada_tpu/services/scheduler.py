@@ -275,6 +275,7 @@ class SchedulerService:
                     job=job.spec.with_(priority=job.priority),
                     node_id=run.node_id,
                     scheduled_at_priority=run.scheduled_at_priority,
+                    leased_ts=run.leased,
                 )
             )
         queued_jobs = [j for j in txn.queued_jobs() if j.id not in exclude]

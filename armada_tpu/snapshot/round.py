@@ -32,10 +32,10 @@ from .vocab import LabelVocab, TaintVocab, referenced_label_keys
 
 NO_NODE = -1
 NO_GANG = -1
-# Market price for running non-preemptible jobs (the reference's
-# pricing.NonPreemptibleRunningPrice): large and finite so spot prices and
-# orderings stay well-defined.
-NON_PREEMPTIBLE_RUNNING_PRICE = 1e18
+# Market price for running non-preemptible jobs
+# (pricing.NonPreemptibleRunningPrice = 1_000_000 in the reference): bids
+# above it can still outrank non-preemptible incumbents, exactly as there.
+NON_PREEMPTIBLE_RUNNING_PRICE = 1_000_000.0
 
 
 @dataclass
@@ -280,6 +280,9 @@ def build_round_snapshot(
     ]
     job_priority[:] = [pc_priority_by_name[n] for n in pc_names_per_job]
     job_preemptible[:] = [pc_preempt_by_name[n] for n in pc_names_per_job]
+    # Priority-class priority, independent of the running override below
+    # (market ordering compares PC priority for running jobs too).
+    job_pc_priority = job_priority.copy()
 
     for j, run in enumerate(running):
         job_is_running[j] = True
@@ -303,9 +306,14 @@ def build_round_snapshot(
         )
         # MarketJobPriorityComparer (comparison.go MarketSchedulingOrderCompare):
         # priority-class priority first, then highest bid, then running jobs
-        # before queued at equal price (anti-churn), then submit time, id.
+        # before queued at equal price (anti-churn), then the active-run
+        # lease time for running jobs / submit time for queued, then id.
         running_rank = np.where(job_is_running, 0, 1)
-        perm = np.lexsort((jids, jts, running_rank, -job_bid, -job_priority))
+        leased_ts = np.zeros(J, dtype=np.float64)
+        for j, run in enumerate(running):
+            leased_ts[j] = run.leased_ts
+        ts_key = np.where(job_is_running, leased_ts, jts)
+        perm = np.lexsort((jids, ts_key, running_rank, -job_bid, -job_pc_priority))
     else:
         perm = np.lexsort((jids, jts, jprio))
     job_order = np.empty(J, dtype=np.int64)

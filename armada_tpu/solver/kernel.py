@@ -174,7 +174,17 @@ def _select_at_row(dev, alloc, j, row, static_ok):
     return lex_argmin(keys, mask)
 
 
-def _fair_preemption(dev, carry, j, static_ok):
+def fair_preemption_order(carry):
+    """Precompute the (node, -rank) walk order once per pass: ranks are
+    fixed at assignment; only the active mask changes as evicted jobs are
+    consumed or rescheduled, which the per-select mask handles."""
+    rank = carry.evict_rank
+    active = rank >= 0
+    node_key = jnp.where(active, carry.job_node, BIG)
+    return jnp.lexsort((BIG - rank, node_key))
+
+
+def _fair_preemption(dev, carry, j, static_ok, fp_order):
     """Vectorized selectNodeForJobWithFairPreemption (nodedb.go:808-899).
 
     Walk evicted jobs in reverse rank order; node n becomes selectable at the
@@ -183,10 +193,7 @@ def _fair_preemption(dev, carry, j, static_ok):
     rank = carry.evict_rank
     active = rank >= 0
     node = carry.job_node
-    # Sort by (node, -rank): cumulative per node in walk order; inactive
-    # entries sink to the end via a node key beyond any real node.
-    node_key = jnp.where(active, node, BIG)
-    order = jnp.lexsort((BIG - rank, node_key))
+    order = fp_order
     n_sorted = node[order]
     a_sorted = active[order]
     contrib = jnp.where(a_sorted[:, None], dev.job_req_fit[order], 0).astype(
@@ -225,7 +232,7 @@ def _fair_preemption(dev, carry, j, static_ok):
     return sel_node, found, preempted_at, new_alloc, new_rank
 
 
-def _select_node(dev, carry, j, extra_sel):
+def _select_node(dev, carry, j, extra_sel, fp_order):
     """SelectNodeForJobWithTxn (nodedb.go:423-503). Returns
     (node, found, preempted_at, new_alloc, new_evict_rank)."""
     prio = carry.job_prio[j]
@@ -249,7 +256,7 @@ def _select_node(dev, carry, j, extra_sel):
     # index is empty (every queued-only round).
     fpre_n, fpre_found, fpre_at, fpre_alloc, fpre_rank = jax.lax.cond(
         jnp.any(carry.evict_rank >= 0),
-        lambda: _fair_preemption(dev, carry, j, static_ok),
+        lambda: _fair_preemption(dev, carry, j, static_ok, fp_order),
         lambda: (
             jnp.int32(0),
             jnp.zeros((), bool),
@@ -328,7 +335,7 @@ def _bind(dev, carry: Carry, j, n, at_prio) -> Carry:
     )
 
 
-def _gang_attempt(dev, carry: Carry, s, all_ev):
+def _gang_attempt(dev, carry: Carry, s, all_ev, fp_order):
     """GangScheduler.Schedule + ScheduleManyWithTxn. Returns
     (carry, status_code)."""
     q = dev.slot_queue[s]
@@ -385,7 +392,7 @@ def _gang_attempt(dev, carry: Carry, s, all_ev):
             live = (m < dev.slot_count[s]) & ok
             safe_j = jnp.clip(j, 0, dev.job_req.shape[0] - 1)
             node, found, pat, new_alloc, new_rank = _select_node(
-                dev, c, safe_j, extra_sel
+                dev, c, safe_j, extra_sel, fp_order
             )
 
             def do_bind(c):
@@ -585,6 +592,8 @@ def _schedule_pass(
     # all-evicted flags are stable within a pass: evictions happen between
     # passes, and a rescheduled member's slot is the one being consumed.
     valid0, all_ev_flags = _slot_validity(dev, carry, include_queued, use_key_skip)
+    # Fair-preemption walk order: one sort per pass, not per member select.
+    fp_order = fair_preemption_order(carry)
 
     def body(state):
         c, valid = state
@@ -625,7 +634,7 @@ def _schedule_pass(
         sstar = heads[qstar]
 
         def attempt(c):
-            c2, status = _gang_attempt(dev, c, sstar, all_ev_flags[sstar])
+            c2, status = _gang_attempt(dev, c, sstar, all_ev_flags[sstar], fp_order)
             # Terminal handling (queue_scheduler.go:176-190).
             c2 = c2._replace(
                 only_ev_global=c2.only_ev_global | (status == FAIL_TERMINAL),
@@ -936,18 +945,17 @@ def solve_impl(dev: DeviceRound):
     )
     carry = _apply_evictions(dev, carry, over)
     carry = carry._replace(scheduled_new=carry.scheduled_new - sched_backout)
-    # Re-open slots whose members are all evicted for pass 2.
+    # Re-open ONLY slots whose members were just oversubscription-evicted
+    # (pass 2 considers the fresh eviction set, not pass-1 leftovers).
     S_, M_ = dev.slot_members.shape
     member_mask = jnp.arange(M_)[None, :] < dev.slot_count[:, None]
     safe = jnp.clip(dev.slot_members, 0, J - 1)
-    slot_all_ev = jnp.all(
-        jnp.where(member_mask, carry.job_evicted[safe], True), axis=1
-    )
+    slot_all_over = jnp.all(
+        jnp.where(member_mask, over[safe], True), axis=1
+    ) & (dev.slot_count > 0)
     any_over = jnp.any(over)
     carry = carry._replace(
-        slot_state=jnp.where(
-            slot_all_ev & any_over, jnp.int8(PENDING), carry.slot_state
-        ),
+        slot_state=jnp.where(slot_all_over, jnp.int8(PENDING), carry.slot_state),
         only_ev_global=jnp.zeros((), bool),
         only_ev_queue=jnp.zeros(Q, bool),
     )

@@ -584,13 +584,21 @@ class ReferenceSolver:
             self.job_pc_name[j],
         )
 
-    def _build_streams(self, include_queued: bool) -> dict:
-        """Per-queue candidate streams: evicted first, then queued."""
+    def _build_streams(self, include_queued: bool, restrict=None) -> dict:
+        """Per-queue candidate streams: evicted first, then queued.
+        restrict: if set, only these evicted jobs enter the stream (pass 2
+        considers only oversubscription-evicted jobs, the new in-memory repo
+        of preempting_queue_scheduler.go:166-178)."""
         snap = self.snap
         streams: dict[int, _QueueStream] = {}
         for q in range(snap.num_queues):
             ev = sorted(
-                (j for j in self.evicted if snap.job_queue[j] == q),
+                (
+                    j
+                    for j in self.evicted
+                    if snap.job_queue[j] == q
+                    and (restrict is None or j in restrict)
+                ),
                 key=lambda j: snap.job_order[j],
             )
             qd = []
@@ -695,10 +703,11 @@ class ReferenceSolver:
         skip_key_check: bool,
         consider_priority: bool,
         budgets: np.ndarray,
+        restrict=None,
     ):
         """QueueScheduler.Schedule (queue_scheduler.go:91-276)."""
         snap = self.snap
-        streams = self._build_streams(include_queued)
+        streams = self._build_streams(include_queued, restrict)
         evicted_cards = self._evicted_gang_cardinality()
         only_evicted_global = False
         only_evicted_queues: set[int] = set()
@@ -989,12 +998,15 @@ class ReferenceSolver:
             self._evict(j)
         if over:
             self._assign_evict_indices()
-            # 4. Second pass: evicted only, considering priority-class priority.
+            # 4. Second pass: ONLY the oversubscription-evicted jobs (the
+            # fresh in-memory repo of the reference), considering
+            # priority-class priority.
             self._queue_schedule(
                 include_queued=False,
                 skip_key_check=False,
                 consider_priority=True,
                 budgets=budgets,
+                restrict=set(over),
             )
             for j in list(self.rescheduled):
                 preempted.discard(j)

@@ -17,6 +17,8 @@ import time
 N_NODES = int(os.environ.get("BENCH_NODES", 5000))
 N_JOBS = int(os.environ.get("BENCH_JOBS", 100_000))
 N_QUEUES = int(os.environ.get("BENCH_QUEUES", 10))
+# Running preemptible jobs (exercises eviction + fair preemption paths).
+N_RUNNING = int(os.environ.get("BENCH_RUNNING", 0))
 
 
 def build_inputs():
@@ -33,6 +35,7 @@ def build_inputs():
             "low": PriorityClass("low", 1000, preemptible=True),
         },
         default_priority_class="low",
+        protected_fraction_of_fair_share=0.5 if N_RUNNING else 1.0,
     )
     rng = np.random.default_rng(0)
     nodes = [
@@ -56,7 +59,25 @@ def build_inputs():
         )
         for i in range(N_JOBS)
     ]
-    snap = build_round_snapshot(cfg, "default", nodes, queues, [], queued)
+    from armada_tpu.core.types import RunningJob
+
+    # Running jobs all in one hog queue (over fair share -> evicted and
+    # mostly rescheduled, driving the eviction + fair-preemption machinery).
+    running = [
+        RunningJob(
+            job=JobSpec(
+                id=f"run-{i:07d}",
+                queue="queue-00",
+                priority_class="low",
+                requests={"cpu": "2", "memory": "4Gi"},
+                submitted_ts=float(-N_RUNNING + i),
+            ),
+            node_id=f"node-{i % N_NODES:05d}",
+            scheduled_at_priority=1000,
+        )
+        for i in range(N_RUNNING)
+    ]
+    snap = build_round_snapshot(cfg, "default", nodes, queues, running, queued)
     return prep_device_round(snap)
 
 
