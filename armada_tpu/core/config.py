@@ -117,6 +117,9 @@ class SchedulingConfig:
     # is recorded once scheduled cost crosses the cutoff fraction.
     market_driven: bool = False
     spot_price_cutoff: float = 0.0
+    # Assert jobdb invariants at the end of each cycle (the reference's
+    # enableAssertions, scheduler.go:143; config.yaml:84).
+    enable_assertions: bool = False
 
     # Regex classifier for run errors -> failure category
     # (internal/executor/categorizer/classifier.go): first match wins.

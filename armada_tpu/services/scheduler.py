@@ -174,6 +174,10 @@ class SchedulerService:
         for seq in sequences:
             self.log.publish(seq)
         self.ingester.sync()  # optimistic immediate apply (same process)
+        if self.config.enable_assertions:
+            # Logical sanitizer: jobdb invariants hold after every cycle
+            # (jobdb.Assert / EnableAssertions in the reference).
+            self.jobdb.read_txn().assert_valid()
 
         if self.runner.idle and not self.runner.synchronous:
             self.runner.submit(lambda now=now: self._schedule_all_pools(now))

@@ -51,7 +51,8 @@ def assert_parity(cfg, nodes, queues, running, queued, label=""):
     return snap, oracle, out
 
 
-def rand_scenario(rng, with_running=False, with_gangs=True, n_queues=3):
+def rand_scenario(rng, with_running=False, with_gangs=True, n_queues=3,
+                  with_affinity=False):
     n_nodes = int(rng.integers(2, 8))
     nodes = []
     for i in range(n_nodes):
@@ -110,6 +111,19 @@ def rand_scenario(rng, with_running=False, with_gangs=True, n_queues=3):
             kw["tolerations"] = (Toleration(key="special", value="true"),)
         if rng.random() < 0.2:
             kw["node_selector"] = {"zone": str(rng.choice(["a", "b"]))}
+        if with_affinity and rng.random() < 0.2:
+            from armada_tpu.core.types import Affinity, MatchExpression, NodeSelectorTerm
+
+            op = str(rng.choice(["In", "NotIn", "Exists"]))
+            kw["affinity"] = Affinity(
+                terms=(
+                    NodeSelectorTerm(
+                        expressions=(
+                            MatchExpression("zone", op, ("a",)),
+                        )
+                    ),
+                )
+            )
         if with_gangs and rng.random() < 0.2:
             card = int(rng.integers(2, 5))
             gang = Gang(id=f"gang-{g}", cardinality=card)
@@ -153,6 +167,15 @@ def test_parity_queued_only(seed):
 def test_parity_with_running(seed):
     rng = np.random.default_rng(seed)
     nodes, queues, running, queued = rand_scenario(rng, with_running=True)
+    assert_parity(PREEMPT_CFG, nodes, queues, running, queued, f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", range(24, 30))
+def test_parity_with_affinity_mix(seed):
+    rng = np.random.default_rng(seed)
+    nodes, queues, running, queued = rand_scenario(
+        rng, with_running=True, with_affinity=True
+    )
     assert_parity(PREEMPT_CFG, nodes, queues, running, queued, f"seed={seed}")
 
 
