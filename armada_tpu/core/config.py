@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from .priorities import PriorityClass
+from .priorities import AwayNodeType, PriorityClass
 from .resources import ResourceListFactory
 
 
@@ -58,6 +58,9 @@ class SchedulingConfig:
         ResourceType("nvidia.com/gpu", "1"),
     )
     floating_resources: tuple[FloatingResource, ...] = ()
+    # Named taint sets for away scheduling (wellKnownNodeTypes config):
+    # {name: (Taint, ...)} using core.types.Taint.
+    well_known_node_types: dict = field(default_factory=dict)
     priority_classes: dict[str, PriorityClass] = field(
         default_factory=lambda: {
             "armada-default": PriorityClass("armada-default", 1000, preemptible=False),
@@ -174,6 +177,20 @@ class SchedulingConfig:
                 )
                 for t in d["floatingResources"]
             )
+        if "wellKnownNodeTypes" in d:
+            from .types import Taint
+
+            kwargs["well_known_node_types"] = {
+                t["name"]: tuple(
+                    Taint(
+                        key=x["key"],
+                        value=x.get("value", ""),
+                        effect=x.get("effect", "NoSchedule"),
+                    )
+                    for x in t.get("taints", [])
+                )
+                for t in d["wellKnownNodeTypes"]
+            }
         if "priorityClasses" in d:
             kwargs["priority_classes"] = {
                 name: PriorityClass(
@@ -181,6 +198,13 @@ class SchedulingConfig:
                     int(pc["priority"]),
                     bool(pc.get("preemptible", False)),
                     dict(pc.get("maximumResourceFractionPerQueue", {})),
+                    away_node_types=tuple(
+                        AwayNodeType(
+                            priority=int(a["priority"]),
+                            well_known_node_type=a["wellKnownNodeTypeName"],
+                        )
+                        for a in pc.get("awayNodeTypes", [])
+                    ),
                 )
                 for name, pc in d["priorityClasses"].items()
             }
@@ -212,6 +236,16 @@ class SchedulingConfig:
             kwargs["max_retries"] = int(d["maxRetries"])
         if "nodeIdLabel" in d:
             kwargs["node_id_label"] = d["nodeIdLabel"]
+        for yaml_key, attr, conv in [
+            ("enableAssertions", "enable_assertions", bool),
+            ("marketDriven", "market_driven", bool),
+            ("spotPriceCutoff", "spot_price_cutoff", float),
+            ("shortJobPenaltySeconds", "short_job_penalty_s", float),
+            ("executorTimeout", "executor_timeout_s", float),
+            ("enablePreferLargeJobOrdering", "enable_prefer_large_job_ordering", bool),
+        ]:
+            if yaml_key in d:
+                kwargs[attr] = conv(d[yaml_key])
         rl = {}
         for yaml_key, attr in [
             ("maximumSchedulingRate", "maximum_scheduling_rate"),

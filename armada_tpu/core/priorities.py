@@ -16,6 +16,16 @@ MIN_PRIORITY: int = -(2**31)
 
 
 @dataclass(frozen=True)
+class AwayNodeType:
+    """Fallback scheduling target: a well-known node type (named taint set)
+    the job may run on at a reduced priority (types.AwayNodeType in the
+    reference; nodedb.go:487-501)."""
+
+    priority: int
+    well_known_node_type: str
+
+
+@dataclass(frozen=True)
 class PriorityClass:
     name: str
     priority: int
@@ -27,13 +37,19 @@ class PriorityClass:
     maximum_resource_fraction_per_queue_by_pool: dict[str, dict[str, float]] = field(
         default_factory=dict
     )
+    # Ordered fallback targets tried after home scheduling fails.
+    away_node_types: tuple = ()  # tuple[AwayNodeType, ...]
 
 
 def priority_levels(priority_classes: dict[str, PriorityClass]) -> list[int]:
     """Distinct scheduling priorities, ascending, prefixed by EvictedPriority.
 
     This is the P axis of the allocatable[P, N, R] tensor; mirrors
-    nodeDbPriorities in the reference nodedb.
+    nodeDbPriorities in the reference nodedb. Away priorities are scheduling
+    priorities too, so they get rows.
     """
-    levels = sorted({pc.priority for pc in priority_classes.values()})
-    return [EVICTED_PRIORITY] + levels
+    levels = {pc.priority for pc in priority_classes.values()}
+    for pc in priority_classes.values():
+        for away in pc.away_node_types:
+            levels.add(away.priority)
+    return [EVICTED_PRIORITY] + sorted(levels)

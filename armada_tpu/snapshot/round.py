@@ -109,6 +109,14 @@ class RoundSnapshot:
     gang_complete: np.ndarray  # bool[G] all declared members present
     gang_uniformity_key: list  # per gang: uniformity label key or ""
 
+    # --- away scheduling (selectNodeForJobWithTxnAndAwayNodeType,
+    # nodedb.go:551-595): per priority class, ordered fallback targets with
+    # extra tolerated-taint bits and a reduced scheduling priority ---
+    pc_names: list  # priority-class name per index (order of pc tables)
+    pc_away_count: np.ndarray  # int32[C]
+    pc_away_prio: np.ndarray  # int32[C, Amax]
+    pc_away_tol: np.ndarray  # uint32[C, Amax, Wt]
+
     # --- vocabularies (host-side, for decoding/reporting) ---
     taint_vocab: TaintVocab
     label_vocab: LabelVocab
@@ -456,6 +464,41 @@ def build_round_snapshot(
         gang_complete[g] = len(members) == row["card"]
     gang_members = np.asarray(members_flat, dtype=np.int32)
 
+    # --- away tables ---
+    pc_names = list(config.priority_classes)
+    C = len(pc_names)
+    Amax = max(
+        [1] + [len(config.priority_classes[n].away_node_types) for n in pc_names]
+    )
+    pc_away_count = np.zeros(C, dtype=np.int32)
+    pc_away_prio = np.zeros((C, Amax), dtype=np.int32)
+    pc_away_tol = np.zeros((C, Amax, taint_vocab.n_words), dtype=np.uint32)
+    from ..core.types import Toleration as _Tol
+
+    for ci, name in enumerate(pc_names):
+        for ai, away in enumerate(config.priority_classes[name].away_node_types):
+            taints = config.well_known_node_types.get(away.well_known_node_type, ())
+            if not taints:
+                continue  # no taints -> no extra capability (nodedb.go:576)
+            # The tolerations added for the away taints (eviction-style:
+            # key+effect, exact value or wildcard, nodedb.go:581-590).
+            tols = tuple(
+                _Tol(
+                    key=t.key,
+                    operator="Exists" if t.value == "*" else "Equal",
+                    value="" if t.value == "*" else t.value,
+                    effect=t.effect,
+                )
+                for t in taints
+            )
+            bits = taint_vocab.tolerated_bits(tols)
+            if not bits.any():
+                continue  # nothing in this snapshot's vocab is tolerated
+            a = pc_away_count[ci]
+            pc_away_prio[ci, a] = away.priority
+            pc_away_tol[ci, a] = bits
+            pc_away_count[ci] += 1
+
     # --- candidate ordering key (indexed resources) ---
     order_idx, order_res = [], []
     for name, resolution in config.indexed_resources.items():
@@ -519,6 +562,10 @@ def build_round_snapshot(
         gang_order=gang_order,
         gang_complete=gang_complete,
         gang_uniformity_key=gang_uniformity_key,
+        pc_names=pc_names,
+        pc_away_count=pc_away_count,
+        pc_away_prio=pc_away_prio,
+        pc_away_tol=pc_away_tol,
         taint_vocab=taint_vocab,
         label_vocab=label_vocab,
         total_resources=np.where(

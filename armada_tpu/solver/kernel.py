@@ -131,10 +131,13 @@ def _fair_shares(weights, demand_costs, total_is_zero):
     return fair_share, capped, uncapped
 
 
-def _static_ok(dev, j, extra_sel):
+def _static_ok(dev, j, extra_sel, extra_tol=None):
     """StaticJobRequirementsMet over all nodes (nodematching.go:161-190).
-    extra_sel: additional required label bits (gang uniformity value)."""
+    extra_sel: additional required label bits (gang uniformity value);
+    extra_tol: additional tolerated-taint bits (away node types)."""
     tolerated = dev.job_tolerated[j]
+    if extra_tol is not None:
+        tolerated = tolerated | extra_tol
     taints_ok = jnp.all((dev.node_taints & ~tolerated) == 0, axis=-1)
     sel_ok = bits_subset(dev.job_selector[j] | extra_sel, dev.node_labels)
     total_ok = jnp.all(dev.job_req_fit[j] <= dev.node_total, axis=-1)
@@ -232,22 +235,13 @@ def _fair_preemption(dev, carry, j, static_ok, fp_order):
     return sel_node, found, preempted_at, new_alloc, new_rank
 
 
-def _select_node(dev, carry, j, extra_sel, fp_order):
-    """SelectNodeForJobWithTxn (nodedb.go:423-503). Returns
+def _select_chain(dev, carry, j, prio, extra_sel, extra_tol, fp_order):
+    """selectNodeForJobWithTxnAtPriority (nodedb.go:597-662) at one target
+    priority with optional extra tolerations (away node types). Returns
     (node, found, preempted_at, new_alloc, new_evict_rank)."""
-    prio = carry.job_prio[j]
-    row_p = jnp.searchsorted(dev.priorities, prio).astype(jnp.int32)
     alloc = carry.alloc
-
-    pinned = carry.job_evicted[j]
-    home = carry.job_node[j]
-    safe_home = jnp.clip(home, 0, alloc.shape[1] - 1)
-    over_alloc = jnp.any(alloc[:, safe_home] < 0)
-    home_fit = jnp.all(dev.job_req_fit[j] <= alloc[row_p, safe_home]) | (
-        dev.node_unschedulable[safe_home] & over_alloc
-    )
-
-    static_ok = _static_ok(dev, j, extra_sel)
+    row_p = jnp.searchsorted(dev.priorities, prio).astype(jnp.int32)
+    static_ok = _static_ok(dev, j, extra_sel, extra_tol)
 
     n0, f0 = _select_at_row(dev, alloc, j, 0, static_ok)
     np_, fp = _select_at_row(dev, alloc, j, row_p, static_ok)
@@ -279,34 +273,81 @@ def _select_node(dev, carry, j, extra_sel, fp_order):
         urg_at = jnp.where(take, dev.priorities[r], urg_at)
         urg_found = urg_found | take
 
-    # Resolution order: pinned; row0; (no fit at own priority -> fail);
-    # fair preemption; urgency.
-    found = jnp.where(
-        pinned,
-        home_fit,
-        f0 | (fp & (fpre_found | urg_found)),
-    )
-    use_row0 = ~pinned & f0
-    use_fpre = ~pinned & ~f0 & fp & fpre_found
-    use_urg = ~pinned & ~f0 & fp & ~fpre_found & urg_found
-
-    node = jnp.where(
-        pinned,
-        safe_home,
-        jnp.where(use_row0, n0, jnp.where(use_fpre, fpre_n, urg_n)),
-    )
+    found = f0 | (fp & (fpre_found | urg_found))
+    use_fpre = ~f0 & fp & fpre_found
+    node = jnp.where(f0, n0, jnp.where(use_fpre, fpre_n, urg_n))
     preempted_at = jnp.where(
-        pinned,
-        prio,
-        jnp.where(
-            use_row0,
-            EVICTED_PRIORITY,
-            jnp.where(use_fpre, fpre_at, urg_at),
-        ),
+        f0, EVICTED_PRIORITY, jnp.where(use_fpre, fpre_at, urg_at)
     )
     new_alloc = jnp.where(use_fpre, fpre_alloc, carry.alloc)
     new_rank = jnp.where(use_fpre, fpre_rank, carry.evict_rank)
     return node, found, preempted_at, new_alloc, new_rank
+
+
+def _select_node(dev, carry, j, extra_sel, fp_order):
+    """SelectNodeForJobWithTxn (nodedb.go:423-503): pinned reschedule, home
+    chain, then away node types at reduced priority. Returns
+    (node, found, preempted_at, new_alloc, new_evict_rank, sched_at)."""
+    prio = carry.job_prio[j]
+    row_p = jnp.searchsorted(dev.priorities, prio).astype(jnp.int32)
+    alloc = carry.alloc
+
+    pinned = carry.job_evicted[j]
+    home = carry.job_node[j]
+    safe_home = jnp.clip(home, 0, alloc.shape[1] - 1)
+    over_alloc = jnp.any(alloc[:, safe_home] < 0)
+    home_fit = jnp.all(dev.job_req_fit[j] <= alloc[row_p, safe_home]) | (
+        dev.node_unschedulable[safe_home] & over_alloc
+    )
+
+    node, found, preempted_at, new_alloc, new_rank = _select_chain(
+        dev, carry, j, prio, extra_sel, None, fp_order
+    )
+    sched_at = prio
+
+    if dev.has_away:
+        # Away node types (nodedb.go:487-501): extra tolerations for the
+        # well-known taints, the whole chain at the away priority, bound at
+        # that priority so home jobs can urgency-preempt later. Gated behind
+        # lax.cond so the (expensive) away chains only execute for jobs that
+        # actually failed home scheduling.
+        pc = dev.job_pc[j]
+        Amax = dev.pc_away_prio.shape[1]
+
+        def try_away(args):
+            node, found, preempted_at, new_alloc, new_rank, sched_at = args
+            for a in range(Amax):
+                live = (a < dev.pc_away_count[pc]) & ~found
+                a_prio = dev.pc_away_prio[pc, a]
+                a_node, a_found, a_at, a_alloc, a_rank = _select_chain(
+                    dev, carry, j, a_prio, extra_sel, dev.pc_away_tol[pc, a],
+                    fp_order,
+                )
+                take = live & a_found
+                node = jnp.where(take, a_node, node)
+                preempted_at = jnp.where(take, a_at, preempted_at)
+                sched_at = jnp.where(take, a_prio, sched_at)
+                new_alloc = jnp.where(take, a_alloc, new_alloc)
+                new_rank = jnp.where(take, a_rank, new_rank)
+                found = found | take
+            return node, found, preempted_at, new_alloc, new_rank, sched_at
+
+        state = (node, found, preempted_at, new_alloc, new_rank, sched_at)
+        node, found, preempted_at, new_alloc, new_rank, sched_at = jax.lax.cond(
+            ~found & ~pinned & (dev.pc_away_count[pc] > 0),
+            try_away,
+            lambda args: args,
+            state,
+        )
+
+    # Pinned (evicted) jobs only ever return to their node.
+    found = jnp.where(pinned, home_fit, found)
+    node = jnp.where(pinned, safe_home, node)
+    preempted_at = jnp.where(pinned, prio, preempted_at)
+    sched_at = jnp.where(pinned, prio, sched_at)
+    new_alloc = jnp.where(pinned, carry.alloc, new_alloc)
+    new_rank = jnp.where(pinned, carry.evict_rank, new_rank)
+    return node, found, preempted_at, new_alloc, new_rank, sched_at
 
 
 def _bind(dev, carry: Carry, j, n, at_prio) -> Carry:
@@ -391,13 +432,13 @@ def _gang_attempt(dev, carry: Carry, s, all_ev, fp_order):
             j = dev.slot_members[s, m]
             live = (m < dev.slot_count[s]) & ok
             safe_j = jnp.clip(j, 0, dev.job_req.shape[0] - 1)
-            node, found, pat, new_alloc, new_rank = _select_node(
+            node, found, pat, new_alloc, new_rank, sched_at = _select_node(
                 dev, c, safe_j, extra_sel, fp_order
             )
 
             def do_bind(c):
                 c2 = c._replace(alloc=new_alloc, evict_rank=new_rank)
-                return _bind(dev, c2, safe_j, node, c2.job_prio[safe_j])
+                return _bind(dev, c2, safe_j, node, sched_at)
 
             c = jax.lax.cond(live & found, do_bind, lambda c: c, c)
             pat_sum = pat_sum + jnp.where(live & found, _f(pat), 0.0)

@@ -38,6 +38,7 @@ _META_FIELDS = (
     "prefer_large",
     "num_key_groups",
     "market_driven",
+    "has_away",
 )
 
 
@@ -108,6 +109,10 @@ class DeviceRound:
     # priority classes
     pc_priority: np.ndarray  # int32[C]
     pc_preemptible: np.ndarray  # bool[C]
+    # Away scheduling tables (nodedb.go:487-501)
+    pc_away_count: np.ndarray  # int32[C]
+    pc_away_prio: np.ndarray  # int32[C, Amax]
+    pc_away_tol: np.ndarray  # uint32[C, Amax, Wt]
 
     # totals / limits
     total_resources: np.ndarray  # float[R]
@@ -126,6 +131,7 @@ class DeviceRound:
     prefer_large: bool
     num_key_groups: int
     market_driven: bool
+    has_away: bool
     spot_price_cutoff: np.ndarray  # float scalar
     job_bid: np.ndarray  # float64[J]
 
@@ -557,6 +563,9 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         queue_pc_limit=queue_pc_limit,
         pc_priority=pc_priority,
         pc_preemptible=pc_preemptible,
+        pc_away_count=snap.pc_away_count,
+        pc_away_prio=snap.pc_away_prio,
+        pc_away_tol=snap.pc_away_tol,
         total_resources=total_dev_sum,
         drf_multipliers=mult,
         max_round_resources=max_round,
@@ -571,6 +580,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         prefer_large=cfg.enable_prefer_large_job_ordering,
         num_key_groups=num_key_groups,
         market_driven=cfg.market_driven,
+        has_away=bool(snap.pc_away_count.any()),
         spot_price_cutoff=np.float64(cfg.spot_price_cutoff),
         job_bid=snap.job_bid,
     )

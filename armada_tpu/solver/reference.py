@@ -22,9 +22,9 @@ Known deliberate deviations from the Go reference (documented, small):
   - Candidate-node order uses resolution-rounded allocatable for the merge
     (the reference rounds within a node type but merges types on raw values,
     nodeiteration.go:170-185); ties differ only between near-identical nodes.
-  - Node affinity expressions, away-pool/home-away scheduling, market/price
-    ordering and the optimiser pass are not yet implemented (the reference
-    gates the latter two behind experimental flags).
+  - Away scheduling covers within-pool away node types (well-known taint
+    sets at reduced priority); cross-pool away nodes and the optimiser pass
+    are not yet implemented (experimental/flag-gated in the reference).
 """
 
 from __future__ import annotations
@@ -218,7 +218,7 @@ class ReferenceSolver:
 
     # ------------------------------------------------------- fitting helpers
 
-    def _static_fit(self, j: int, n: int, extra_sel) -> bool:
+    def _static_fit(self, j: int, n: int, extra_sel, extra_tol=None) -> bool:
         """Taints, selector, total resources (StaticJobRequirementsMet,
         nodematching.go:161-190)."""
         snap = self.snap
@@ -234,6 +234,8 @@ class ReferenceSolver:
         ) & np.uint32(1):
             return False  # node affinity (nodematching.go:242-255)
         tolerated = snap.job_tolerated[j] | self.extra_tolerated[j]
+        if extra_tol is not None:
+            tolerated = tolerated | extra_tol
         if (snap.node_taint_bits[n] & ~tolerated).any():
             return False
         required = snap.job_selector[j]
@@ -257,10 +259,12 @@ class ReferenceSolver:
             keys.append(self.alloc[row, :, ri] // res)
         return np.lexsort(keys)
 
-    def _select_at_row(self, j: int, row: int, extra_sel) -> int | None:
+    def _select_at_row(self, j: int, row: int, extra_sel, extra_tol=None) -> int | None:
         for n in self._candidate_order(row):
             n = int(n)
-            if self._static_fit(j, n, extra_sel) and self._dynamic_fit(j, n, row):
+            if self._static_fit(j, n, extra_sel, extra_tol) and self._dynamic_fit(
+                j, n, row
+            ):
                 return n
         return None
 
@@ -286,22 +290,48 @@ class ReferenceSolver:
                 return n, priority
             return None, R_JOB_NO_FIT
 
+        # Home scheduling at the job's own priority.
+        result = self._select_home_chain(j, priority, extra_sel, extra_tol=None)
+        if result is not None:
+            return result
+
+        # Away scheduling (nodedb.go:487-501): each away node type adds
+        # tolerations for its well-known taints and retries the whole chain
+        # at the away priority. The job is then bound at that priority.
+        ci = snap.pc_names.index(self.job_pc_name[j])
+        for a in range(int(snap.pc_away_count[ci])):
+            away_prio = int(snap.pc_away_prio[ci, a])
+            away_tol = snap.pc_away_tol[ci, a]
+            result = self._select_home_chain(
+                j, away_prio, extra_sel, extra_tol=away_tol
+            )
+            if result is not None:
+                self.sched_prio[j] = away_prio  # ScheduledAtPriority
+                return result
+
+        return None, R_JOB_NO_FIT
+
+    def _select_home_chain(self, j, priority, extra_sel, extra_tol):
+        """selectNodeForJobWithTxnAtPriority (nodedb.go:597-662): no-preempt
+        row, feasibility gate, fair preemption, urgency preemption."""
+        snap = self.snap
+
         # Try at EvictedPriority: fits without preempting anyone. The
         # recorded preempted-at priority is the scan row's priority
         # (nodedb.go:796-799).
-        n = self._select_at_row(j, 0, extra_sel)
+        n = self._select_at_row(j, 0, extra_sel, extra_tol)
         if n is not None:
             return n, EVICTED_PRIORITY
 
-        # Check at the job's own priority; if impossible, give up early.
+        # Check at the target priority; if impossible, give up early.
         row = self._row_of[priority]
-        n = self._select_at_row(j, row, extra_sel)
+        n = self._select_at_row(j, row, extra_sel, extra_tol)
         if n is None:
-            return None, R_JOB_NO_FIT
+            return None
 
         # Fair preemption: prevent re-scheduling of evicted jobs appearing
         # latest in the fairness order (nodedb.go:803-899).
-        res = self._fair_preemption(j, extra_sel)
+        res = self._fair_preemption(j, extra_sel, extra_tol)
         if res is not None:
             return res
 
@@ -311,13 +341,13 @@ class ReferenceSolver:
             level = int(snap.priorities[r])
             if level > priority:
                 break
-            n = self._select_at_row(j, r, extra_sel)
+            n = self._select_at_row(j, r, extra_sel, extra_tol)
             if n is not None:
                 return n, level
 
-        return None, R_JOB_NO_FIT
+        return None
 
-    def _fair_preemption(self, j: int, extra_sel):
+    def _fair_preemption(self, j: int, extra_sel, extra_tol=None):
         snap = self.snap
         avail: dict[int, np.ndarray] = {}
         pending: dict[int, list] = {}
@@ -334,7 +364,7 @@ class ReferenceSolver:
             pending[n].append(e)
             if not (self.req_fit[j] <= avail[n]).all():
                 continue
-            if not self._static_fit(j, n, extra_sel):
+            if not self._static_fit(j, n, extra_sel, extra_tol):
                 static_unmet.add(n)
                 continue
             # Permanently unbind the consumed evicted jobs: they can no

@@ -1,0 +1,132 @@
+"""Home/away scheduling: away node types (well-known taint sets) tried at
+reduced priority after home scheduling fails (nodedb.go:487-595), with
+kernel/oracle parity."""
+
+import numpy as np
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.priorities import AwayNodeType, PriorityClass
+from armada_tpu.core.types import JobSpec, NodeSpec, QueueSpec, Taint, Toleration
+from armada_tpu.snapshot.round import build_round_snapshot
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round, prep_device_round
+from armada_tpu.solver.reference import ReferenceSolver
+
+# "gpu" nodes are tainted; gpu jobs tolerate natively at high priority;
+# cpu jobs may run away on gpu nodes at low priority.
+AWAY_CFG = SchedulingConfig(
+    priority_classes={
+        "gpu-native": PriorityClass("gpu-native", 30000, preemptible=False),
+        "cpu": PriorityClass(
+            "cpu",
+            10000,
+            preemptible=True,
+            away_node_types=(AwayNodeType(priority=500, well_known_node_type="gpu-node"),),
+        ),
+    },
+    default_priority_class="cpu",
+    well_known_node_types={
+        "gpu-node": (Taint("gpu", "true", "NoSchedule"),),
+    },
+)
+
+
+def nodes(n_cpu=1, n_gpu=2):
+    out = [
+        NodeSpec(id=f"cpu-{i}", pool="default",
+                 total_resources={"cpu": "8", "memory": "32Gi"})
+        for i in range(n_cpu)
+    ]
+    out += [
+        NodeSpec(id=f"gpu-{i}", pool="default",
+                 taints=(Taint("gpu", "true", "NoSchedule"),),
+                 total_resources={"cpu": "16", "memory": "64Gi"})
+        for i in range(n_gpu)
+    ]
+    return out
+
+
+def both(cfg, ns, queues, running, queued):
+    snap = build_round_snapshot(cfg, "default", ns, queues, running, queued)
+    oracle = ReferenceSolver(snap).solve()
+    out = solve_round(pad_device_round(prep_device_round(snap)))
+    J = snap.num_jobs
+    assert (oracle.assigned_node == out["assigned_node"][:J]).all(), (
+        oracle.assigned_node, out["assigned_node"][:J]
+    )
+    assert (oracle.scheduled_mask == out["scheduled_mask"][:J]).all()
+    assert (oracle.preempted_mask == out["preempted_mask"][:J]).all()
+    assert (oracle.scheduled_priority == out["scheduled_priority"][:J]).all()
+    return snap, oracle
+
+
+def cpu_job(i, cpu="4"):
+    return JobSpec(id=f"c{i}", queue="q", priority_class="cpu",
+                   requests={"cpu": cpu, "memory": "1Gi"}, submitted_ts=float(i))
+
+
+def test_away_overflow_onto_tainted_nodes():
+    # 1 cpu node (8 cpu) + 2 gpu nodes; 4 cpu jobs x 4 cpu: two land home,
+    # two overflow away onto gpu nodes at the away priority 500.
+    queued = [cpu_job(i) for i in range(4)]
+    snap, res = both(AWAY_CFG, nodes(), [QueueSpec("q")], [], queued)
+    assert res.scheduled_mask.sum() == 4
+    placements = {snap.job_ids[j]: snap.node_ids[res.assigned_node[j]]
+                  for j in range(4)}
+    home = [j for j, n in placements.items() if n.startswith("cpu-")]
+    away = [j for j, n in placements.items() if n.startswith("gpu-")]
+    assert len(home) == 2 and len(away) == 2
+    for jid in away:
+        j = snap.job_ids.index(jid)
+        assert res.scheduled_priority[j] == 500  # bound at away priority
+
+
+def test_native_jobs_preempt_away_jobs():
+    # An away cpu job (bound at 500) is urgency-preempted by a native gpu
+    # job (30000) when the gpu node fills.
+    running = []
+    # away job occupying the only gpu node (bound at away priority 500)
+    from armada_tpu.core.types import RunningJob
+
+    running = [
+        RunningJob(
+            job=JobSpec(id="away0", queue="q", priority_class="cpu",
+                        requests={"cpu": "12", "memory": "1Gi"},
+                        tolerations=(Toleration(key="gpu", value="true"),)),
+            node_id="gpu-0",
+            scheduled_at_priority=500,
+        )
+    ]
+    native = JobSpec(id="native0", queue="q", priority_class="gpu-native",
+                     requests={"cpu": "12", "memory": "1Gi"},
+                     tolerations=(Toleration(key="gpu", value="true"),),
+                     submitted_ts=10.0)
+    snap, res = both(
+        AWAY_CFG, nodes(n_cpu=0, n_gpu=1), [QueueSpec("q")], running, [native]
+    )
+    n = snap.job_ids.index("native0")
+    a = snap.job_ids.index("away0")
+    assert res.scheduled_mask[n]
+    assert res.preempted_mask[a]  # the away squatter was pushed off
+
+
+def test_no_away_when_home_fits():
+    queued = [cpu_job(0, cpu="2")]
+    snap, res = both(AWAY_CFG, nodes(), [QueueSpec("q")], [], queued)
+    assert snap.node_ids[res.assigned_node[0]].startswith("cpu-")
+    assert res.scheduled_priority[0] == 10000  # home priority
+
+
+def test_away_disabled_without_well_known_taints():
+    cfg = SchedulingConfig(
+        priority_classes={
+            "cpu": PriorityClass(
+                "cpu", 10000, preemptible=True,
+                away_node_types=(AwayNodeType(500, "missing-type"),),
+            ),
+        },
+        default_priority_class="cpu",
+    )
+    queued = [cpu_job(0, cpu="12")]  # only fits gpu nodes
+    snap, res = both(cfg, nodes(n_cpu=1, n_gpu=1), [QueueSpec("q")], [], queued)
+    assert res.scheduled_mask.sum() == 0  # no away capability granted
