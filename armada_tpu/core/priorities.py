@@ -51,5 +51,10 @@ def priority_levels(priority_classes: dict[str, PriorityClass]) -> list[int]:
     levels = {pc.priority for pc in priority_classes.values()}
     for pc in priority_classes.values():
         for away in pc.away_node_types:
+            if away.priority <= EVICTED_PRIORITY:
+                raise ValueError(
+                    f"away priority {away.priority} of class {pc.name!r} must "
+                    f"be greater than the evicted priority {EVICTED_PRIORITY}"
+                )
             levels.add(away.priority)
     return [EVICTED_PRIORITY] + sorted(levels)

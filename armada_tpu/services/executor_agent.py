@@ -1,0 +1,166 @@
+"""Remote executor agent: a separate process speaking the lease protocol.
+
+The reference's executor (internal/executor/application.go) is an agent per
+worker cluster: it reports node state, receives leases over the
+ExecutorApi stream, creates pods, and reports their lifecycle. This agent
+is the same loop over the gRPC ExecutorLease/ReportEvents methods, with a
+simulated pod runtime (the fake cluster context) — swap `_PodRuntime` for a
+real container backend to manage actual machines.
+
+  python -m armada_tpu.services.executor_agent \
+      --server HOST:PORT --name clusterA --nodes 100 --cpu 8 [--pool p]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from .grpc_api import ApiClient
+
+
+class _PodRuntime:
+    """Simulated pods: timed sleeps, like the reference fake executor."""
+
+    def __init__(self, runtime_s: float = 30.0, startup_s: float = 0.0):
+        self.runtime_s = runtime_s
+        self.startup_s = startup_s
+        self.pods: dict[str, dict] = {}  # run_id -> pod record
+
+    def create(self, lease: dict, now: float):
+        self.pods[lease["run_id"]] = {
+            **lease,
+            "created": now,
+            "phase": "created",
+        }
+
+    def kill(self, run_id: str):
+        self.pods.pop(run_id, None)
+
+    def poll(self, now: float) -> list[dict]:
+        """Phase transitions since last poll, as ReportEvents items."""
+        events = []
+        for pod in list(self.pods.values()):
+            base = {
+                "job_id": pod["job_id"],
+                "run_id": pod["run_id"],
+                "queue": pod["queue"],
+                "jobset": pod["jobset"],
+                "created": now,
+            }
+            if pod["phase"] == "created":
+                events.append({"type": "pending", **base})
+                pod["phase"] = "pending"
+            elif pod["phase"] == "pending" and now >= pod["created"] + self.startup_s:
+                events.append({"type": "running", **base})
+                pod["phase"] = "running"
+                pod["started"] = now
+            elif (
+                pod["phase"] == "running"
+                and now >= pod["started"] + self.runtime_s
+            ):
+                events.append({"type": "succeeded", **base})
+                self.pods.pop(pod["run_id"], None)
+        return events
+
+
+class ExecutorAgent:
+    def __init__(
+        self,
+        client: ApiClient,
+        name: str,
+        nodes: list[dict],
+        pool: str = "default",
+        runtime: _PodRuntime | None = None,
+    ):
+        self.client = client
+        self.name = name
+        self.pool = pool
+        self.nodes = nodes
+        self.runtime = runtime or _PodRuntime()
+        self.acked: set[str] = set()
+
+    def tick(self, now: float | None = None) -> dict:
+        now = time.time() if now is None else now
+        reply = self.client._call(
+            "ExecutorLease",
+            {
+                "executor": self.name,
+                "pool": self.pool,
+                "nodes": self.nodes,
+                "acked_run_ids": sorted(self.acked),
+            },
+        )
+        for lease in reply.get("leases", []):
+            if lease["run_id"] not in self.acked:
+                # create before ack: a failed create must be re-leased
+                self.runtime.create(lease, now)
+                self.acked.add(lease["run_id"])
+        for cancel in reply.get("cancel_runs", []):
+            self.runtime.kill(cancel["run_id"])
+        events = self.runtime.poll(now)
+        # Reconciliation: runs the server believes are live here but the
+        # runtime doesn't know (agent restart, lost pod) are reported
+        # failed so the scheduler retries them elsewhere (the reference
+        # executor's missing-pod reconciliation).
+        for run in reply.get("active_runs", []):
+            if run["run_id"] not in self.runtime.pods:
+                events.append(
+                    {
+                        "type": "failed",
+                        "job_id": run["job_id"],
+                        "run_id": run["run_id"],
+                        "queue": run["queue"],
+                        "jobset": run["jobset"],
+                        "created": now,
+                        "error": "pod missing on executor (restart or loss)",
+                        "retryable": True,
+                    }
+                )
+        if events:
+            self.client._call("ReportEvents", {"events": events})
+        # Prune acks for pods that no longer exist: completed runs don't
+        # need acks (the server only re-sends LEASED runs), and the set
+        # must not grow forever.
+        self.acked &= set(self.runtime.pods)
+        return reply
+
+    def run(self, interval: float = 1.0):
+        while True:
+            try:
+                self.tick()
+            except Exception as e:  # control plane hiccup: retry next tick
+                print(f"executor {self.name}: tick failed: {e!r}")
+            time.sleep(interval)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="armada-tpu-executor")
+    ap.add_argument("--server", default="127.0.0.1:50051")
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--pool", default="default")
+    ap.add_argument("--nodes", type=int, default=10)
+    ap.add_argument("--cpu", default="8")
+    ap.add_argument("--memory", default="128Gi")
+    ap.add_argument("--runtime", type=float, default=30.0)
+    ap.add_argument("--interval", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    nodes = [
+        {
+            "id": f"{args.name}-node-{i:05d}",
+            "total_resources": {"cpu": args.cpu, "memory": args.memory},
+        }
+        for i in range(args.nodes)
+    ]
+    agent = ExecutorAgent(
+        ApiClient(args.server),
+        args.name,
+        nodes,
+        pool=args.pool,
+        runtime=_PodRuntime(runtime_s=args.runtime),
+    )
+    agent.run(args.interval)
+
+
+if __name__ == "__main__":
+    main()

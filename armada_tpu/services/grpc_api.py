@@ -226,6 +226,133 @@ class ApiServer:
     def _list_priority_overrides(self, req):
         return {"overrides": dict(self.scheduler.priority_overrides)}
 
+    # ---- executor API (the LeaseJobRuns protocol,
+    # pkg/executorapi/executorapi.proto:106-115) ----
+
+    def _executor_lease(self, req):
+        """One heartbeat exchange: the executor reports its nodes and acked
+        run ids; the reply carries new leases and runs to cancel/preempt."""
+        from ..core.types import NodeSpec, Taint
+        from ..jobdb import JobState
+        from .scheduler import ExecutorHeartbeat
+
+        name = req["executor"]
+        pool = req.get("pool", "default")
+        nodes = [
+            NodeSpec(
+                id=n["id"],
+                name=n.get("name", n["id"]),
+                executor=name,
+                pool=pool,
+                labels=dict(n.get("labels", {})),
+                taints=tuple(
+                    Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+                    for t in n.get("taints", ())
+                ),
+                total_resources=dict(n.get("total_resources", {})),
+                unschedulable=bool(n.get("unschedulable", False)),
+            )
+            for n in req.get("nodes", [])
+        ]
+        import time as _t
+
+        self.scheduler.report_executor(
+            ExecutorHeartbeat(name=name, pool=pool, nodes=nodes, last_seen=_t.time())
+        )
+
+        acked = set(req.get("acked_run_ids", []))
+        leases, cancels, active = [], [], []
+        txn = self.scheduler.jobdb.read_txn()
+        for job in txn.all_jobs():
+            run = job.latest_run
+            if run is None or run.executor != name:
+                continue
+            if job.state == JobState.LEASED and run.id not in acked:
+                leases.append(
+                    {
+                        "run_id": run.id,
+                        "job_id": job.id,
+                        "queue": job.queue,
+                        "jobset": job.jobset,
+                        "node_id": run.node_id,
+                        "scheduled_at_priority": run.scheduled_at_priority,
+                        "spec": {
+                            "id": job.spec.id,
+                            "requests": job.spec.requests,
+                            "annotations": job.spec.annotations,
+                        },
+                    }
+                )
+            elif job.state in (JobState.PENDING, JobState.RUNNING):
+                # Runs the server believes are live here: the agent
+                # reconciles pods it doesn't actually have (restart/loss).
+                active.append(
+                    {
+                        "run_id": run.id,
+                        "job_id": job.id,
+                        "queue": job.queue,
+                        "jobset": job.jobset,
+                    }
+                )
+            elif (
+                job.state
+                in (JobState.CANCELLED, JobState.PREEMPTED, JobState.FAILED)
+                and run.id in acked
+            ):
+                # killed underneath the executor: tear the pod down
+                # (SUCCEEDED pods exit on their own; no cancel for them)
+                cancels.append({"run_id": run.id, "job_id": job.id})
+        return {"leases": leases, "cancel_runs": cancels, "active_runs": active}
+
+    def _report_events(self, req):
+        """Executor-side state transitions republished to the log
+        (ExecutorApi.ReportEvents, api.go:347)."""
+        from ..events import (
+            EventSequence,
+            JobRunErrors,
+            JobRunPending,
+            JobRunRunning,
+            JobRunSucceeded,
+            JobSucceeded,
+        )
+
+        type_map = {
+            "pending": lambda e: [
+                JobRunPending(created=e["created"], job_id=e["job_id"],
+                              run_id=e["run_id"])
+            ],
+            "running": lambda e: [
+                JobRunRunning(created=e["created"], job_id=e["job_id"],
+                              run_id=e["run_id"])
+            ],
+            "succeeded": lambda e: [
+                JobRunSucceeded(created=e["created"], job_id=e["job_id"],
+                                run_id=e["run_id"]),
+                JobSucceeded(created=e["created"], job_id=e["job_id"]),
+            ],
+            "failed": lambda e: [
+                JobRunErrors(created=e["created"], job_id=e["job_id"],
+                             run_id=e["run_id"], error=e.get("error", ""),
+                             retryable=bool(e.get("retryable", True))),
+            ],
+        }
+        items = req.get("events", [])
+        # Validate the whole batch before publishing anything: a malformed
+        # item must not leave a half-published batch that a client retry
+        # would duplicate into the durable log.
+        for item in items:
+            if item.get("type") not in type_map:
+                raise ValueError(f"unknown event type {item.get('type')!r}")
+            for key in ("job_id", "run_id", "queue", "jobset", "created"):
+                if key not in item:
+                    raise ValueError(f"event missing field {key!r}: {item}")
+        for item in items:
+            events = type_map[item["type"]](item)
+            self.log.publish(
+                EventSequence.of(item["queue"], item["jobset"], *events)
+            )
+        return {}
+
     def _get_logs(self, req):
         if self.binoculars is None:
             raise KeyError("binoculars not enabled")
@@ -302,6 +429,8 @@ class ApiServer:
             "CordonNode": self._cordon_node,
             "SetPriorityOverride": self._set_priority_override,
             "ListPriorityOverrides": self._list_priority_overrides,
+            "ExecutorLease": self._executor_lease,
+            "ReportEvents": self._report_events,
         }
 
     def serve(self, port: int = 0, max_workers: int = 8):

@@ -143,6 +143,7 @@ class SchedulerService:
         self.ingester.sync()
         sequences: list[EventSequence] = []
         sequences += self._expire_stale_executors(now)
+        sequences += self._handle_failed_runs(now)
 
         # Scheduling through the runner seam: sync solves inline; async
         # applies the previous solve's result first and only starts the next
@@ -249,6 +250,29 @@ class SchedulerService:
             sequences.append(
                 EventSequence.of(job.queue, job.jobset, *events)
             )
+        return sequences
+
+    def _handle_failed_runs(self, now: float) -> list[EventSequence]:
+        """Runs reported failed by executors: requeue the job (with the
+        failed node recorded for anti-affinity) or fail it after max
+        retries (scheduler.go:589-636 generateUpdateMessages)."""
+        from ..jobdb.jobdb import RunState
+
+        sequences = []
+        txn = self.jobdb.read_txn()
+        for job in txn.all_jobs():
+            if job.state.terminal or job.state == JobState.QUEUED:
+                continue
+            run = job.latest_run
+            if run is None or run.state != RunState.FAILED:
+                continue
+            if job.num_attempts >= self.config.max_retries + 1:
+                event = JobErrors(
+                    created=now, job_id=job.id, error="max retries exceeded"
+                )
+            else:
+                event = JobRequeued(created=now, job_id=job.id)
+            sequences.append(EventSequence.of(job.queue, job.jobset, event))
         return sequences
 
     def _build_pool_inputs(

@@ -194,6 +194,107 @@ def test_lookout_http(plane, client):
         lk.stop()
 
 
+def test_remote_executor_agent():
+    """Full lease protocol over real gRPC: a remote agent (no in-process
+    executor) heartbeats, receives leases, runs pods, reports lifecycle."""
+    from armada_tpu.services.executor_agent import ExecutorAgent, _PodRuntime
+
+    p = ControlPlane(SchedulingConfig(), cycle_period=0.05).start()
+    try:
+        client = ApiClient(p.address)
+        client.create_queue("remote")
+        agent = ExecutorAgent(
+            ApiClient(p.address),
+            "remote-exec",
+            nodes=[
+                {"id": f"rn-{i}", "total_resources": {"cpu": "8", "memory": "32Gi"}}
+                for i in range(2)
+            ],
+            runtime=_PodRuntime(runtime_s=1.0),
+        )
+        agent.tick()  # register nodes
+        ids = client.submit_jobs(
+            "remote", "rset", [{"requests": {"cpu": "2", "memory": "1Gi"}} for _ in range(3)]
+        )
+
+        def all_in(*states):
+            return all(
+                (j := p.scheduler.jobdb.get(i)) is not None and j.state.value in states
+                for i in ids
+            )
+
+        assert _wait(lambda: all_in("leased") or all_in("leased", "pending", "running"))
+        agent.tick()  # pick up leases -> pods created -> pending
+        assert _wait(lambda: all_in("pending", "running"))
+        agent.tick()  # running
+        deadline = time.time() + 15
+        while time.time() < deadline and not all_in("succeeded"):
+            agent.tick()
+            time.sleep(0.2)
+        assert all_in("succeeded")
+        # run/node info round-tripped through the protocol
+        run = p.scheduler.jobdb.get(ids[0]).latest_run
+        assert run.executor == "remote-exec"
+        assert run.node_id.startswith("rn-")
+    finally:
+        p.stop()
+
+
+def test_executor_agent_restart_reconciliation():
+    """An agent restart loses its pods; the protocol's active-run
+    reconciliation reports them failed and the scheduler retries."""
+    from armada_tpu.services.executor_agent import ExecutorAgent, _PodRuntime
+
+    p = ControlPlane(SchedulingConfig(), cycle_period=0.05).start()
+    try:
+        client = ApiClient(p.address)
+        client.create_queue("rr")
+        agent = ExecutorAgent(
+            ApiClient(p.address),
+            "rr-exec",
+            nodes=[
+                {"id": f"rr-{i}", "total_resources": {"cpu": "8", "memory": "32Gi"}}
+                for i in range(2)
+            ],
+            runtime=_PodRuntime(runtime_s=300.0),
+        )
+        agent.tick()
+        (jid,) = client.submit_jobs("rr", "s", [{"requests": {"cpu": "2", "memory": "1Gi"}}])
+        assert _wait(lambda: (j := p.scheduler.jobdb.get(jid)) and j.state.value == "leased")
+        agent.tick()  # pod created -> pending
+        agent.tick()  # running
+        assert _wait(lambda: p.scheduler.jobdb.get(jid).state.value == "running")
+        first_run = p.scheduler.jobdb.get(jid).latest_run.id
+
+        # "restart": fresh agent, empty runtime and acks
+        agent2 = ExecutorAgent(
+            ApiClient(p.address), "rr-exec", nodes=agent.nodes,
+            runtime=_PodRuntime(runtime_s=1.0),
+        )
+        agent2.tick()  # reconciliation reports the run failed
+
+        def retried():
+            j = p.scheduler.jobdb.get(jid)
+            return (
+                j is not None
+                and j.num_attempts >= 2
+                and j.latest_run.id != first_run
+                and j.state.value in ("leased", "pending", "running", "succeeded")
+            )
+
+        assert _wait(retried, timeout=15)
+        j = p.scheduler.jobdb.get(jid)
+        assert first_run not in {r.id for r in j.runs if r.state.value != "failed"}
+        # second attempt completes on the new agent
+        deadline = time.time() + 15
+        while time.time() < deadline and p.scheduler.jobdb.get(jid).state.value != "succeeded":
+            agent2.tick()
+            time.sleep(0.2)
+        assert p.scheduler.jobdb.get(jid).state.value == "succeeded"
+    finally:
+        p.stop()
+
+
 def test_file_lease_leader(tmp_path):
     path = str(tmp_path / "lease")
     a = FileLeaseLeader(path, lease_duration=0.5, identity="a")
