@@ -303,7 +303,12 @@ def build_round_snapshot(
     jprio = np.asarray([j.priority for j in jobs], dtype=np.int64)
     jts = np.asarray([j.submitted_ts for j in jobs], dtype=np.float64)
     jids = np.asarray([j.id for j in jobs])
-    job_bid = np.asarray([j.bid_price(pool) for j in jobs], dtype=np.float64)
+    # Bid prices only matter in market mode; skip 1M python calls otherwise.
+    job_bid = (
+        np.asarray([j.bid_price(pool) for j in jobs], dtype=np.float64)
+        if config.market_driven
+        else np.zeros(J, dtype=np.float64)
+    )
     if config.market_driven:
         # Running non-preemptible jobs carry an effectively infinite price
         # (pricing.NonPreemptibleRunningPrice): they always win rescheduling.
