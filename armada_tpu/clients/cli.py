@@ -127,6 +127,12 @@ def cmd_cordon(args):
     print(f"{args.action}ed {args.node_id}")
 
 
+def cmd_cordon_executor(args):
+    client = connect(args.server)
+    client.cordon_executor(args.name, uncordon=args.action == "uncordon")
+    print(f"{args.action}ed executor {args.name}")
+
+
 def cmd_report(args):
     client = connect(args.server)
     if args.kind == "scheduling":
@@ -240,6 +246,11 @@ def build_parser():
     cd.add_argument("action", choices=["cordon", "uncordon"])
     cd.add_argument("node_id")
     cd.set_defaults(fn=cmd_cordon)
+
+    ce = sub.add_parser("executor", help="cordon/uncordon a whole executor")
+    ce.add_argument("action", choices=["cordon", "uncordon"])
+    ce.add_argument("name")
+    ce.set_defaults(fn=cmd_cordon_executor)
 
     rep = sub.add_parser("report")
     rep.add_argument("kind", choices=["scheduling", "queue", "job"])

@@ -242,6 +242,11 @@ class SchedulingConfig:
             ("spotPriceCutoff", "spot_price_cutoff", float),
             ("shortJobPenaltySeconds", "short_job_penalty_s", float),
             ("executorTimeout", "executor_timeout_s", float),
+            (
+                "maxUnacknowledgedJobsPerExecutor",
+                "max_unacknowledged_jobs_per_executor",
+                int,
+            ),
             ("enablePreferLargeJobOrdering", "enable_prefer_large_job_ordering", bool),
         ]:
             if yaml_key in d:

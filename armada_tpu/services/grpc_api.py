@@ -226,6 +226,12 @@ class ApiServer:
     def _list_priority_overrides(self, req):
         return {"overrides": dict(self.scheduler.priority_overrides)}
 
+    def _cordon_executor(self, req):
+        self.scheduler.set_executor_cordon(
+            req["executor"], not req.get("uncordon", False)
+        )
+        return {}
+
     # ---- executor API (the LeaseJobRuns protocol,
     # pkg/executorapi/executorapi.proto:106-115) ----
 
@@ -431,6 +437,7 @@ class ApiServer:
             "ListPriorityOverrides": self._list_priority_overrides,
             "ExecutorLease": self._executor_lease,
             "ReportEvents": self._report_events,
+            "CordonExecutor": self._cordon_executor,
         }
 
     def serve(self, port: int = 0, max_workers: int = 8):
@@ -586,6 +593,11 @@ class ApiClient:
 
     def cordon_node(self, node_id, uncordon=False):
         self._call("CordonNode", {"node_id": node_id, "uncordon": uncordon})
+
+    def cordon_executor(self, executor, uncordon=False):
+        self._call(
+            "CordonExecutor", {"executor": executor, "uncordon": uncordon}
+        )
 
     def watch_jobset(self, queue, jobset, from_offset=0, watch=True):
         fn = self.channel.unary_stream(

@@ -67,6 +67,7 @@ class SchedulerService:
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
         self.priority_overrides: dict[str, float] = {}
         self.cordoned_queues: set[str] = set()
+        self.cordoned_executors: set[str] = set()
         self.executors: dict[str, ExecutorHeartbeat] = {}
         self.is_leader = is_leader
         self.cycle_count = 0
@@ -121,6 +122,14 @@ class SchedulerService:
 
     def report_executor(self, hb: ExecutorHeartbeat):
         self.executors[hb.name] = hb
+
+    def set_executor_cordon(self, name: str, cordoned: bool):
+        """Cordon a whole executor cluster: no new placements there
+        (the reference's executor cordon via executor settings)."""
+        if cordoned:
+            self.cordoned_executors.add(name)
+        else:
+            self.cordoned_executors.discard(name)
 
     # ---- cycle ----
 
@@ -196,6 +205,7 @@ class SchedulerService:
         executors = dict(self.executors)
         cordoned = set(self.cordoned_queues)
         overrides = dict(self.priority_overrides)
+        skipped = self._skipped_executors(executors)
         pools = {hb.pool for hb in executors.values()} or {
             p.name for p in self.config.pools
         }
@@ -205,6 +215,7 @@ class SchedulerService:
             pool_seqs = self._schedule_pool(
                 pool, now, exclude=leased_this_cycle,
                 executors=executors, cordoned=cordoned, overrides=overrides,
+                skipped=skipped,
             )
             for seq in pool_seqs:
                 for event in seq.events:
@@ -212,6 +223,29 @@ class SchedulerService:
                         leased_this_cycle.add(event.job_id)
             sequences += pool_seqs
         return sequences
+
+    def _skipped_executors(self, executors: dict) -> set[str]:
+        """Executors excluded from this round: operator-cordoned, or
+        lagging on lease acknowledgement (maxUnacknowledgedJobsPerExecutor,
+        scheduling_algo.go:1049-1066). Their running jobs still count toward
+        queue usage; their nodes are just not schedulable. Computed once per
+        cycle from a snapshot — pool-independent."""
+        skipped = set(self.cordoned_executors)
+        limit = self.config.max_unacknowledged_jobs_per_executor
+        if limit:
+            unacked: dict[str, int] = {}
+            txn = self.jobdb.read_txn()
+            for job in txn.leased_jobs():
+                run = job.latest_run
+                if run is not None and job.state == JobState.LEASED:
+                    unacked[run.executor] = unacked.get(run.executor, 0) + 1
+            for name, count in unacked.items():
+                if count > limit and name in executors:
+                    skipped.add(name)
+                    self.log_.with_fields(executor=name, unacked=count).warning(
+                        "executor lagging on lease acks; skipping this round"
+                    )
+        return skipped
 
     def _expire_stale_executors(self, now: float) -> list[EventSequence]:
         """Jobs on executors that stopped heartbeating are requeued or
@@ -281,12 +315,15 @@ class SchedulerService:
         exclude: set[str] = frozenset(),
         executors: dict | None = None,
         overrides: dict | None = None,
+        skipped: set[str] | None = None,
     ):
         executors = executors if executors is not None else dict(self.executors)
+        if skipped is None:
+            skipped = self._skipped_executors(executors)
         nodes: list[NodeSpec] = []
         node_executor: dict[str, str] = {}
         for hb in executors.values():
-            if hb.pool != pool:
+            if hb.pool != pool or hb.name in skipped:
                 continue
             for node in hb.nodes:
                 nodes.append(node)
@@ -354,6 +391,7 @@ class SchedulerService:
         executors: dict | None = None,
         cordoned: set | None = None,
         overrides: dict | None = None,
+        skipped: set[str] | None = None,
     ) -> list[EventSequence]:
         (
             nodes,
@@ -363,7 +401,7 @@ class SchedulerService:
             node_executor,
             txn,
             excluded_nodes,
-        ) = self._build_pool_inputs(pool, exclude, executors, overrides)
+        ) = self._build_pool_inputs(pool, exclude, executors, overrides, skipped)
         if not nodes or not (queued or running):
             return []
         snap = build_round_snapshot(

@@ -335,3 +335,10 @@ jobs:
     out = capsys.readouterr().out
     assert '"total": 3' in out
     main(["--server", plane.address, "report", "scheduling"])
+
+
+def test_cordon_executor_over_grpc(client, plane):
+    client.cordon_executor("fake-a")
+    assert "fake-a" in plane.scheduler.cordoned_executors
+    client.cordon_executor("fake-a", uncordon=True)
+    assert "fake-a" not in plane.scheduler.cordoned_executors

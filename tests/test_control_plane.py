@@ -213,3 +213,68 @@ def test_cancel_jobset():
     txn = sched.jobdb.read_txn()
     assert sum(1 for j in txn.all_jobs() if j.state == JobState.CANCELLED) == 3
     assert txn.get("job-0010").state == JobState.QUEUED
+
+
+def test_executor_cordon_diverts_placement():
+    """Cordoning a whole executor removes its nodes from rounds; jobs go to
+    the other cluster; uncordon restores it (executor settings cordon,
+    scheduling_algo.go executor filters)."""
+    config, log, sched, submit, ex_a = mk_stack(n_nodes=2)
+    ex_b = FakeExecutor(
+        "cluster-b", log, sched,
+        nodes=make_nodes("cluster-b", count=2, cpu="16", memory="64Gi"),
+        runtime_for=lambda job_id: 10.0,
+    )
+    submit.create_queue(QueueSpec("q"))
+    sched.set_executor_cordon("cluster-a", True)
+    t = 0.0
+    submit.submit("q", "s", [job(i) for i in range(4)], now=t)
+    for _ in range(3):
+        t += 1.0
+        ex_a.tick(t)
+        ex_b.tick(t)
+        sched.cycle(now=t)
+    txn = sched.jobdb.read_txn()
+    placed = [j.latest_run.executor for j in txn.all_jobs() if j.latest_run]
+    assert placed and all(e == "cluster-b" for e in placed)
+    # uncordon: new work can land on cluster-a again
+    sched.set_executor_cordon("cluster-a", False)
+    submit.submit("q", "s2", [job(100 + i, cpu="14") for i in range(4)], now=t)
+    for _ in range(3):
+        t += 1.0
+        ex_a.tick(t)
+        ex_b.tick(t)
+        sched.cycle(now=t)
+    txn = sched.jobdb.read_txn()
+    placed = {j.latest_run.executor for j in txn.all_jobs() if j.latest_run}
+    assert "cluster-a" in placed
+
+
+def test_lagging_executor_skipped():
+    """An executor sitting on too many unacknowledged leases is excluded
+    from new rounds until it acks (maxUnacknowledgedJobsPerExecutor,
+    scheduling_algo.go:1049-1066)."""
+    config, log, sched, submit, ex_a = mk_stack(
+        n_nodes=2, max_unacknowledged_jobs_per_executor=2
+    )
+    submit.create_queue(QueueSpec("q"))
+    t = 1.0
+    ex_a.tick(t)  # heartbeat so nodes register
+    submit.submit("q", "s", [job(i, cpu="1", mem="1Gi") for i in range(6)], now=t)
+    # cycle WITHOUT executor ticks: leases pile up unacknowledged
+    sched.cycle(now=t)
+    txn = sched.jobdb.read_txn()
+    leased = [j for j in txn.all_jobs() if j.state == JobState.LEASED]
+    assert len(leased) == 6
+    # more work arrives; the lagging executor must be skipped entirely
+    submit.submit("q", "s2", [job(10 + i, cpu="1", mem="1Gi") for i in range(2)], now=t + 1)
+    sched.cycle(now=t + 1)
+    txn = sched.jobdb.read_txn()
+    still_queued = [j for j in txn.all_jobs() if j.state == JobState.QUEUED]
+    assert len(still_queued) == 2
+    # the executor acks (ticks): leases progress, next round can place again
+    t += 2.0
+    ex_a.tick(t)
+    sched.cycle(now=t)
+    txn = sched.jobdb.read_txn()
+    assert all(j.state != JobState.QUEUED for j in txn.all_jobs())
