@@ -130,6 +130,31 @@ class QueueDelete(Event):
 
 
 @dataclass(frozen=True)
+class ExecutorCordon(Event):
+    """Control-plane event: executor-level cordon toggled (the reference's
+    executor settings upsert/delete, pkg/controlplaneevents/events.proto).
+    Event-sourced so the setting survives control-plane restarts."""
+
+    name: str = ""
+    cordoned: bool = False
+
+
+@dataclass(frozen=True)
+class PriorityOverride(Event):
+    """Control-plane event: external queue priority override set/cleared
+    (internal/scheduler/priorityoverride). cleared=True removes it."""
+
+    queue: str = ""
+    priority_factor: float = 0.0
+    cleared: bool = False
+
+
+# Synthetic jobset key for control-plane (non-job) events: queue CRUD,
+# executor settings, priority overrides.
+CONTROL_PLANE_JOBSET = "__control-plane__"
+
+
+@dataclass(frozen=True)
 class EventSequence:
     """A batch of events for one (queue, jobset), the log's unit of
     publication (events.proto:66; jobset-keyed routing as in

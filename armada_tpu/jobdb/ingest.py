@@ -124,10 +124,15 @@ def categorize_error(error: str, rules) -> str:
 class SchedulerIngester:
     """Cursor-tracked consumer materializing the log into a JobDb."""
 
-    def __init__(self, log, jobdb: JobDb, error_rules=()):
+    def __init__(self, log, jobdb: JobDb, error_rules=(), settings_handler=None):
         self.log = log
         self.jobdb = jobdb
         self.error_rules = error_rules
+        # Optional hook for control-plane settings events (executor cordon,
+        # priority override): called for every event so the owner's
+        # materialized settings stay current on the same cursor as the
+        # jobdb — a standby catches up on its first post-failover sync.
+        self.settings_handler = settings_handler
         self.cursor = 0
 
     def sync(self, limit: int = 10_000) -> int:
@@ -141,6 +146,9 @@ class SchedulerIngester:
             try:
                 for entry in entries:
                     apply_entry(txn, entry, self.error_rules)
+                    if self.settings_handler is not None:
+                        for event in entry.sequence.events:
+                            self.settings_handler(event)
                 txn.commit()
             except Exception:
                 txn.abort()

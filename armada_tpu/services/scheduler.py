@@ -61,7 +61,8 @@ class SchedulerService:
         self.log = log
         self.jobdb = JobDb()
         self.ingester = SchedulerIngester(
-            log, self.jobdb, error_rules=config.error_categories
+            log, self.jobdb, error_rules=config.error_categories,
+            settings_handler=self._apply_settings_event,
         )
         self.backend = backend
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
@@ -76,6 +77,7 @@ class SchedulerService:
 
         self.reports = SchedulingReportsRepository()
         self.metrics = None  # set via attach_metrics
+        self.ingester.sync()  # restore jobdb + event-sourced settings
         from ..utils.logging import get_logger
 
         self.log_ = get_logger("armada_tpu.scheduler")
@@ -99,9 +101,20 @@ class SchedulerService:
 
     def set_priority_override(self, queue: str, priority_factor: float | None):
         """External priority override (internal/scheduler/priorityoverride):
-        replaces the queue's priority factor for scheduling; None clears."""
+        replaces the queue's priority factor for scheduling; None clears.
+        Event-sourced: survives restarts via the durable log. No-op calls
+        (clearing an absent override, re-setting the same value) publish
+        nothing so idempotent retries keep the log bounded."""
+        from ..events.model import CONTROL_PLANE_JOBSET, PriorityOverride
+
         if priority_factor is None:
-            self.priority_overrides.pop(queue, None)
+            if queue not in self.priority_overrides:
+                return
+            self.priority_overrides.pop(queue)
+            self.log.publish(EventSequence.of(
+                "", CONTROL_PLANE_JOBSET,
+                PriorityOverride(created=_time.time(), queue=queue, cleared=True),
+            ))
             return
         import math
 
@@ -110,7 +123,13 @@ class SchedulerService:
             raise ValueError(
                 f"priority factor must be finite and > 0, got {priority_factor!r}"
             )
+        if self.priority_overrides.get(queue) == pf:
+            return
         self.priority_overrides[queue] = pf
+        self.log.publish(EventSequence.of(
+            "", CONTROL_PLANE_JOBSET,
+            PriorityOverride(created=_time.time(), queue=queue, priority_factor=pf),
+        ))
 
     def _effective_queue(self, name: str, overrides: dict | None = None) -> QueueSpec:
         overrides = overrides if overrides is not None else self.priority_overrides
@@ -125,11 +144,39 @@ class SchedulerService:
 
     def set_executor_cordon(self, name: str, cordoned: bool):
         """Cordon a whole executor cluster: no new placements there
-        (the reference's executor cordon via executor settings)."""
+        (the reference's executor cordon via executor settings).
+        Event-sourced: survives restarts via the durable log; no-op calls
+        publish nothing so idempotent retries keep the log bounded."""
+        from ..events.model import CONTROL_PLANE_JOBSET, ExecutorCordon
+
+        if cordoned == (name in self.cordoned_executors):
+            return
         if cordoned:
             self.cordoned_executors.add(name)
         else:
             self.cordoned_executors.discard(name)
+        self.log.publish(EventSequence.of(
+            "", CONTROL_PLANE_JOBSET,
+            ExecutorCordon(created=_time.time(), name=name, cordoned=cordoned),
+        ))
+
+    def _apply_settings_event(self, event):
+        """Materialize control-plane settings events (the reference's
+        executor-settings and override tables from controlplaneevents).
+        Runs inside ingester.sync(), so a standby's first post-failover
+        cycle catches up settings on the same cursor as the jobdb."""
+        from ..events.model import ExecutorCordon, PriorityOverride
+
+        if isinstance(event, ExecutorCordon):
+            if event.cordoned:
+                self.cordoned_executors.add(event.name)
+            else:
+                self.cordoned_executors.discard(event.name)
+        elif isinstance(event, PriorityOverride):
+            if event.cleared:
+                self.priority_overrides.pop(event.queue, None)
+            else:
+                self.priority_overrides[event.queue] = event.priority_factor
 
     # ---- cycle ----
 

@@ -28,7 +28,7 @@ from ..events.model import new_id
 
 # Jobset key under which control-plane (queue CRUD) events are logged,
 # mirroring the reference's separate controlPlaneEvents topic.
-CONTROL_PLANE_JOBSET = "__control-plane__"
+from ..events.model import CONTROL_PLANE_JOBSET  # noqa: F401 (re-export)
 
 
 class SubmissionError(ValueError):

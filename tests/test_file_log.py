@@ -185,3 +185,26 @@ def test_dedup_survives_restart(tmp_path):
         now=1.0,
     )
     assert ids1 == ids2  # dedup index rebuilt from the log
+
+
+def test_settings_survive_restart(tmp_path):
+    """Executor cordon and priority overrides are event-sourced: a fresh
+    scheduler over the same durable log restores them (the reference's
+    executor-settings/override tables from controlplaneevents)."""
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.services.scheduler import SchedulerService
+
+    d = str(tmp_path / "log")
+    log = FileEventLog(d)
+    sched = SchedulerService(SchedulingConfig(), log)
+    sched.set_executor_cordon("cluster-x", True)
+    sched.set_executor_cordon("cluster-y", True)
+    sched.set_executor_cordon("cluster-y", False)
+    sched.set_priority_override("q1", 4.0)
+    sched.set_priority_override("q2", 2.0)
+    sched.set_priority_override("q2", None)
+    log.close()
+
+    sched2 = SchedulerService(SchedulingConfig(), FileEventLog(d))
+    assert sched2.cordoned_executors == {"cluster-x"}
+    assert sched2.priority_overrides == {"q1": 4.0}
