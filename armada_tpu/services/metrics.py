@@ -53,6 +53,11 @@ class SchedulerMetrics:
             ["pool", "queue"],
             registry=r,
         )
+        self.skipped_executors = Gauge(
+            "scheduler_skipped_executors",
+            "Executors excluded from the current round (cordoned or lagging)",
+            registry=r,
+        )
         self.scheduled_jobs = Counter(
             "scheduler_jobs_scheduled_total",
             "Jobs scheduled",

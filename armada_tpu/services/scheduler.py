@@ -253,6 +253,8 @@ class SchedulerService:
         cordoned = set(self.cordoned_queues)
         overrides = dict(self.priority_overrides)
         skipped = self._skipped_executors(executors)
+        if self.metrics is not None and self.metrics.registry is not None:
+            self.metrics.skipped_executors.set(len(skipped))
         pools = {hb.pool for hb in executors.values()} or {
             p.name for p in self.config.pools
         }
@@ -277,7 +279,7 @@ class SchedulerService:
         scheduling_algo.go:1049-1066). Their running jobs still count toward
         queue usage; their nodes are just not schedulable. Computed once per
         cycle from a snapshot — pool-independent."""
-        skipped = set(self.cordoned_executors)
+        skipped = {n for n in self.cordoned_executors if n in executors}
         limit = self.config.max_unacknowledged_jobs_per_executor
         if limit:
             unacked: dict[str, int] = {}

@@ -278,3 +278,34 @@ def test_lagging_executor_skipped():
     sched.cycle(now=t)
     txn = sched.jobdb.read_txn()
     assert all(j.state != JobState.QUEUED for j in txn.all_jobs())
+
+
+def test_metrics_rendered():
+    """Headline prometheus metrics are populated by a cycle, including the
+    skipped-executors gauge (metrics.go / cycle_metrics.go families)."""
+    from armada_tpu.services.metrics import SchedulerMetrics
+
+    config, log, sched, submit, ex = mk_stack(n_nodes=2)
+    metrics = SchedulerMetrics()
+    if metrics.registry is None:
+        return  # prometheus_client unavailable
+    sched.attach_metrics(metrics)
+    submit.create_queue(QueueSpec("q"))
+    # a cordon on an unregistered executor must NOT count as skipped
+    sched.set_executor_cordon("ghost-exec", True)
+    sched.set_executor_cordon("cluster-a", True)
+    t = 1.0
+    ex.tick(t)
+    submit.submit("q", "s", [job(i) for i in range(3)], now=t)
+    sched.cycle(now=t)
+    text = metrics.render().decode()
+    assert "scheduler_skipped_executors 1.0" in text
+    sched.set_executor_cordon("cluster-a", False)
+    t += 1.0
+    ex.tick(t)
+    sched.cycle(now=t)
+    text = metrics.render().decode()
+    assert "scheduler_skipped_executors 0.0" in text
+    assert 'scheduler_queue_fair_share{pool="default",queue="q"}' in text
+    assert 'scheduler_jobs_scheduled_total{pool="default",queue="q"} 3.0' in text
+    assert 'scheduler_solve_seconds_count{pool="default"}' in text
