@@ -10,17 +10,30 @@ transports that need it.
 
 from __future__ import annotations
 
-import itertools
+import os as _os
 import time as _time
 from dataclasses import dataclass, field
 
 from ..core.types import JobSpec
 
-_id_counter = itertools.count(1)
+_B32 = "0123456789abcdefghjkmnpqrstvwxyz"
+
+
+def _ulid() -> str:
+    t = int(_time.time() * 1000) & ((1 << 48) - 1)
+    v = (t << 80) | int.from_bytes(_os.urandom(10), "big")
+    return "".join(_B32[(v >> (5 * i)) & 31] for i in range(25, -1, -1))
 
 
 def new_id(prefix: str = "id") -> str:
-    return f"{prefix}-{next(_id_counter):012d}"
+    """Globally unique, time-ordered id (ULID: 48-bit ms timestamp +
+    80-bit randomness), like the reference's util.NewULID
+    (/root/reference/internal/common/util/ulid.go). A process-local
+    counter would collide with replayed ids after a restart on the
+    durable log (freshly issued ids repeating ones already in the log),
+    making the ingester's idempotent-replay guard silently drop new
+    submissions."""
+    return f"{prefix}-{_ulid()}"
 
 
 @dataclass(frozen=True)

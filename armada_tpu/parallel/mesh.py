@@ -5,15 +5,22 @@ executor each, with the scheduler seeing the union
 (/root/reference/internal/scheduler/scheduling/scheduling_algo.go:135-147).
 The TPU-native analogue: one mesh axis ("nodes") over which every per-node
 tensor (allocatable[P, N, R], taint/label bitsets, totals) is sharded, so
-each chip owns one cluster's worth of nodes. Candidate selection inside the
-solve is a masked lexicographic argmin over N — under jit with shardings,
-XLA lowers the min-reductions to per-shard reductions plus tiny cross-chip
-collectives riding ICI; binds are scatter-updates landing on the owning
-shard only.
+each chip owns one cluster's worth of nodes.
 
-The solve itself is unchanged (solver/kernel.py): jit + sharding annotations
-partition it. Job/queue/slot tensors are small relative to nodes and stay
-replicated; at 1M jobs the job axis can be sharded the same way later.
+Execution model: **shard_map, not whole-program GSPMD.** Every chip runs the
+same sequential solve in lockstep on replicated job/queue/slot state; per-node
+scans (feasibility, best-fit argmin) cover only the local shard, and the
+shard-crossing points are explicit collectives provided by
+solver.dist.ShardDist:
+
+  - candidate selection: local lexicographic argmin, then an all_gather of
+    the per-shard winners and a mesh-size-wide argmin (O(K) scalars on ICI);
+  - single-node column reads: masked local gather + psum;
+  - binds/evictions: applied by the owning shard only (no collective).
+
+Letting XLA's sharding partitioner propagate through the jitted while_loop
+program instead (the round-1 design) made the sharded compile explode;
+shard_map compiles the per-shard program once, like the single-device path.
 """
 
 from __future__ import annotations
@@ -24,6 +31,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..solver.dist import ShardDist
 from ..solver.kernel import solve_impl
 from ..solver.kernel_prep import DeviceRound
 
@@ -35,6 +43,7 @@ _NODE_SHARDED = {
     "node_labels": P("nodes", None),
     "node_id_rank": P("nodes",),
     "node_unschedulable": P("nodes",),
+    "node_gid": P("nodes",),
 }
 
 
@@ -69,36 +78,73 @@ def pad_nodes(dev: DeviceRound, multiple: int) -> DeviceRound:
         node_unschedulable=np.concatenate(
             [np.asarray(dev.node_unschedulable), np.ones(pad, dtype=bool)]
         ),
+        node_gid=np.arange(total, dtype=np.int32),
+        affinity_allowed=_pad_words(dev.affinity_allowed, total),
     )
+
+
+def _pad_words(aw: np.ndarray, n_nodes: int) -> np.ndarray:
+    """Grow the node-bitset word axis to cover n_nodes global ids."""
+    aw = np.asarray(aw)
+    need = (n_nodes + 31) // 32
+    if aw.shape[1] >= need:
+        return aw
+    return np.pad(aw, [(0, 0), (0, need - aw.shape[1])])
+
+
+def _spec_tree(dev: DeviceRound):
+    """A DeviceRound-shaped pytree of PartitionSpecs (meta fields kept).
+
+    Every data leaf (including scalar leaves like global_tokens) gets a
+    spec; only the node-major arrays are actually sharded."""
+    from ..solver.kernel_prep import _META_FIELDS
+
+    specs = {
+        f.name: _NODE_SHARDED.get(f.name, P())
+        for f in dataclasses.fields(DeviceRound)
+        if f.name not in _META_FIELDS
+    }
+    return dataclasses.replace(dev, **specs)
 
 
 def node_sharded_solve(mesh: Mesh):
     """Jitted round solve with node-sharded inputs over `mesh`.
 
     Returns a callable dev -> outputs. Inputs must have the node axis padded
-    to a multiple of the mesh size (pad_nodes)."""
+    to a multiple of the mesh size (pad_nodes). Outputs are replicated and
+    identical to the single-device solve on the same snapshot
+    (tests/test_multichip.py asserts this)."""
+    n_shards = mesh.devices.size
+    dist = ShardDist("nodes", n_shards)
 
-    def shardings_for(dev: DeviceRound):
-        spec = {}
-        for f in dataclasses.fields(DeviceRound):
-            if f.name in _NODE_SHARDED:
-                spec[f.name] = NamedSharding(mesh, _NODE_SHARDED[f.name])
-            else:
-                spec[f.name] = NamedSharding(mesh, P())
-        return spec
+    def inner(dev):
+        return solve_impl(dev, dist=dist)
 
-    jitted = jax.jit(solve_impl)  # shared across rounds: cache by shape
+    def build(dev: DeviceRound):
+        sharded = jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(_spec_tree(dev),),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    cache = {}
 
     def run(dev: DeviceRound):
-        spec = shardings_for(dev)
+        # One compiled program per (shapes, static config); shard_map in_specs
+        # depend only on the treedef, so cache by it.
+        key = jax.tree_util.tree_structure(dev)
+        if key not in cache:
+            cache[key] = build(dev)
+        # Place inputs on the mesh so jit does not re-layout on every call.
         placed = {}
         for f in dataclasses.fields(DeviceRound):
             v = getattr(dev, f.name)
             if isinstance(v, (np.ndarray, jax.Array)):
-                placed[f.name] = jax.device_put(v, spec[f.name])
-            else:
-                placed[f.name] = v
-        dev_placed = dataclasses.replace(dev, **placed)
-        return jitted(dev_placed)
+                spec = _NODE_SHARDED.get(f.name, P())
+                placed[f.name] = jax.device_put(v, NamedSharding(mesh, spec))
+        return cache[key](dataclasses.replace(dev, **placed))
 
     return run

@@ -306,7 +306,15 @@ class ApiServer:
                 and run.id in acked
             ):
                 # killed underneath the executor: tear the pod down
-                # (SUCCEEDED pods exit on their own; no cancel for them)
+                # (SUCCEEDED pods exit on their own; no cancel for them).
+                # The acked gate is both necessary and sufficient: the
+                # agent's acked set IS its live-pod set (executor_agent.py
+                # prunes acks to live pods every tick), so a pod started
+                # from a prior exchange whose job was cancelled mid-flight
+                # appears in acked on the NEXT exchange and gets its cancel
+                # then; and runs that never produced a pod never trigger
+                # resends (an unconditional send would re-deliver cancels
+                # for every retained terminal job on every exchange).
                 cancels.append({"run_id": run.id, "job_id": job.id})
         return {"leases": leases, "cancel_runs": cancels, "active_runs": active}
 
@@ -440,11 +448,22 @@ class ApiServer:
             "CordonExecutor": self._cordon_executor,
         }
 
-    def serve(self, port: int = 0, max_workers: int = 8):
+    def serve(self, port: int = 0, max_workers: int = 16, max_watchers: int | None = None):
+        """Serve on 127.0.0.1:port.
+
+        Watch streams park a worker thread each in a wait loop; unbounded
+        watchers would starve unary RPCs (executor lease exchanges) of the
+        shared pool. `max_watchers` (default: max_workers - 4) bounds them
+        so unary handlers always have threads; excess watchers are rejected
+        with RESOURCE_EXHAUSTED and may retry."""
+        import threading
         from concurrent import futures
 
+        if max_watchers is None:
+            max_watchers = max(1, max_workers - 4)
         table = self.method_table()
         outer = self
+        watchers = threading.Semaphore(max_watchers)
 
         class Handler(grpc.GenericRpcHandler):
             def service(self, handler_call_details):
@@ -455,7 +474,17 @@ class ApiServer:
                 method = parts[1]
                 if method == "WatchJobSet":
                     def stream(request, context):
-                        yield from outer._watch_jobset(_decode(request), context)
+                        if not watchers.acquire(blocking=False):
+                            context.abort(
+                                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                f"too many concurrent watchers (max {max_watchers})",
+                            )
+                        try:
+                            yield from outer._watch_jobset(
+                                _decode(request), context
+                            )
+                        finally:
+                            watchers.release()
 
                     return grpc.unary_stream_rpc_method_handler(
                         stream,

@@ -42,7 +42,19 @@ class StandaloneLeader:
 
 class FileLeaseLeader:
     """Lease file on shared storage: holder renews mtime; takeover after
-    lease_duration of silence. Single-writer via atomic create/replace."""
+    lease_duration of silence. Single-writer via atomic create/replace.
+
+    Safety model: the lease file carries a monotonic **fencing counter**,
+    incremented on every takeover. First acquisition uses O_EXCL so exactly
+    one creator wins; takeover of an expired lease writes fence+1 and
+    re-reads to confirm (if two candidates interleave, the later writer's
+    file survives and the earlier one's re-read or validate() fails on the
+    holder/fence mismatch). A validate-then-publish window remains — a
+    candidate can take over after validate() returns and before the publish
+    lands — which file storage cannot close without write-time fencing;
+    that residual window is safe here because event application is
+    idempotent and a deposed leader's events are re-derived identically by
+    the new leader (scheduler.go:225-233 recovery semantics)."""
 
     def __init__(
         self,
@@ -56,33 +68,60 @@ class FileLeaseLeader:
         self.renew_deadline = renew_deadline
         self.identity = identity or f"{os.getpid()}-{uuid.uuid4()}"
         self._epoch = 0
+        self._fence = 0
 
     def _read(self):
+        """Returns (holder, ts, fence); holder None only when the file does
+        not exist. A torn/corrupt file (killed mid-write, disk full) parses
+        as holder="" with an expired ts, so candidates recover it through
+        the fenced takeover path — O_EXCL creation would otherwise fail
+        forever against a file that exists but never parses."""
         try:
             with open(self.path) as f:
-                holder, ts = f.read().strip().split("\n")
-                return holder, float(ts)
-        except (FileNotFoundError, ValueError):
-            return None, 0.0
+                raw = f.read()
+        except FileNotFoundError:
+            return None, 0.0, 0
+        try:
+            parts = raw.strip().split("\n")
+            holder, ts = parts[0], float(parts[1])
+            fence = int(parts[2]) if len(parts) > 2 else 0
+            if not holder:
+                raise ValueError("empty holder")
+            return holder, ts, fence
+        except (ValueError, IndexError):
+            return "", 0.0, 0
 
-    def _write(self, now: float):
+    def _write(self, now: float, fence: int):
         tmp = f"{self.path}.{self.identity}.tmp"
         with open(tmp, "w") as f:
-            f.write(f"{self.identity}\n{now}")
+            f.write(f"{self.identity}\n{now}\n{fence}")
         os.replace(tmp, self.path)
 
     def try_acquire_or_renew(self, now: float | None = None) -> bool:
         now = _time.time() if now is None else now
-        holder, ts = self._read()
+        holder, ts, fence = self._read()
         if holder == self.identity:
-            self._write(now)
+            self._write(now, fence)
+            self._fence = fence
             return True
-        if holder is None or now - ts > self.lease_duration:
-            self._write(now)
+        if holder is None:
+            # First acquisition: O_EXCL so exactly one creator wins.
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                return False
+            with os.fdopen(fd, "w") as f:
+                f.write(f"{self.identity}\n{now}\n1")
+            self._fence = 1
+            self._epoch += 1
+            return True
+        if now - ts > self.lease_duration:
+            self._write(now, fence + 1)
             # Re-read to confirm we won the race.
-            holder, _ = self._read()
-            won = holder == self.identity
+            holder2, _, fence2 = self._read()
+            won = holder2 == self.identity and fence2 == fence + 1
             if won:
+                self._fence = fence + 1
                 self._epoch += 1
             return won
         return False
@@ -94,9 +133,10 @@ class FileLeaseLeader:
     def validate(self, token: LeaderToken) -> bool:
         if not token.leader:
             return False
-        holder, ts = self._read()
+        holder, ts, fence = self._read()
         return (
             holder == self.identity
+            and fence == self._fence
             and token.id == f"{self.identity}:{self._epoch}"
             and _time.time() - ts <= self.lease_duration
         )

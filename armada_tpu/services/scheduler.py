@@ -72,6 +72,19 @@ class SchedulerService:
         self.executors: dict[str, ExecutorHeartbeat] = {}
         self.is_leader = is_leader
         self.cycle_count = 0
+        # Leadership-acquisition timestamp (same clock as cycle(now) —
+        # virtual in the simulator): anchors the orphaned-lease grace
+        # period below. Reset whenever leadership is (re)gained so a
+        # re-elected leader with a cold heartbeat map re-runs the grace
+        # instead of mass-expiring healthy executors' jobs.
+        self.started_at: float | None = None
+        self._last_token_id: str | None = None
+        # Orphan sweeps run once after the grace expires and again for a
+        # timeout window after any executor is dropped (covers a background
+        # solve leasing onto an executor expired mid-cycle), instead of
+        # scanning every leased job every cycle forever.
+        self._orphan_sweep_done = False
+        self._orphan_recheck_until = 0.0
         self.last_cycle_stats: dict = {}
         from .reports import SchedulingReportsRepository
 
@@ -192,10 +205,18 @@ class SchedulerService:
         if hasattr(self.is_leader, "get_token"):
             token = self.is_leader.get_token()
             if not token.leader:
+                self._last_token_id = None
                 return []
         elif not self.is_leader():
+            self._last_token_id = None
             return []
         now = _time.time() if now is None else now
+        token_id = token.id if token is not None else ""
+        if self._last_token_id != token_id:
+            # Fresh (re-)election: restart the orphaned-lease grace period.
+            self._last_token_id = token_id
+            self.started_at = now
+            self._orphan_sweep_done = False
         self.ingester.sync()
         sequences: list[EventSequence] = []
         sequences += self._expire_stale_executors(now)
@@ -225,6 +246,17 @@ class SchedulerService:
         # penalty scan) bounded, like the reference's DB pruners.
         if self.cycle_count % 600 == 599:
             self.jobdb.prune_terminal(now - self.config.terminal_job_retention_s)
+
+        # A lease published onto an executor no longer in the heartbeat map
+        # (a background solve outliving the executor, by any margin) must
+        # reopen the orphan sweep, or the job stays LEASED forever.
+        for seq in sequences:
+            for event in seq.events:
+                if (
+                    isinstance(event, JobRunLeased)
+                    and event.executor not in self.executors
+                ):
+                    self._orphan_sweep_done = False
 
         if token is not None and not self.is_leader.validate(token):
             return []  # lost leadership mid-cycle: nothing published
@@ -298,7 +330,18 @@ class SchedulerService:
 
     def _expire_stale_executors(self, now: float) -> list[EventSequence]:
         """Jobs on executors that stopped heartbeating are requeued or
-        failed (scheduler.go:1099 expireJobsIfNecessary)."""
+        failed (scheduler.go:1099 expireJobsIfNecessary).
+
+        Heartbeats are in-memory only, so after a restart/failover the map
+        starts empty while the jobdb restores jobs leased to executors that
+        may never report again. Jobs whose executor is absent from the map
+        are therefore also expired, once a startup grace period (one
+        executor timeout, anchored at the first cycle) has given live
+        executors the chance to heartbeat. The same path catches a
+        background solve publishing a lease onto an executor that was
+        expired mid-cycle: the orphaned lease expires on a later cycle."""
+        if self.started_at is None:
+            self.started_at = now
         timeout = self.config.executor_timeout_s
         stale = {
             name
@@ -307,20 +350,39 @@ class SchedulerService:
         }
         for name in stale:
             self.executors.pop(name, None)
-        if not stale:
+        if stale:
+            # Leases published onto a just-dropped executor by an in-flight
+            # background solve surface shortly after: keep re-checking for
+            # one timeout window.
+            self._orphan_recheck_until = now + timeout
+        expire_orphans = (now - self.started_at) > timeout and (
+            not self._orphan_sweep_done or now < self._orphan_recheck_until
+        )
+        if expire_orphans:
+            self._orphan_sweep_done = True
+        if not stale and not expire_orphans:
             return []
         sequences = []
         txn = self.jobdb.read_txn()
         for job in txn.leased_jobs():
             run = job.latest_run
-            if run is None or run.executor not in stale:
+            if run is None:
+                continue
+            if run.executor in stale:
+                reason = f"executor {run.executor} timed out"
+            elif expire_orphans and run.executor not in self.executors:
+                reason = (
+                    f"executor {run.executor} unknown "
+                    "(no heartbeat since scheduler start)"
+                )
+            else:
                 continue
             events = [
                 JobRunErrors(
                     created=now,
                     job_id=job.id,
                     run_id=run.id,
-                    error=f"executor {run.executor} timed out",
+                    error=reason,
                     retryable=True,
                 )
             ]

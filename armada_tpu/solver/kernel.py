@@ -41,6 +41,7 @@ import numpy as np
 from ..core.priorities import EVICTED_PRIORITY, MIN_PRIORITY
 from ..ops.bitset import bits_subset
 from ..ops.select import lex_argmin
+from .dist import LOCAL
 from .kernel_prep import DeviceRound
 
 NO_NODE = -1
@@ -142,7 +143,9 @@ def _static_ok(dev, j, extra_sel, extra_tol=None):
     sel_ok = bits_subset(dev.job_selector[j] | extra_sel, dev.node_labels)
     total_ok = jnp.all(dev.job_req_fit[j] <= dev.node_total, axis=-1)
     # Retry anti-affinity: nodes earlier attempts failed on are infeasible.
-    n_idx = jnp.arange(dev.node_total.shape[0], dtype=jnp.int32)
+    # node_gid carries global node ids (equals arange(N) locally; the owning
+    # shard's slice of it under node sharding).
+    n_idx = dev.node_gid
     excl_ok = jnp.all(
         n_idx[:, None] != dev.job_excluded_nodes[j][None, :], axis=-1
     )
@@ -164,7 +167,7 @@ def _static_ok(dev, j, extra_sel, extra_tol=None):
     )
 
 
-def _select_at_row(dev, alloc, j, row, static_ok):
+def _select_at_row(dev, dist, alloc, j, row, static_ok):
     """First-fit in best-fit order at one priority row (nodedb.go:713-752)."""
     dyn = jnp.all(dev.job_req_fit[j] <= alloc[row], axis=-1)
     mask = static_ok & dyn
@@ -174,7 +177,7 @@ def _select_at_row(dev, alloc, j, row, static_ok):
         res = dev.order_res_resolution[k]
         keys.append(alloc[row, :, ri] // res)
     keys.append(dev.node_id_rank)
-    return lex_argmin(keys, mask)
+    return dist.lex_argmin_nodes(keys, mask, dev.node_gid)
 
 
 def fair_preemption_order(carry):
@@ -187,7 +190,7 @@ def fair_preemption_order(carry):
     return jnp.lexsort((BIG - rank, node_key))
 
 
-def _fair_preemption(dev, carry, j, static_ok, fp_order):
+def _fair_preemption(dev, dist, carry, j, static_ok, fp_order):
     """Vectorized selectNodeForJobWithFairPreemption (nodedb.go:808-899).
 
     Walk evicted jobs in reverse rank order; node n becomes selectable at the
@@ -212,12 +215,15 @@ def _fair_preemption(dev, carry, j, static_ok, fp_order):
     )
     base = c[seg_first] - contrib[seg_first]
     cwithin = c - base
-    safe_node = jnp.clip(n_sorted, 0, dev.alloc0.shape[1] - 1)
-    avail = carry.alloc[0, safe_node].astype(jnp.result_type(int)) + cwithin
+    safe_node = jnp.clip(n_sorted, 0, dist.num_nodes(carry.alloc) - 1)
+    avail = (
+        dist.take_rows(carry.alloc[0], safe_node).astype(jnp.result_type(int))
+        + cwithin
+    )
     feasible = (
         a_sorted
         & jnp.all(avail >= dev.job_req_fit[j], axis=-1)
-        & static_ok[safe_node]
+        & dist.take_rows(static_ok, safe_node)
     )
     rank_sorted = rank[order]
     idx, found = lex_argmin([-rank_sorted, pos.astype(jnp.int32)], feasible)
@@ -227,7 +233,9 @@ def _fair_preemption(dev, carry, j, static_ok, fp_order):
     freed = jnp.sum(
         jnp.where(consumed[:, None], dev.job_req_fit, 0), axis=0
     ).astype(carry.alloc.dtype)
-    new_alloc = carry.alloc.at[0, sel_node].add(jnp.where(found, freed, 0))
+    new_alloc = dist.add_row_at(
+        carry.alloc, 0, sel_node, jnp.where(found, freed, 0)
+    )
     new_rank = jnp.where(consumed, -2, rank)
     preempted_at = jnp.max(
         jnp.where(consumed, carry.job_prio, MIN_PRIORITY)
@@ -235,7 +243,7 @@ def _fair_preemption(dev, carry, j, static_ok, fp_order):
     return sel_node, found, preempted_at, new_alloc, new_rank
 
 
-def _select_chain(dev, carry, j, prio, extra_sel, extra_tol, fp_order):
+def _select_chain(dev, dist, carry, j, prio, extra_sel, extra_tol, fp_order):
     """selectNodeForJobWithTxnAtPriority (nodedb.go:597-662) at one target
     priority with optional extra tolerations (away node types). Returns
     (node, found, preempted_at, new_alloc, new_evict_rank)."""
@@ -243,14 +251,14 @@ def _select_chain(dev, carry, j, prio, extra_sel, extra_tol, fp_order):
     row_p = jnp.searchsorted(dev.priorities, prio).astype(jnp.int32)
     static_ok = _static_ok(dev, j, extra_sel, extra_tol)
 
-    n0, f0 = _select_at_row(dev, alloc, j, 0, static_ok)
-    np_, fp = _select_at_row(dev, alloc, j, row_p, static_ok)
+    n0, f0 = _select_at_row(dev, dist, alloc, j, 0, static_ok)
+    np_, fp = _select_at_row(dev, dist, alloc, j, row_p, static_ok)
 
     # Fair preemption involves a J-sized sort; skip it when the evicted-job
     # index is empty (every queued-only round).
     fpre_n, fpre_found, fpre_at, fpre_alloc, fpre_rank = jax.lax.cond(
         jnp.any(carry.evict_rank >= 0),
-        lambda: _fair_preemption(dev, carry, j, static_ok, fp_order),
+        lambda: _fair_preemption(dev, dist, carry, j, static_ok, fp_order),
         lambda: (
             jnp.int32(0),
             jnp.zeros((), bool),
@@ -267,7 +275,7 @@ def _select_chain(dev, carry, j, prio, extra_sel, extra_tol, fp_order):
     P = dev.priorities.shape[0]
     for r in range(1, P):
         allowed = dev.priorities[r] <= prio
-        nr, fr = _select_at_row(dev, alloc, j, r, static_ok)
+        nr, fr = _select_at_row(dev, dist, alloc, j, r, static_ok)
         take = allowed & fr & ~urg_found
         urg_n = jnp.where(take, nr, urg_n)
         urg_at = jnp.where(take, dev.priorities[r], urg_at)
@@ -284,7 +292,7 @@ def _select_chain(dev, carry, j, prio, extra_sel, extra_tol, fp_order):
     return node, found, preempted_at, new_alloc, new_rank
 
 
-def _select_node(dev, carry, j, extra_sel, fp_order):
+def _select_node(dev, dist, carry, j, extra_sel, fp_order):
     """SelectNodeForJobWithTxn (nodedb.go:423-503): pinned reschedule, home
     chain, then away node types at reduced priority. Returns
     (node, found, preempted_at, new_alloc, new_evict_rank, sched_at)."""
@@ -294,14 +302,15 @@ def _select_node(dev, carry, j, extra_sel, fp_order):
 
     pinned = carry.job_evicted[j]
     home = carry.job_node[j]
-    safe_home = jnp.clip(home, 0, alloc.shape[1] - 1)
-    over_alloc = jnp.any(alloc[:, safe_home] < 0)
-    home_fit = jnp.all(dev.job_req_fit[j] <= alloc[row_p, safe_home]) | (
-        dev.node_unschedulable[safe_home] & over_alloc
+    safe_home = jnp.clip(home, 0, dist.num_nodes(alloc) - 1)
+    home_col = dist.take_col(alloc, safe_home)
+    over_alloc = jnp.any(home_col < 0)
+    home_fit = jnp.all(dev.job_req_fit[j] <= home_col[row_p]) | (
+        dist.take(dev.node_unschedulable, safe_home) & over_alloc
     )
 
     node, found, preempted_at, new_alloc, new_rank = _select_chain(
-        dev, carry, j, prio, extra_sel, None, fp_order
+        dev, dist, carry, j, prio, extra_sel, None, fp_order
     )
     sched_at = prio
 
@@ -320,8 +329,8 @@ def _select_node(dev, carry, j, extra_sel, fp_order):
                 live = (a < dev.pc_away_count[pc]) & ~found
                 a_prio = dev.pc_away_prio[pc, a]
                 a_node, a_found, a_at, a_alloc, a_rank = _select_chain(
-                    dev, carry, j, a_prio, extra_sel, dev.pc_away_tol[pc, a],
-                    fp_order,
+                    dev, dist, carry, j, a_prio, extra_sel,
+                    dev.pc_away_tol[pc, a], fp_order,
                 )
                 take = live & a_found
                 node = jnp.where(take, a_node, node)
@@ -350,17 +359,20 @@ def _select_node(dev, carry, j, extra_sel, fp_order):
     return node, found, preempted_at, new_alloc, new_rank, sched_at
 
 
-def _bind(dev, carry: Carry, j, n, at_prio) -> Carry:
+def _bind(dev, dist, carry: Carry, j, n, at_prio) -> Carry:
     """bindJobToNodeInPlace (nodedb.go:911-945)."""
     preemptible = dev.job_preemptible[j]
     rows = jnp.where(
         preemptible, dev.priorities <= at_prio, jnp.ones_like(dev.priorities, bool)
     )
     delta = jnp.where(rows[:, None], dev.job_req_fit[j], 0).astype(carry.alloc.dtype)
-    alloc = carry.alloc.at[:, n].add(-delta)
+    alloc = dist.add_col(carry.alloc, n, -delta)
     was_evicted = carry.job_evicted[j]
-    alloc = alloc.at[0, n].add(
-        jnp.where(was_evicted, dev.job_req_fit[j], 0).astype(carry.alloc.dtype)
+    alloc = dist.add_row_at(
+        alloc,
+        0,
+        n,
+        jnp.where(was_evicted, dev.job_req_fit[j], 0).astype(carry.alloc.dtype),
     )
     return carry._replace(
         alloc=alloc,
@@ -376,7 +388,7 @@ def _bind(dev, carry: Carry, j, n, at_prio) -> Carry:
     )
 
 
-def _gang_attempt(dev, carry: Carry, s, all_ev, fp_order):
+def _gang_attempt(dev, dist, carry: Carry, s, all_ev, fp_order):
     """GangScheduler.Schedule + ScheduleManyWithTxn. Returns
     (carry, status_code)."""
     q = dev.slot_queue[s]
@@ -433,12 +445,12 @@ def _gang_attempt(dev, carry: Carry, s, all_ev, fp_order):
             live = (m < dev.slot_count[s]) & ok
             safe_j = jnp.clip(j, 0, dev.job_req.shape[0] - 1)
             node, found, pat, new_alloc, new_rank, sched_at = _select_node(
-                dev, c, safe_j, extra_sel, fp_order
+                dev, dist, c, safe_j, extra_sel, fp_order
             )
 
             def do_bind(c):
                 c2 = c._replace(alloc=new_alloc, evict_rank=new_rank)
-                return _bind(dev, c2, safe_j, node, sched_at)
+                return _bind(dev, dist, c2, safe_j, node, sched_at)
 
             c = jax.lax.cond(live & found, do_bind, lambda c: c, c)
             pat_sum = pat_sum + jnp.where(live & found, _f(pat), 0.0)
@@ -554,6 +566,39 @@ def _gang_attempt(dev, carry: Carry, s, all_ev, fp_order):
     return new_carry, status
 
 
+def _slot_valid_one(dev, carry: Carry, all_ev_flags, include_queued, use_key_skip, s):
+    """Validity of ONE slot (QueuedGangIterator yield semantics). The single
+    source of truth for the predicate: the full O(S) scan (_slot_validity)
+    vmaps it and the head-pointer advance evaluates it per slot — they
+    cannot drift apart."""
+    Q = dev.queue_slot_start.shape[0]
+    v = (carry.slot_state[s] == PENDING) & (dev.slot_count[s] > 0)
+    all_ev = all_ev_flags[s]
+    if include_queued:
+        only_ev = carry.only_ev_global | carry.only_ev_queue[
+            jnp.clip(dev.slot_queue[s], 0, Q - 1)
+        ]
+        active = jnp.where(dev.slot_is_running[s], all_ev, True)
+        v = v & active & (~only_ev | all_ev)
+        # Lookback: queued jobs beyond the limit stop yielding; 0 means
+        # unlimited (QueuedGangIterator.stopYieldingNewJobsIfLimitHit).
+        if dev.max_lookback:
+            v = v & (
+                dev.slot_is_running[s]
+                | all_ev
+                | (dev.slot_jobs_before[s] < dev.max_lookback)
+            )
+        if use_key_skip:
+            kg = dev.slot_key_group[s]
+            v = v & ~(
+                (kg >= 0)
+                & carry.unfeasible[jnp.clip(kg, 0, carry.unfeasible.shape[0] - 1)]
+            )
+    else:
+        v = v & all_ev
+    return v
+
+
 def _slot_validity(dev, carry: Carry, include_queued, use_key_skip):
     """Which slots can be yielded right now (QueuedGangIterator semantics)."""
     S, M = dev.slot_members.shape
@@ -563,29 +608,11 @@ def _slot_validity(dev, carry: Carry, include_queued, use_key_skip):
     all_evicted = jnp.all(
         jnp.where(member_mask, carry.job_evicted[safe], True), axis=1
     )
-    pending = (carry.slot_state == PENDING) & (dev.slot_count > 0)
-    only_ev = carry.only_ev_global | carry.only_ev_queue[
-        jnp.clip(dev.slot_queue, 0, carry.only_ev_queue.shape[0] - 1)
-    ]
-
-    valid = pending
-    if include_queued:
-        active = jnp.where(dev.slot_is_running, all_evicted, True)
-        valid = valid & active & (~only_ev | all_evicted)
-        # Lookback: queued jobs beyond the limit stop yielding; 0 means
-        # unlimited (QueuedGangIterator.stopYieldingNewJobsIfLimitHit).
-        if dev.max_lookback:
-            valid = valid & (
-                dev.slot_is_running
-                | all_evicted
-                | (dev.slot_jobs_before < dev.max_lookback)
-            )
-        if use_key_skip:
-            kg = jnp.clip(dev.slot_key_group, 0, carry.unfeasible.shape[0] - 1)
-            known_bad = (dev.slot_key_group >= 0) & carry.unfeasible[kg]
-            valid = valid & ~known_bad
-    else:
-        valid = valid & all_evicted
+    valid = jax.vmap(
+        lambda s: _slot_valid_one(
+            dev, carry, all_evicted, include_queued, use_key_skip, s
+        )
+    )(jnp.arange(S, dtype=jnp.int32))
     return valid, all_evicted
 
 
@@ -609,6 +636,7 @@ def _slot_min_prio(dev, carry, s):
 
 def _schedule_pass(
     dev,
+    dist,
     carry: Carry,
     budgets,
     *,
@@ -619,15 +647,21 @@ def _schedule_pass(
 ):
     """QueueScheduler.Schedule as a while_loop (queue_scheduler.go:91-276).
 
-    Slot validity is maintained incrementally: within a pass it only changes
-    at the consumed slot, except when an only-evicted flag flips or an
-    unfeasible key is registered (then it is recomputed in full). Member
-    evictions never happen mid-pass, so the all-evicted flags are stable."""
+    Per-queue candidate streams are walked with **head pointers**: slots are
+    sorted by (queue, segment, order), so each queue's next candidate is an
+    advancing index into its slot range. Steady-state per-iteration work is
+    O(Q + nodes) — independent of the total slot count S, which is what a
+    1M-queued-job round needs. The O(S) full validity scan runs only at pass
+    start and when a validity *flag* flips (an only-evicted marker or a
+    newly registered unfeasible key — rare), because those can invalidate
+    other queues' heads; everything else that validity depends on is either
+    static within the pass (all-evicted membership: evictions happen between
+    passes) or behind the pointers already (consumed slots)."""
     Q = dev.queue_slot_start.shape[0]
     S = dev.slot_members.shape[0]
 
     def cond(state):
-        c, valid = state
+        c, ptr = state
         return ~c.stop & (c.loops < S + 2)
 
     # all-evicted flags are stable within a pass: evictions happen between
@@ -636,9 +670,32 @@ def _schedule_pass(
     # Fair-preemption walk order: one sort per pass, not per member select.
     fp_order = fair_preemption_order(carry)
 
+    def lazy_valid(c, s):
+        """O(1) validity of slot s (shared predicate, see _slot_valid_one)."""
+        return _slot_valid_one(
+            dev, c, all_ev_flags, include_queued, use_key_skip, s
+        )
+
+    def advance(c, ptr, q):
+        """Move queue q's pointer to its next valid slot (amortized O(1):
+        total advance steps across the pass are bounded by S)."""
+        end = dev.queue_slot_end[q]
+
+        def acond(p):
+            return (p < end) & ~lazy_valid(c, jnp.clip(p, 0, S - 1))
+
+        p = jax.lax.while_loop(acond, lambda p: p + 1, ptr[q])
+        return ptr.at[q].set(p)
+
+    def ptrs_from_scratch(c):
+        valid, _ = _slot_validity(dev, c, include_queued, use_key_skip)
+        heads, has = _queue_heads(dev, valid)
+        return jnp.where(has, heads, dev.queue_slot_end)
+
     def body(state):
-        c, valid = state
-        heads, has_head = _queue_heads(dev, valid)
+        c, ptr = state
+        has_head = ptr < dev.queue_slot_end
+        heads = jnp.clip(ptr, 0, S - 1)
 
         req_h = _f(dev.slot_req[heads])  # [Q, R]
         qalloc_cost = c.qalloc + _f(dev.queue_short_penalty)
@@ -675,7 +732,9 @@ def _schedule_pass(
         sstar = heads[qstar]
 
         def attempt(c):
-            c2, status = _gang_attempt(dev, c, sstar, all_ev_flags[sstar], fp_order)
+            c2, status = _gang_attempt(
+                dev, dist, c, sstar, all_ev_flags[sstar], fp_order
+            )
             # Terminal handling (queue_scheduler.go:176-190).
             c2 = c2._replace(
                 only_ev_global=c2.only_ev_global | (status == FAIL_TERMINAL),
@@ -709,30 +768,38 @@ def _schedule_pass(
             | jnp.any(c.only_ev_queue != flags_before[1])
             | jnp.any(c.unfeasible != flags_before[2])
         )
-        valid = jnp.where(any_head, valid.at[sstar].set(False), valid)
-        valid = jax.lax.cond(
+        # Consume the winning slot and advance its queue's pointer to the
+        # next valid slot; a flag flip can invalidate OTHER queues' heads,
+        # so it triggers the full O(S) recompute instead.
+        ptr = jnp.where(any_head, ptr.at[qstar].set(sstar + 1), ptr)
+        ptr = jax.lax.cond(
             flags_changed,
-            lambda: _slot_validity(dev, c, include_queued, use_key_skip)[0],
-            lambda: valid,
+            lambda: ptrs_from_scratch(c),
+            lambda: jax.lax.cond(
+                any_head,
+                lambda: advance(c, ptr, qstar),
+                lambda: ptr,
+            ),
         )
-        return c._replace(loops=c.loops + 1), valid
+        return c._replace(loops=c.loops + 1), ptr
 
     # Each iteration consumes one slot (or stops), so S+2 bounds the loop;
     # the counter restarts per pass (the reference's loopNumber is also
     # per-QueueScheduler, queue_scheduler.go:99).
+    heads0, has0 = _queue_heads(dev, valid0)
+    ptr0 = jnp.where(has0, heads0, dev.queue_slot_end)
     carry = carry._replace(stop=jnp.zeros((), bool), loops=jnp.zeros((), jnp.int32))
-    carry, _ = jax.lax.while_loop(cond, body, (carry, valid0))
+    carry, _ = jax.lax.while_loop(cond, body, (carry, ptr0))
     return carry
 
 
-def _apply_evictions(dev, carry: Carry, evict_mask):
+def _apply_evictions(dev, dist, carry: Carry, evict_mask):
     """Move evicted jobs' usage to the evicted row and update queue
     accounting (EvictJobsFromNode + sctx.EvictJob)."""
     P = dev.priorities.shape[0]
-    N = dev.alloc0.shape[1]
     req = dev.job_req
-    node = jnp.clip(carry.job_node, 0, N - 1)
     alloc = carry.alloc
+    ln = alloc.shape[1]
     for r in range(1, P):
         in_rows = jnp.where(
             dev.job_preemptible,
@@ -742,7 +809,7 @@ def _apply_evictions(dev, carry: Carry, evict_mask):
         contrib = jnp.where(
             (evict_mask & in_rows)[:, None], dev.job_req_fit, 0
         ).astype(alloc.dtype)
-        add = jax.ops.segment_sum(contrib, node, num_segments=N)
+        add = dist.segment_to_nodes(contrib, carry.job_node, ln)
         alloc = alloc.at[r].add(add)
 
     qseg = jnp.clip(dev.job_queue, 0, dev.queue_weight.shape[0] - 1)
@@ -844,17 +911,16 @@ def _assign_evict_ranks(dev, carry: Carry, budgets, prefer_large: bool):
     return carry._replace(evict_rank=rank)
 
 
-def _oversubscribed_mask(dev, carry: Carry):
+def _oversubscribed_mask(dev, dist, carry: Carry):
     """OversubscribedEvictor (eviction.go:133-180)."""
     P = dev.priorities.shape[0]
-    N = dev.alloc0.shape[1]
     bound = (carry.job_node >= 0) & ~carry.job_evicted
-    node = jnp.clip(carry.job_node, 0, N - 1)
     mask = jnp.zeros(dev.job_req.shape[0], dtype=bool)
     for r in range(1, P):
-        over_nodes = jnp.any(carry.alloc[r] < 0, axis=-1)  # [N]
+        over_nodes = jnp.any(carry.alloc[r] < 0, axis=-1)  # [local N]
         at_prio = carry.job_prio == dev.priorities[r]
-        mask = mask | (bound & dev.job_preemptible & at_prio & over_nodes[node])
+        over_at_job = dist.take_rows(over_nodes, carry.job_node)
+        mask = mask | (bound & dev.job_preemptible & at_prio & over_at_job)
     return mask & (dev.job_queue >= 0)
 
 
@@ -873,7 +939,7 @@ def _gang_complete_mask(dev, carry: Carry, evict_mask):
     return evict_mask | (add & bound)
 
 
-def solve_impl(dev: DeviceRound):
+def solve_impl(dev: DeviceRound, dist=LOCAL):
     J = dev.job_req.shape[0]
     Q = dev.queue_weight.shape[0]
     S = dev.slot_members.shape[0]
@@ -962,12 +1028,13 @@ def solve_impl(dev: DeviceRound):
             & evict_queue[qidx]
         )
     evict0 = _gang_complete_mask(dev, carry, evict0)
-    carry = _apply_evictions(dev, carry, evict0)
+    carry = _apply_evictions(dev, dist, carry, evict0)
     carry = _assign_evict_ranks(dev, carry, budgets, dev.prefer_large)
 
     # 2. Pass 1: evicted + queued.
     carry = _schedule_pass(
         dev,
+        dist,
         carry,
         budgets,
         include_queued=True,
@@ -977,14 +1044,14 @@ def solve_impl(dev: DeviceRound):
     )
 
     # 3. Oversubscription eviction.
-    over = _oversubscribed_mask(dev, carry)
+    over = _oversubscribed_mask(dev, dist, carry)
     over = _gang_complete_mask(dev, carry, over)
     # Back out per-round scheduled resources for re-evicted new jobs.
     sched_backout = jnp.sum(
         jnp.where((over & carry.job_scheduled)[:, None], _f(dev.job_req), 0.0),
         axis=0,
     )
-    carry = _apply_evictions(dev, carry, over)
+    carry = _apply_evictions(dev, dist, carry, over)
     carry = carry._replace(scheduled_new=carry.scheduled_new - sched_backout)
     # Re-open ONLY slots whose members were just oversubscription-evicted
     # (pass 2 considers the fresh eviction set, not pass-1 leftovers).
@@ -1012,6 +1079,7 @@ def solve_impl(dev: DeviceRound):
         any_over,
         lambda c: _schedule_pass(
             dev,
+            dist,
             c,
             budgets,
             include_queued=False,

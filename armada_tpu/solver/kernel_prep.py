@@ -59,6 +59,9 @@ class DeviceRound:
     node_labels: np.ndarray  # uint32[N, Wl]
     node_id_rank: np.ndarray  # int32[N]
     node_unschedulable: np.ndarray  # bool[N]
+    # Global node ids (arange(N)); under node sharding each shard holds its
+    # slice, giving kernels the global id of every local node.
+    node_gid: np.ndarray  # int32[N]
     order_res_idx: np.ndarray  # int32[K]
     order_res_resolution: np.ndarray  # int32[K]
 
@@ -192,6 +195,7 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
             [np.asarray(dev.node_id_rank), np.arange(N, Np, dtype=np.int32)]
         ),
         node_unschedulable=pad(dev.node_unschedulable, 0, Np, fill=True),
+        node_gid=np.arange(Np, dtype=np.int32),
         job_req=pad(dev.job_req, 0, Jp),
         job_req_fit=pad(dev.job_req_fit, 0, Jp),
         job_tolerated=pad(dev.job_tolerated, 0, Jp),
@@ -518,6 +522,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         node_labels=snap.node_label_bits,
         node_id_rank=snap.node_id_rank,
         node_unschedulable=snap.node_unschedulable,
+        node_gid=np.arange(N, dtype=np.int32),
         order_res_idx=snap.order_res_idx.astype(np.int32),
         order_res_resolution=np.asarray(order_res, dtype=np.int32),
         job_req=req_dev,

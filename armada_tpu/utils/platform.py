@@ -4,8 +4,13 @@ This machine exposes one real TPU chip through an experimental tunnel
 plugin ("axon") that registers itself in every interpreter via PYTHONPATH
 sitecustomize. When the tunnel is unhealthy, backend initialization blocks
 forever inside a C call — unkillable from Python. Guard: probe device init
-in a disposable subprocess with a timeout; on failure, deregister the
-tunnel backend factories in this process and pin the CPU platform.
+in a disposable subprocess with a timeout (retrying once — tunnel cold
+starts can exceed a single window), and on failure deregister the tunnel
+backend factories in this process and pin the CPU platform.
+
+The probe records WHY a fallback happened in `last_probe_report` and logs
+it to stderr, so a bench run on the wrong platform is diagnosable from its
+output rather than silent (round-1 failure mode: bench silently ran on cpu).
 """
 
 from __future__ import annotations
@@ -14,24 +19,69 @@ import os
 import subprocess
 import sys
 
+# Populated by ensure_healthy_backend for callers (bench) to report.
+last_probe_report: dict = {}
 
-def ensure_healthy_backend(probe_timeout: float = 90.0) -> str:
+
+def _probe_once(timeout: float) -> tuple[str | None, str]:
+    """Returns (platform or None, detail)."""
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; d = jax.devices(); print(d[0].platform)",
+            ],
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"probe timed out after {timeout:.0f}s (tunnel hung)"
+    if proc.returncode == 0:
+        platform = (proc.stdout or "").strip().splitlines()[-1:] or ["unknown"]
+        return platform[0], "ok"
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    return None, f"probe exited rc={proc.returncode}: {' | '.join(tail)}"
+
+
+def ensure_healthy_backend(probe_timeout: float = 120.0, retries: int = 1) -> str:
     """Returns the platform that will be used ("axon"/"tpu"/"cpu")."""
+    global last_probe_report
     want = os.environ.get("JAX_PLATFORMS", "")
     if want and "cpu" in want.split(","):
         _force_cpu()
+        last_probe_report = {"platform": "cpu", "reason": "JAX_PLATFORMS=cpu"}
         return "cpu"
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout,
-            capture_output=True,
+    attempts = []
+    for i in range(retries + 1):
+        platform, detail = _probe_once(probe_timeout)
+        attempts.append(detail)
+        if platform is not None:
+            last_probe_report = {
+                "platform": platform,
+                "reason": "ok",
+                "attempts": attempts,
+            }
+            return platform
+        print(
+            f"[platform] device probe attempt {i + 1}/{retries + 1} failed: "
+            f"{detail}",
+            file=sys.stderr,
+            flush=True,
         )
-        if proc.returncode == 0:
-            return want or "axon"
-    except subprocess.TimeoutExpired:
-        pass
     _force_cpu()
+    last_probe_report = {
+        "platform": "cpu",
+        "reason": "fallback: " + "; ".join(attempts),
+        "attempts": attempts,
+    }
+    print(
+        "[platform] all probes failed; falling back to CPU "
+        f"({'; '.join(attempts)})",
+        file=sys.stderr,
+        flush=True,
+    )
     return "cpu"
 
 

@@ -103,6 +103,8 @@ def main():
     out = solve_round(dev)
     round_s = time.time() - t0
 
+    from armada_tpu.utils import platform as plat
+
     scheduled = int(out["scheduled_mask"].sum())
     result = {
         "metric": (
@@ -117,6 +119,7 @@ def main():
             "compile_s": round(compile_s, 1),
             "snapshot_build_s": round(setup_s, 1),
             "loops": int(out["num_loops"]),
+            "platform_probe": plat.last_probe_report.get("reason", ""),
         },
     }
     print(json.dumps(result))
