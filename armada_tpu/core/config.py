@@ -35,6 +35,11 @@ class FloatingResource:
 class PoolConfig:
     name: str
     away_pools: tuple[str, ...] = ()
+    # Run↔node reconciliation (PoolConfig.ExperimentalRunReconciliation,
+    # scheduling/reconciliation.go): validate leased runs against
+    # executor-reported nodes each cycle; invalid placements are preempted
+    # (gang-aware) or failed for non-preemptible jobs.
+    run_reconciliation: bool = False
 
 
 @dataclass(frozen=True)
@@ -46,6 +51,23 @@ class RateLimits:
     maximum_scheduling_burst: int = 1000
     maximum_per_queue_scheduling_rate: float = 50.0
     maximum_per_queue_scheduling_burst: int = 1000
+
+
+@dataclass(frozen=True)
+class OptimiserConfig:
+    """The experimental fairness-optimising post-pass knobs
+    (configuration OptimiserConfig; scheduling/optimiser/,
+    preempting_queue_scheduler.go:659-702)."""
+
+    enabled: bool = False
+    # FairnessOptimisingGangScheduler.minFairnessImprovementPercentage.
+    min_fairness_improvement_pct: float = 0.0
+    # OptimisingQueueScheduler bounds.
+    maximum_jobs_per_round: int = 100
+    maximum_resource_fraction_to_schedule: dict = field(default_factory=dict)
+    # PreemptingNodeScheduler.maximumJobSizeToPreempt ({resource: qty}).
+    maximum_job_size_to_preempt: dict | None = None
+    minimum_job_size_to_schedule: dict | None = None
 
 
 @dataclass(frozen=True)
@@ -105,6 +127,25 @@ class SchedulingConfig:
     gang_uniformity_label_annotation: str = "armadaproject.io/gangNodeUniformityLabel"
     enable_prefer_large_job_ordering: bool = False
     consider_priority_class_priority: bool = True
+    # Batched fill fast path: when the head of a queue's candidate stream
+    # starts a run of identical singleton gangs (same scheduling key), the
+    # kernel places up to this many of them in ONE while-loop iteration by
+    # filling nodes in best-fit order, stopping exactly at the point the
+    # serial loop would have switched queues or hit a constraint — so
+    # results are bit-identical to the one-gang-per-iteration loop (the
+    # parity suite runs with this enabled). 0 disables.
+    batch_fill_window: int = 512
+    # Fast mode (SURVEY §7 "batch independent single-job gangs between
+    # fair-share re-costs"): one kernel iteration batches a whole
+    # multi-queue sweep — per-queue candidate-cost sequences are closed
+    # forms of their own counts, so the exact serial attempt order is a
+    # SORT of all queues' entry keys, cut at the first ineligible head's
+    # key (gangs, evicted slots, constraint-blocked queues stay serial).
+    # The scheduled job set matches the serial loop whenever every batched
+    # job fits without preemption; node assignment is greedy per queue
+    # rather than attempt-interleaved, so placements may differ from the
+    # reference trace. OFF by default (parity mode).
+    enable_fast_fill: bool = False
     executor_timeout_s: float = 600.0
     max_unacknowledged_jobs_per_executor: int = 2500
     # Short-job penalty (scheduling/short_job_penalty.go): jobs that finish
@@ -123,6 +164,9 @@ class SchedulingConfig:
     # Assert jobdb invariants at the end of each cycle (the reference's
     # enableAssertions, scheduler.go:143; config.yaml:84).
     enable_assertions: bool = False
+    # Experimental fairness-optimising post-pass
+    # (config.Pools[].ExperimentalOptimiser; scheduling/optimiser/).
+    optimiser: "OptimiserConfig | None" = None
 
     # Regex classifier for run errors -> failure category
     # (internal/executor/categorizer/classifier.go): first match wins.
@@ -158,7 +202,30 @@ class SchedulingConfig:
         kwargs = {}
         if "pools" in d:
             kwargs["pools"] = tuple(
-                PoolConfig(p["name"], tuple(p.get("awayPools", ()))) for p in d["pools"]
+                PoolConfig(
+                    p["name"],
+                    tuple(p.get("awayPools", ())),
+                    run_reconciliation=bool(
+                        (p.get("experimentalRunReconciliation") or {}).get(
+                            "enabled", False
+                        )
+                    ),
+                )
+                for p in d["pools"]
+            )
+        if "experimentalOptimiser" in d:
+            o = d["experimentalOptimiser"] or {}
+            kwargs["optimiser"] = OptimiserConfig(
+                enabled=bool(o.get("enabled", False)),
+                min_fairness_improvement_pct=float(
+                    o.get("minimumFairnessImprovementPercentage", 0.0)
+                ),
+                maximum_jobs_per_round=int(o.get("maximumJobsPerRound", 100)),
+                maximum_resource_fraction_to_schedule=dict(
+                    o.get("maximumResourceFractionToSchedule", {})
+                ),
+                maximum_job_size_to_preempt=o.get("maximumJobSizeToPreempt"),
+                minimum_job_size_to_schedule=o.get("minimumJobSizeToSchedule"),
             )
         if "supportedResourceTypes" in d:
             kwargs["supported_resource_types"] = tuple(
@@ -248,6 +315,8 @@ class SchedulingConfig:
                 int,
             ),
             ("enablePreferLargeJobOrdering", "enable_prefer_large_job_ordering", bool),
+            ("batchFillWindow", "batch_fill_window", int),
+            ("enableFastFill", "enable_fast_fill", bool),
         ]:
             if yaml_key in d:
                 kwargs[attr] = conv(d[yaml_key])
@@ -263,3 +332,76 @@ class SchedulingConfig:
         if rl:
             kwargs["rate_limits"] = RateLimits(**rl)
         return SchedulingConfig(**kwargs)
+
+
+def _set_path(d: dict, path: list[str], value):
+    cur = d
+    for key in path[:-1]:
+        cur = cur.setdefault(key, {})
+    cur[path[-1]] = value
+
+
+def _coerce(raw: str):
+    """Env values arrive as strings; YAML-parse them for typed overrides."""
+    try:
+        import yaml
+
+        return yaml.safe_load(raw)
+    except Exception:
+        return raw
+
+
+def load_config(path: str | None = None, env: dict | None = None) -> SchedulingConfig:
+    """Load a scheduling config from YAML with env-var overrides and
+    validation — the viper+pflag pattern of the reference
+    (internal/common/config/, cmd/fakeexecutor/main.go:22-47).
+
+    Env keys: ARMADA__<Path__To__Key>=value, double-underscore-separated
+    reference key names, YAML-typed values, applied over the file, e.g.
+    ARMADA__maxQueueLookback=5000 or
+    ARMADA__protectedFractionOfFairShare=0.5.
+    """
+    import os
+
+    doc: dict = {}
+    if path:
+        import yaml
+
+        with open(path) as f:
+            loaded = yaml.safe_load(f) or {}
+        doc = loaded.get("scheduling", loaded)
+    env = os.environ if env is None else env
+    for key, raw in env.items():
+        if not key.startswith("ARMADA__"):
+            continue
+        parts = key[len("ARMADA__"):].split("__")
+        _set_path(doc, parts, _coerce(raw))
+    config = SchedulingConfig.from_dict(doc)
+    validate_config(config)
+    return config
+
+
+def validate_config(config: SchedulingConfig):
+    """Semantic validation (the reference uses go-playground/validator on
+    its config struct; these mirror the constraints that matter here)."""
+    problems = []
+    if config.default_priority_class not in config.priority_classes:
+        problems.append(
+            f"defaultPriorityClass {config.default_priority_class!r} "
+            "is not a configured priority class"
+        )
+    if not (0.0 <= config.protected_fraction_of_fair_share <= 1e9):
+        problems.append("protectedFractionOfFairShare must be >= 0")
+    if config.max_queue_lookback < 0:
+        problems.append("maxQueueLookback must be >= 0")
+    if config.batch_fill_window < 0:
+        problems.append("batchFillWindow must be >= 0")
+    for name, frac in config.maximum_resource_fraction_to_schedule.items():
+        if frac < 0:
+            problems.append(f"maximumResourceFractionToSchedule[{name}] < 0")
+    known = {t.name for t in config.supported_resource_types}
+    for name in config.dominant_resource_fairness_resources:
+        if name not in known:
+            problems.append(f"DRF resource {name!r} is not a supported type")
+    if problems:
+        raise ValueError("invalid scheduling config: " + "; ".join(problems))

@@ -135,6 +135,10 @@ class QueueUpsert(Event):
     name: str = ""
     priority_factor: float = 1.0
     cordoned: bool = False
+    # Queue-level auth (pkg/client/queue permission model): owner names
+    # and [{subjects: [...], verbs: [...]}] grants.
+    owners: tuple = ()
+    permissions: tuple = ()
 
 
 @dataclass(frozen=True)

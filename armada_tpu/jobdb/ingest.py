@@ -40,8 +40,8 @@ def _apply_event(txn, seq: ev.EventSequence, event, error_rules=()) -> None:
         return
 
     if isinstance(event, ev.CancelJobSet):
-        for job in list(txn.all_jobs()):
-            if job.queue == seq.queue and job.jobset == seq.jobset and not job.state.terminal:
+        for job in txn.jobs_for_jobset(seq.queue, seq.jobset):
+            if not job.state.terminal:
                 txn.upsert(job.with_(state=JobState.CANCELLED))
         return
 
@@ -93,7 +93,12 @@ def _apply_event(txn, seq: ev.EventSequence, event, error_rules=()) -> None:
     elif isinstance(event, ev.JobRunErrors):
         run = job.latest_run
         if run and run.id == event.run_id:
-            run = replace(run, state=RunState.FAILED, finished=event.created)
+            run = replace(
+                run,
+                state=RunState.FAILED,
+                finished=event.created,
+                retryable=bool(getattr(event, "retryable", True)),
+            )
             failed_nodes = job.failed_nodes + ((run.node_id,) if run.node_id else ())
             txn.upsert(
                 job.with_(runs=job.runs[:-1] + (run,), failed_nodes=failed_nodes,
@@ -124,7 +129,14 @@ def categorize_error(error: str, rules) -> str:
 class SchedulerIngester:
     """Cursor-tracked consumer materializing the log into a JobDb."""
 
-    def __init__(self, log, jobdb: JobDb, error_rules=(), settings_handler=None):
+    def __init__(
+        self,
+        log,
+        jobdb: JobDb,
+        error_rules=(),
+        settings_handler=None,
+        transition_observer=None,
+    ):
         self.log = log
         self.jobdb = jobdb
         self.error_rules = error_rules
@@ -133,6 +145,10 @@ class SchedulerIngester:
         # materialized settings stay current on the same cursor as the
         # jobdb — a standby catches up on its first post-failover sync.
         self.settings_handler = settings_handler
+        # Optional hook (txn, event) called BEFORE each job event applies:
+        # feeds state-transition metrics with time-in-previous-state
+        # (metrics/state_metrics.go checkpoint intervals).
+        self.transition_observer = transition_observer
         self.cursor = 0
 
     def sync(self, limit: int = 10_000) -> int:
@@ -145,6 +161,9 @@ class SchedulerIngester:
             txn = self.jobdb.write_txn()
             try:
                 for entry in entries:
+                    if self.transition_observer is not None:
+                        for event in entry.sequence.events:
+                            self.transition_observer(txn, event)
                     apply_entry(txn, entry, self.error_rules)
                     if self.settings_handler is not None:
                         for event in entry.sequence.events:

@@ -17,6 +17,8 @@ import argparse
 import time
 
 from .grpc_api import ApiClient
+from .podchecks import PodIssueHandler
+from .utilisation import UtilisationReporter, node_reports
 
 
 class _PodRuntime:
@@ -31,6 +33,8 @@ class _PodRuntime:
         self.pods[lease["run_id"]] = {
             **lease,
             "created": now,
+            "last_change": now,
+            "node": lease.get("node_id", ""),
             "phase": "created",
         }
 
@@ -79,26 +83,61 @@ class ExecutorAgent:
         self.nodes = nodes
         self.runtime = runtime or _PodRuntime()
         self.acked: set[str] = set()
+        # Pod-issue machinery + utilisation reporting (executor/podchecks,
+        # executor/utilisation): stuck pods are actioned into retry/fail
+        # reports; node heartbeats carry usage and the non-framework slice.
+        self.issue_handler = PodIssueHandler()
+        self.utilisation = UtilisationReporter()
+        self.non_framework_usage: dict[str, dict] = {}
 
     def tick(self, now: float | None = None) -> dict:
         now = time.time() if now is None else now
+        self.utilisation.sample(self.runtime.pods)
         reply = self.client._call(
             "ExecutorLease",
             {
                 "executor": self.name,
                 "pool": self.pool,
-                "nodes": self.nodes,
+                "nodes": node_reports(
+                    self.nodes,
+                    self.utilisation.by_node(),
+                    self.non_framework_usage,
+                ),
                 "acked_run_ids": sorted(self.acked),
             },
         )
         for lease in reply.get("leases", []):
             if lease["run_id"] not in self.acked:
+                from ..utils.compress import decompress_obj
+
+                lease = {**lease, "spec": decompress_obj(lease.get("spec"))}
                 # create before ack: a failed create must be re-leased
                 self.runtime.create(lease, now)
                 self.acked.add(lease["run_id"])
         for cancel in reply.get("cancel_runs", []):
+            self.issue_handler.note_kill(cancel["run_id"], now)
             self.runtime.kill(cancel["run_id"])
+            self.issue_handler.note_gone(cancel["run_id"])
         events = self.runtime.poll(now)
+        # Pod-issue sweep: stuck pods become retryable/fatal run errors
+        # (service/pod_issue_handler.go).
+        for issue in self.issue_handler.examine(self.runtime.pods, now):
+            pod = self.runtime.pods.get(issue["run_id"])
+            if pod is None:
+                continue
+            events.append(
+                {
+                    "type": "failed",
+                    "job_id": pod["job_id"],
+                    "run_id": pod["run_id"],
+                    "queue": pod["queue"],
+                    "jobset": pod["jobset"],
+                    "created": now,
+                    "error": f"pod issue: {issue['message']}",
+                    "retryable": issue["retryable"],
+                }
+            )
+            self.runtime.kill(issue["run_id"])
         # Reconciliation: runs the server believes are live here but the
         # runtime doesn't know (agent restart, lost pod) are reported
         # failed so the scheduler retries them elsewhere (the reference

@@ -11,17 +11,20 @@ state back through the event log.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 from ..core.types import NodeSpec
 from ..events import (
     EventSequence,
+    JobRunErrors,
     JobRunPending,
     JobRunRunning,
     JobRunSucceeded,
     JobSucceeded,
 )
+from .podchecks import Action, PodChecker, PodIssueHandler
 from .scheduler import ExecutorHeartbeat
+from .utilisation import ALL_PRIORITIES, UtilisationReporter
 
 
 def make_nodes(
@@ -32,9 +35,11 @@ def make_nodes(
     memory: str = "128Gi",
     labels: dict | None = None,
     taints=(),
+    extra_resources: dict | None = None,
 ) -> list[NodeSpec]:
     """Default shape mirrors the reference fake executor: 500 x 8 cpu /
-    128Gi (internal/executor/fake/context/context.go:40-49)."""
+    128Gi (internal/executor/fake/context/context.go:40-49);
+    extra_resources adds e.g. {"nvidia.com/gpu": "8"} for GPU nodes."""
     return [
         NodeSpec(
             id=f"{executor}-node-{i:05d}",
@@ -43,10 +48,20 @@ def make_nodes(
             pool=pool,
             labels=dict(labels or {}),
             taints=tuple(taints),
-            total_resources={"cpu": cpu, "memory": memory},
+            total_resources={
+                "cpu": cpu,
+                "memory": memory,
+                **(extra_resources or {}),
+            },
         )
         for i in range(count)
     ]
+
+
+# Jobs annotated with this fail once they start, with the annotation value
+# as the error message — the testsuite's categorization cases use it (the
+# reference's testcases run containers that exit non-zero).
+FAIL_SIMULATION_ANNOTATION = "armadaproject.io/fail-simulation"
 
 
 @dataclass
@@ -72,6 +87,10 @@ class FakeExecutor:
         pool: str = "default",
         runtime_for=lambda job_id: 30.0,
         startup_delay: float = 0.0,
+        pod_checker: PodChecker | None = None,
+        issue_for=None,
+        non_framework_usage: dict | None = None,
+        usage_fn=None,
     ):
         self.name = name
         self.log = log
@@ -82,6 +101,31 @@ class FakeExecutor:
         self.startup_delay = startup_delay
         self.active: dict[str, _ActiveRun] = {}
         self._seen_runs: set[str] = set()
+        # Pod-issue machinery (podchecks + pod_issue_handler.go):
+        # `issue_for(job_id)` simulates a faulty pod, returning a record
+        # like {"events": [{"type": "Warning", "message": ...}],
+        # "blocked": True} — blocked pods never reach running and are
+        # eventually actioned by the checker.
+        self.issue_handler = PodIssueHandler(pod_checker)
+        self.issue_for = issue_for or (lambda job_id: None)
+        self._issues: dict[str, dict] = {}  # run_id -> pod record
+        # Utilisation (executor/utilisation/): framework usage sampled per
+        # running pod; non-framework usage reported as unallocatable at
+        # every priority row.
+        self.utilisation = UtilisationReporter(usage_fn=usage_fn)
+        if non_framework_usage:
+            self.nodes = [
+                replace(
+                    n,
+                    unallocatable_by_priority={
+                        **n.unallocatable_by_priority,
+                        ALL_PRIORITIES: non_framework_usage[n.id],
+                    },
+                )
+                if n.id in non_framework_usage
+                else n
+                for n in self.nodes
+            ]
 
     def heartbeat(self, now: float):
         """Report node state (the LeaseRequest half of the lease loop)."""
@@ -119,6 +163,16 @@ class FakeExecutor:
                 started=now,
                 finishes_at=now + self.startup_delay + runtime,
             )
+            issue = self.issue_for(job.id)
+            if issue:
+                self._issues[run.id] = {
+                    "phase": "pending",
+                    "created": now,
+                    "last_change": now,
+                    "node": run.node_id,
+                    "spec": {"requests": dict(job.spec.requests)},
+                    **issue,
+                }
 
     # ---- binoculars surface (logs + cordon) ----
 
@@ -147,14 +201,40 @@ class FakeExecutor:
         """Advance pod lifecycle; emit state-transition events."""
         self.heartbeat(now)
         self.accept_leases(now)
+        self._check_pod_issues(now)
         txn = self.scheduler.jobdb.read_txn()
         for run in list(self.active.values()):
             job = txn.get(run.job_id)
             if job is None or job.state.terminal:
                 # cancelled or preempted underneath us
                 self.active.pop(run.run_id, None)
+                self._issues.pop(run.run_id, None)
                 continue
+            if run.run_id in self._issues and self._issues[run.run_id].get(
+                "blocked"
+            ):
+                continue  # faulty pod: never progresses
             if not run.running_reported and now >= run.started + self.startup_delay:
+                fail_msg = job.spec.annotations.get(FAIL_SIMULATION_ANNOTATION)
+                if fail_msg:
+                    self.log.publish(
+                        EventSequence.of(
+                            run.queue,
+                            run.jobset,
+                            JobRunRunning(
+                                created=now, job_id=run.job_id, run_id=run.run_id
+                            ),
+                            JobRunErrors(
+                                created=now,
+                                job_id=run.job_id,
+                                run_id=run.run_id,
+                                error=fail_msg,
+                                retryable=False,
+                            ),
+                        )
+                    )
+                    self.active.pop(run.run_id, None)
+                    continue
                 self.log.publish(
                     EventSequence.of(
                         run.queue,
@@ -173,3 +253,49 @@ class FakeExecutor:
                     )
                 )
                 self.active.pop(run.run_id, None)
+        self._sample_utilisation(now)
+
+    def _check_pod_issues(self, now: float):
+        """The pod-issue loop (service/pod_issue_handler.go): faulty pods
+        are examined against the configured checks; RETRY reports a
+        retryable run error, FAIL a fatal one; either way the pod dies."""
+        if not self._issues:
+            return
+        for issue in self.issue_handler.examine(self._issues, now):
+            run = self.active.get(issue["run_id"])
+            if run is None:
+                self._issues.pop(issue["run_id"], None)
+                continue
+            self.log.publish(
+                EventSequence.of(
+                    run.queue,
+                    run.jobset,
+                    JobRunErrors(
+                        created=now,
+                        job_id=run.job_id,
+                        run_id=run.run_id,
+                        error=f"pod issue: {issue['message']}",
+                        retryable=issue["retryable"],
+                    ),
+                )
+            )
+            self.active.pop(run.run_id, None)
+            self._issues.pop(run.run_id, None)
+
+    def _sample_utilisation(self, now: float):
+        """Feed the utilisation reporter from running pods."""
+        pods = {}
+        txn = self.scheduler.jobdb.read_txn()
+        for run in self.active.values():
+            job = txn.get(run.job_id)
+            if job is None:
+                continue
+            pods[run.run_id] = {
+                "phase": "running" if run.running_reported else "pending",
+                "node": job.latest_run.node_id if job.latest_run else "",
+                "spec": {"requests": dict(job.spec.requests)},
+            }
+        self.utilisation.sample(pods)
+
+    def usage_by_node(self) -> dict:
+        return self.utilisation.by_node()

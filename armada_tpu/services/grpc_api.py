@@ -103,7 +103,15 @@ class ApiServer:
     """Hosts submit/query/events/reports over one gRPC server."""
 
     def __init__(
-        self, submit, scheduler, query, log, submit_checker=None, binoculars=None
+        self,
+        submit,
+        scheduler,
+        query,
+        log,
+        submit_checker=None,
+        binoculars=None,
+        auth=None,
+        authorizer=None,
     ):
         self.submit = submit
         self.scheduler = scheduler
@@ -111,6 +119,43 @@ class ApiServer:
         self.log = log
         self.submit_checker = submit_checker
         self.binoculars = binoculars
+        # Authentication chain + permission mapping (services/auth.py;
+        # common/auth/{multi,permissions}.go). None = open server (tests,
+        # trusted in-process deployments).
+        self.auth = auth
+        self.authorizer = authorizer
+
+    def _authorize(self, method: str, principal, req: dict):
+        """Per-method permission gate (the reference's auth interceptors +
+        per-handler authorize calls, server/submit.go)."""
+        from . import auth as A
+
+        az = self.authorizer
+        if az is None or principal is None:
+            return
+        queue = None
+        if "queue" in req and self.submit is not None:
+            queue = self.submit.get_queue(req.get("queue", ""))
+        if method == "SubmitJobs":
+            az.authorize_queue(principal, "submit", queue, A.SUBMIT_ANY_JOBS)
+        elif method == "CancelJobs":
+            az.authorize_queue(principal, "cancel", queue, A.CANCEL_ANY_JOBS)
+        elif method == "ReprioritizeJobs":
+            az.authorize_queue(
+                principal, "reprioritize", queue, A.REPRIORITIZE_ANY_JOBS
+            )
+        elif method in ("CreateQueue", "UpdateQueue"):
+            az.authorize_global(principal, A.CREATE_QUEUE)
+        elif method == "DeleteQueue":
+            az.authorize_global(principal, A.DELETE_QUEUE)
+        elif method in ("CordonNode", "CordonExecutor", "SetPriorityOverride"):
+            az.authorize_global(principal, A.CORDON)
+        elif method in ("ExecutorLease", "ReportEvents"):
+            az.authorize_global(principal, A.EXECUTE_JOBS)
+        elif method == "WatchJobSet":
+            az.authorize_queue(principal, "watch", queue, A.WATCH_ALL_EVENTS)
+        # Reads (GetQueue/ListQueues/GetJobs/reports/logs) require only an
+        # authenticated principal.
 
     # ---- unary handlers ----
 
@@ -257,6 +302,12 @@ class ApiServer:
                 ),
                 total_resources=dict(n.get("total_resources", {})),
                 unschedulable=bool(n.get("unschedulable", False)),
+                # Utilisation reporting: the non-framework slice arrives as
+                # unallocatable-at-every-priority (executor/utilisation/).
+                unallocatable_by_priority={
+                    int(k): dict(v)
+                    for k, v in n.get("unallocatable_by_priority", {}).items()
+                },
             )
             for n in req.get("nodes", [])
         ]
@@ -269,11 +320,16 @@ class ApiServer:
         acked = set(req.get("acked_run_ids", []))
         leases, cancels, active = [], [], []
         txn = self.scheduler.jobdb.read_txn()
-        for job in txn.all_jobs():
+        # Live runs on this executor come from the by-executor index; the
+        # cancel sweep below resolves acked run ids directly (no full-store
+        # walk on the lease hot path).
+        for job in txn.jobs_for_executor(name):
             run = job.latest_run
             if run is None or run.executor != name:
                 continue
             if job.state == JobState.LEASED and run.id not in acked:
+                from ..utils.compress import compress_obj
+
                 leases.append(
                     {
                         "run_id": run.id,
@@ -282,11 +338,16 @@ class ApiServer:
                         "jobset": job.jobset,
                         "node_id": run.node_id,
                         "scheduled_at_priority": run.scheduled_at_priority,
-                        "spec": {
-                            "id": job.spec.id,
-                            "requests": job.spec.requests,
-                            "annotations": job.spec.annotations,
-                        },
+                        # Jobspecs dominate lease payloads; compressed like
+                        # the reference's zlib-compressed lease replies
+                        # (common/compress, scheduler/api.go).
+                        "spec": compress_obj(
+                            {
+                                "id": job.spec.id,
+                                "requests": job.spec.requests,
+                                "annotations": job.spec.annotations,
+                            }
+                        ),
                     }
                 )
             elif job.state in (JobState.PENDING, JobState.RUNNING):
@@ -300,22 +361,24 @@ class ApiServer:
                         "jobset": job.jobset,
                     }
                 )
-            elif (
-                job.state
+        # Jobs killed underneath the executor: tear the pod down
+        # (SUCCEEDED pods exit on their own; no cancel for them). The acked
+        # gate is both necessary and sufficient: the agent's acked set IS
+        # its live-pod set (executor_agent.py prunes acks to live pods
+        # every tick), so a pod started from a prior exchange whose job was
+        # cancelled mid-flight appears in acked on the NEXT exchange and
+        # gets its cancel then; and runs that never produced a pod never
+        # trigger resends. Resolved per acked run id via the run index.
+        for rid in acked:
+            job = txn.job_for_run(rid)
+            if (
+                job is not None
+                and job.state
                 in (JobState.CANCELLED, JobState.PREEMPTED, JobState.FAILED)
-                and run.id in acked
+                and job.latest_run is not None
+                and job.latest_run.executor == name
             ):
-                # killed underneath the executor: tear the pod down
-                # (SUCCEEDED pods exit on their own; no cancel for them).
-                # The acked gate is both necessary and sufficient: the
-                # agent's acked set IS its live-pod set (executor_agent.py
-                # prunes acks to live pods every tick), so a pod started
-                # from a prior exchange whose job was cancelled mid-flight
-                # appears in acked on the NEXT exchange and gets its cancel
-                # then; and runs that never produced a pod never trigger
-                # resends (an unconditional send would re-deliver cancels
-                # for every retained terminal job on every exchange).
-                cancels.append({"run_id": run.id, "job_id": job.id})
+                cancels.append({"run_id": rid, "job_id": job.id})
         return {"leases": leases, "cancel_runs": cancels, "active_runs": active}
 
     def _report_events(self, req):
@@ -465,6 +528,24 @@ class ApiServer:
         outer = self
         watchers = threading.Semaphore(max_watchers)
 
+        from .auth import AuthError, PermissionDenied
+
+        def gate(method, request, context):
+            """Authenticate + authorize one call; aborts on failure."""
+            if outer.auth is None:
+                return None
+            md = {
+                k.lower(): v for k, v in (context.invocation_metadata() or ())
+            }
+            try:
+                principal = outer.auth.authenticate(md)
+                outer._authorize(method, principal, request)
+                return principal
+            except AuthError as e:
+                context.abort(grpc.StatusCode.UNAUTHENTICATED, str(e))
+            except PermissionDenied as e:
+                context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+
         class Handler(grpc.GenericRpcHandler):
             def service(self, handler_call_details):
                 name = handler_call_details.method  # /Service/Method
@@ -474,15 +555,15 @@ class ApiServer:
                 method = parts[1]
                 if method == "WatchJobSet":
                     def stream(request, context):
+                        req = _decode(request)
+                        gate(method, req, context)
                         if not watchers.acquire(blocking=False):
                             context.abort(
                                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                                 f"too many concurrent watchers (max {max_watchers})",
                             )
                         try:
-                            yield from outer._watch_jobset(
-                                _decode(request), context
-                            )
+                            yield from outer._watch_jobset(req, context)
                         finally:
                             watchers.release()
 
@@ -496,8 +577,10 @@ class ApiServer:
                     return None
 
                 def unary(request, context):
+                    req = _decode(request)
+                    gate(method, req, context)
                     try:
-                        return _encode(fn(_decode(request)))
+                        return _encode(fn(req))
                     except KeyError as e:
                         context.abort(grpc.StatusCode.NOT_FOUND, str(e))
                     except ValueError as e:
@@ -515,10 +598,23 @@ class ApiServer:
 
 
 class ApiClient:
-    """Python client for the gRPC API (pkg/client + client/python analogue)."""
+    """Python client for the gRPC API (pkg/client + client/python analogue).
 
-    def __init__(self, target: str):
+    Credentials: pass `token=` (Bearer JWT) or `basic=(user, password)` —
+    the client attaches the authorization metadata the server's auth chain
+    expects (client/rust/src/auth.rs plays the same role)."""
+
+    def __init__(self, target: str, token: str | None = None, basic=None):
         self.channel = grpc.insecure_channel(target)
+        self._metadata: list = []
+        if token:
+            self._metadata = [("authorization", f"Bearer {token}")]
+        elif basic:
+            import base64
+
+            user, password = basic
+            cred = base64.b64encode(f"{user}:{password}".encode()).decode()
+            self._metadata = [("authorization", f"Basic {cred}")]
 
     def _call(self, method: str, request: dict):
         fn = self.channel.unary_unary(
@@ -526,7 +622,7 @@ class ApiClient:
             request_serializer=bytes,
             response_deserializer=bytes,
         )
-        return _decode(fn(_encode(request)))
+        return _decode(fn(_encode(request), metadata=self._metadata or None))
 
     def submit_jobs(self, queue, jobset, jobs: list[dict]):
         return self._call(
@@ -638,7 +734,8 @@ class ApiClient:
             _encode(
                 {"queue": queue, "jobset": jobset, "from_offset": from_offset,
                  "watch": watch}
-            )
+            ),
+            metadata=self._metadata or None,
         )
         for msg in stream:
             yield _decode(msg)

@@ -1,0 +1,120 @@
+"""Health checking: startup + liveness surface.
+
+Mirrors /root/reference/internal/common/health/ (startup checker, multi
+checker, HTTP handler wired per service at schedulerapp.go:71-75): each
+component registers a named checker; the multi-checker aggregates; an
+HTTP endpoint exposes /health (liveness) and /health/startup.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class StartupCompleteChecker:
+    """Satisfied once the component signals it finished starting
+    (health/startup_complete_checker.go)."""
+
+    def __init__(self, name: str = "startup"):
+        self.name = name
+        self._complete = False
+
+    def mark_complete(self):
+        self._complete = True
+
+    def check(self) -> tuple[bool, str]:
+        return (True, "started") if self._complete else (False, "starting")
+
+
+class FuncChecker:
+    """Wraps a callable returning (ok, detail)."""
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self.fn = fn
+
+    def check(self) -> tuple[bool, str]:
+        try:
+            result = self.fn()
+            if isinstance(result, tuple):
+                return bool(result[0]), str(result[1])
+            return bool(result), ""
+        except Exception as e:  # a crashing checker is unhealthy
+            return False, f"checker raised: {e!r}"
+
+
+class HeartbeatChecker:
+    """Healthy while beats keep arriving within the timeout (used for the
+    scheduler cycle loop: a wedged cycle turns the service unhealthy)."""
+
+    def __init__(self, name: str, timeout_s: float):
+        self.name = name
+        self.timeout_s = timeout_s
+        self._last = time.monotonic()
+
+    def beat(self):
+        self._last = time.monotonic()
+
+    def check(self) -> tuple[bool, str]:
+        age = time.monotonic() - self._last
+        ok = age <= self.timeout_s
+        return ok, f"last beat {age:.1f}s ago (timeout {self.timeout_s}s)"
+
+
+class MultiChecker:
+    """health/multi_checker.go: all registered checkers must pass."""
+
+    def __init__(self, *checkers):
+        self.checkers = list(checkers)
+
+    def add(self, checker):
+        self.checkers.append(checker)
+
+    def check(self) -> tuple[bool, dict]:
+        results = {}
+        ok = True
+        for checker in self.checkers:
+            c_ok, detail = checker.check()
+            results[checker.name] = {"ok": c_ok, "detail": detail}
+            ok = ok and c_ok
+        return ok, results
+
+
+def serve_health(
+    checker: MultiChecker,
+    startup: StartupCompleteChecker | None = None,
+    port: int = 0,
+):
+    """HTTP health endpoint: /health (liveness via the multi-checker) and
+    /health/startup (the startup checker alone). Returns (server, port);
+    server runs on a daemon thread."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/health/startup" and startup is not None:
+                ok, detail = startup.check()
+                body = {"ok": ok, "detail": detail}
+            elif self.path in ("/health", "/healthz"):
+                ok, body = checker.check()
+                body = {"ok": ok, "checks": body}
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            data = json.dumps(body).encode()
+            self.send_response(200 if body["ok"] else 503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, server.server_address[1]

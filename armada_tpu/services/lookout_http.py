@@ -58,10 +58,18 @@ pre{background:#fff;padding:12px;border-radius:8px;font-size:12px;overflow:auto}
 <option>succeeded</option><option>failed</option><option>cancelled</option><option>preempted</option></select>
 <button onclick="load()">refresh</button>
 <button onclick="toggleReport()">scheduling report</button>
+<button onclick="toggleErrors()">errors</button>
 </div>
 <pre id="report" style="display:none"></pre>
+<pre id="errors" style="display:none"></pre>
+<div id="details" style="display:none;position:fixed;top:8%;left:50%;transform:translateX(-50%);
+background:#fff;border-radius:8px;box-shadow:0 8px 30px rgba(0,0,0,.25);padding:16px;
+max-width:700px;max-height:80%;overflow:auto;z-index:10">
+<button style="float:right" onclick="hideDetails()">close</button>
+<pre id="details-body" style="background:none"></pre></div>
 <table id="jobs"><thead><tr>
-<th>job</th><th>queue</th><th>jobset</th><th>state</th><th>node</th><th>executor</th><th>attempts</th>
+<th>job</th><th>queue</th><th>jobset</th><th>state</th><th>node</th><th>executor</th>
+<th>attempts</th><th>error</th>
 </tr></thead><tbody></tbody></table>
 </main>
 <script>
@@ -76,14 +84,30 @@ async function load(){
   let u='/api/jobs?take=200';if(q)u+='&queue='+encodeURIComponent(q);if(st)u+='&state='+st;
   const data=await jget(u);
   document.querySelector('#jobs tbody').innerHTML=data.jobs.map(j=>
-    `<tr><td>${esc(j.job_id)}</td><td>${esc(j.queue)}</td><td>${esc(j.jobset)}</td>
+    `<tr style="cursor:pointer" onclick="showDetails('${esc(j.job_id)}')">
+     <td>${esc(j.job_id)}</td><td>${esc(j.queue)}</td><td>${esc(j.jobset)}</td>
      <td><span class="state ${esc(j.state)}">${esc(j.state)}</span></td>
-     <td>${esc(j.node)}</td><td>${esc(j.executor)}</td><td>${esc(j.attempts)}</td></tr>`).join('');
+     <td>${esc(j.node)}</td><td>${esc(j.executor)}</td><td>${esc(j.attempts)}</td>
+     <td title="${esc(j.error)}">${esc(j.error_category||(j.error?'error':''))}</td></tr>`).join('');
 }
+async function showDetails(id){
+  const d=await jget('/api/details/'+encodeURIComponent(id));
+  document.getElementById('details-body').textContent=JSON.stringify(d,null,2);
+  document.getElementById('details').style.display='block';
+}
+function hideDetails(){document.getElementById('details').style.display='none'}
 async function toggleReport(){
   const el=document.getElementById('report');
   if(el.style.display==='none'){el.textContent=(await jget('/api/report')).report;el.style.display='block'}
   else el.style.display='none';
+}
+async function toggleErrors(){
+  const el=document.getElementById('errors');
+  if(el.style.display==='none'){
+    const d=await jget('/api/errors');
+    el.textContent=d.errors.map(e=>`${e.job_id} [${e.error_category}] ${e.error}`).join('\\n')||'no errors';
+    el.style.display='block'
+  } else el.style.display='none';
 }
 load();setInterval(load,3000);
 </script></body></html>
@@ -163,6 +187,20 @@ class LookoutHttpServer:
                         self._json(
                             {"report": outer.scheduler.reports.scheduling_report()}
                         )
+                    elif parsed.path == "/api/errors":
+                        filters = []
+                        if params.get("queue"):
+                            filters.append(JobFilter("queue", params["queue"]))
+                        self._json(
+                            {"errors": outer.query.get_job_errors(filters)}
+                        )
+                    elif parsed.path.startswith("/api/details/"):
+                        job_id = parsed.path.rsplit("/", 1)[1]
+                        details = outer.query.job_details(job_id)
+                        if details is None:
+                            self._json({"error": "not found"}, 404)
+                        else:
+                            self._json(details)
                     elif parsed.path.startswith("/api/job/"):
                         job_id = parsed.path.rsplit("/", 1)[1]
                         spec = outer.query.get_job_spec(job_id)

@@ -85,6 +85,63 @@ class SchedulerMetrics:
         self.event_log_offset = Gauge(
             "event_log_end_offset", "End offset of the event log", registry=r
         )
+        # ---- depth mirroring metrics/cycle_metrics.go + state_metrics.go ----
+        # Preemptions by mechanism (cycle_metrics.go:531 preemption types):
+        # round (fairness/urgency in the solve), oversubscription repair,
+        # reconciliation, optimiser.
+        self.preempted_by_type = Counter(
+            "scheduler_jobs_preempted_by_type_total",
+            "Jobs preempted, by preemption mechanism",
+            ["pool", "type"],
+            registry=r,
+        )
+        # Per-queue state-transition counters with queue granularity.
+        self.queue_state_transitions = Counter(
+            "scheduler_queue_job_state_transitions_total",
+            "Job state transitions by queue",
+            ["queue", "state"],
+            registry=r,
+        )
+        # Time-in-state at transition (state_metrics.go checkpoint
+        # intervals): queued->leased, leased->running, running->done.
+        self.state_seconds = Histogram(
+            "scheduler_job_state_seconds",
+            "Seconds spent in the previous state at each transition",
+            ["transition"],
+            buckets=(0.1, 1, 5, 15, 60, 300, 1800, 7200, 86400),
+            registry=r,
+        )
+        self.queue_demand = Gauge(
+            "scheduler_queue_demand",
+            "Queue demand as dominant-share cost",
+            ["pool", "queue"],
+            registry=r,
+        )
+        # Ingestion lag (common/ingest/metrics + topic_delay_monitor.go):
+        # events between the log end and the ingester cursor.
+        self.ingester_lag = Gauge(
+            "ingester_lag_events",
+            "Events the scheduler ingester has not applied yet",
+            registry=r,
+        )
+        self.snapshot_build_seconds = Histogram(
+            "scheduler_snapshot_build_seconds",
+            "Host-side snapshot + device-prep time per pool round",
+            ["pool"],
+            registry=r,
+        )
+        self.solve_loops = Gauge(
+            "scheduler_solve_loops",
+            "while-loop iterations of the last device solve",
+            ["pool"],
+            registry=r,
+        )
+        self.executor_heartbeat_age = Gauge(
+            "scheduler_executor_heartbeat_age_seconds",
+            "Seconds since each executor's last heartbeat",
+            ["executor"],
+            registry=r,
+        )
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS:

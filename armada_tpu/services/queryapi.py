@@ -45,10 +45,15 @@ class JobRow:
     attempts: int
     error: str
     error_category: str
+    last_transition: float = 0.0
+    runtime_s: float = 0.0  # latest run start -> finish (0 while running)
 
     @staticmethod
     def from_job(job) -> "JobRow":
         run = job.latest_run
+        runtime = 0.0
+        if run is not None and run.started and run.finished:
+            runtime = max(0.0, run.finished - run.started)
         return JobRow(
             job_id=job.id,
             queue=job.queue,
@@ -62,6 +67,36 @@ class JobRow:
             attempts=job.num_attempts,
             error=job.error,
             error_category=job.error_category,
+            last_transition=max(
+                job.submitted,
+                run.finished if run else 0.0,
+                run.started if run else 0.0,
+                run.leased if run else 0.0,
+            ),
+            runtime_s=runtime,
+        )
+
+    @staticmethod
+    def from_lookout(row) -> "JobRow":
+        run = row.latest_run
+        runtime = 0.0
+        if run is not None and run.started and run.finished:
+            runtime = max(0.0, run.finished - run.started)
+        return JobRow(
+            job_id=row.job_id,
+            queue=row.queue,
+            jobset=row.jobset,
+            state=row.state,
+            priority=row.priority,
+            priority_class=row.priority_class,
+            submitted=row.submitted,
+            node=run.node if run else "",
+            executor=run.executor if run else "",
+            attempts=len(row.runs),
+            error=row.error,
+            error_category=row.error_category,
+            last_transition=row.last_transition,
+            runtime_s=runtime,
         )
 
 
@@ -77,10 +112,19 @@ def _matches(row: JobRow, f: JobFilter) -> bool:
 
 
 class QueryApi:
-    def __init__(self, jobdb: JobDb):
+    """Query surface over either the live jobdb or the independently
+    materialized lookout view (pass `lookout=LookoutStore`): the reference
+    serves lookout queries from its own Postgres view, never the scheduler
+    DB (internal/lookout/repository)."""
+
+    def __init__(self, jobdb: JobDb | None = None, lookout=None):
+        assert jobdb is not None or lookout is not None
         self.jobdb = jobdb
+        self.lookout = lookout
 
     def _rows(self) -> list[JobRow]:
+        if self.lookout is not None:
+            return [JobRow.from_lookout(r) for r in self.lookout.all_rows()]
         txn = self.jobdb.read_txn()
         return [JobRow.from_job(j) for j in txn.all_jobs()]
 
@@ -129,7 +173,117 @@ class QueryApi:
                 elif agg == "state_counts":
                     sc = g["aggregates"].setdefault(agg, {})
                     sc[row.state] = sc.get(row.state, 0) + 1
+                elif agg == "error_category_counts":
+                    sc = g["aggregates"].setdefault(agg, {})
+                    if row.error_category:
+                        sc[row.error_category] = sc.get(row.error_category, 0) + 1
+                elif agg == "last_transition_max":
+                    cur = g["aggregates"].get(agg)
+                    g["aggregates"][agg] = (
+                        row.last_transition
+                        if cur is None
+                        else max(cur, row.last_transition)
+                    )
+                elif agg == "runtime_avg":
+                    bucket = g["aggregates"].setdefault(agg, {"sum": 0.0, "n": 0})
+                    if row.runtime_s:
+                        bucket["sum"] += row.runtime_s
+                        bucket["n"] += 1
+        for g in groups.values():
+            ra = g["aggregates"].get("runtime_avg")
+            if isinstance(ra, dict):
+                g["aggregates"]["runtime_avg"] = (
+                    ra["sum"] / ra["n"] if ra["n"] else 0.0
+                )
         return sorted(groups.values(), key=lambda g: -g["count"])
+
+    def get_job_errors(
+        self, filters: list[JobFilter] = (), take: int = 100
+    ) -> list[dict]:
+        """Error drilldown (lookout repository GetJobError + the UI's error
+        surfacing): failed jobs with error text + category + run history."""
+        out = []
+        for row in self._rows():
+            if not row.error:
+                continue
+            if not all(_matches(row, f) for f in filters):
+                continue
+            out.append(
+                {
+                    "job_id": row.job_id,
+                    "queue": row.queue,
+                    "jobset": row.jobset,
+                    "state": row.state,
+                    "error": row.error,
+                    "error_category": row.error_category,
+                    "attempts": row.attempts,
+                    "node": row.node,
+                }
+            )
+            if len(out) >= take:
+                break
+        return out
+
+    def job_details(self, job_id: str) -> dict | None:
+        """Job drill-down for the UI: spec + run history + error."""
+        if self.lookout is not None:
+            row = self.lookout.get(job_id)
+            if row is None:
+                return None
+            return {
+                "job_id": row.job_id,
+                "queue": row.queue,
+                "jobset": row.jobset,
+                "state": row.state,
+                "priority": row.priority,
+                "priority_class": row.priority_class,
+                "requests": dict(row.requests),
+                "annotations": dict(row.annotations),
+                "submitted": row.submitted,
+                "error": row.error,
+                "error_category": row.error_category,
+                "runs": [
+                    {
+                        "run_id": r.run_id,
+                        "executor": r.executor,
+                        "node": r.node,
+                        "state": r.state,
+                        "leased": r.leased,
+                        "started": r.started,
+                        "finished": r.finished,
+                        "error": r.error,
+                    }
+                    for r in row.runs
+                ],
+            }
+        job = self.jobdb.get(job_id)
+        if job is None:
+            return None
+        return {
+            "job_id": job.id,
+            "queue": job.queue,
+            "jobset": job.jobset,
+            "state": job.state.value,
+            "priority": job.priority,
+            "priority_class": job.spec.priority_class,
+            "requests": dict(job.spec.requests),
+            "annotations": dict(job.spec.annotations),
+            "submitted": job.submitted,
+            "error": job.error,
+            "error_category": job.error_category,
+            "runs": [
+                {
+                    "run_id": r.id,
+                    "executor": r.executor,
+                    "node": r.node_id,
+                    "state": r.state.value,
+                    "leased": r.leased,
+                    "started": r.started,
+                    "finished": r.finished,
+                }
+                for r in job.runs
+            ],
+        }
 
     def get_job_spec(self, job_id: str):
         job = self.jobdb.get(job_id)

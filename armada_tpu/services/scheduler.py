@@ -63,6 +63,7 @@ class SchedulerService:
         self.ingester = SchedulerIngester(
             log, self.jobdb, error_rules=config.error_categories,
             settings_handler=self._apply_settings_event,
+            transition_observer=self._observe_transition,
         )
         self.backend = backend
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
@@ -86,6 +87,12 @@ class SchedulerService:
         self._orphan_sweep_done = False
         self._orphan_recheck_until = 0.0
         self.last_cycle_stats: dict = {}
+        # Rate-limit token buckets persisted across cycles (the reference's
+        # limiter carries over; MaximumSchedulingRate refills it). Keyed per
+        # pool for the global bucket; per (pool, queue) for queue buckets.
+        self._rate_tokens: dict[str, float] = {}
+        self._queue_rate_tokens: dict[tuple, float] = {}
+        self._rate_last_refill: dict[str, float] = {}
         from .reports import SchedulingReportsRepository
 
         self.reports = SchedulingReportsRepository()
@@ -101,6 +108,47 @@ class SchedulerService:
 
     def attach_metrics(self, metrics):
         self.metrics = metrics
+
+    def _observe_transition(self, txn, event):
+        """State-transition metrics with time-in-previous-state
+        (metrics/state_metrics.go): called before each event applies, so
+        the previous state's entry time is still on the record."""
+        m = self.metrics
+        if m is None or m.registry is None:
+            return
+        from ..events import (
+            JobErrors as _JE,
+            JobRunLeased as _JRL,
+            JobRunRunning as _JRR,
+            JobSucceeded as _JS,
+        )
+
+        name, transition, since = None, None, None
+        job = txn.get(getattr(event, "job_id", "")) if hasattr(event, "job_id") else None
+        if isinstance(event, _JRL):
+            name, transition = "leased", "queued_to_leased"
+            since = job.submitted if job else None
+        elif isinstance(event, _JRR):
+            name, transition = "running", "leased_to_running"
+            run = job.latest_run if job else None
+            since = run.leased if run else None
+        elif isinstance(event, _JS):
+            name, transition = "succeeded", "running_to_done"
+            run = job.latest_run if job else None
+            since = run.started if run else None
+        elif isinstance(event, _JE):
+            name, transition = "failed", "running_to_done"
+            run = job.latest_run if job else None
+            since = (run.started or run.leased) if run else None
+        if name is None:
+            return
+        m.job_state_transitions.labels(state=name).inc()
+        if job is not None:
+            m.queue_state_transitions.labels(queue=job.queue, state=name).inc()
+        if since and getattr(event, "created", 0) and event.created >= since:
+            m.state_seconds.labels(transition=transition).observe(
+                event.created - since
+            )
 
     # ---- control-plane inputs ----
 
@@ -221,6 +269,7 @@ class SchedulerService:
         sequences: list[EventSequence] = []
         sequences += self._expire_stale_executors(now)
         sequences += self._handle_failed_runs(now)
+        sequences += self._reconcile_runs(now)
 
         # Scheduling through the runner seam: sync solves inline; async
         # applies the previous solve's result first and only starts the next
@@ -397,6 +446,112 @@ class SchedulerService:
             )
         return sequences
 
+    def _reconcile_runs(self, now: float) -> list[EventSequence]:
+        """Run↔node reconciliation (scheduling/reconciliation.go, consumed
+        at scheduling_algo.go:293-398): leased runs whose reported node
+        vanished or changed pool are invalid. Preemptible invalid jobs are
+        preempted — gang-aware: the rest of the gang goes with them
+        (reconcilePoolJobs) — and non-preemptible ones are failed. Non-gang
+        jobs on deleted nodes are only logged, like the reference
+        (checkJobsOnDeletedNodes)."""
+        pools_on = {
+            p.name: p for p in self.config.pools if p.run_reconciliation
+        }
+        if not pools_on:
+            return []
+        node_pool: dict[str, str] = {}
+        for hb in self.executors.values():
+            for node in hb.nodes:
+                node_pool[node.id] = hb.pool
+        txn = self.jobdb.read_txn()
+        invalid: list[tuple] = []  # (job, reason)
+        for job in txn.leased_jobs():
+            run = job.latest_run
+            if run is None or run.pool not in pools_on:
+                continue
+            cfg = pools_on[run.pool]
+            is_gang = job.spec.gang is not None
+            if run.node_id not in node_pool:
+                if is_gang:
+                    invalid.append(
+                        (job, f"node {run.node_id} no longer exists")
+                    )
+                else:
+                    self.log_.with_fields(job=job.id).warning(
+                        "non-gang job on deleted node %s", run.node_id
+                    )
+                continue
+            allowed = {run.pool, *cfg.away_pools}
+            if node_pool[run.node_id] not in allowed:
+                invalid.append(
+                    (
+                        job,
+                        f"node {run.node_id} moved from pool {run.pool} "
+                        f"to {node_pool[run.node_id]}",
+                    )
+                )
+        if not invalid:
+            return []
+        sequences = []
+        handled: set[str] = set()
+        for job, reason in invalid:
+            if job.id in handled:
+                continue
+            handled.add(job.id)
+            preemptible = self.config.priority_class(
+                job.spec.priority_class
+            ).preemptible
+            run = job.latest_run
+            if preemptible:
+                events = [
+                    JobRunPreempted(
+                        created=now,
+                        job_id=job.id,
+                        run_id=run.id if run else "",
+                        reason=f"reconciliation: {reason}",
+                    )
+                ]
+                sequences.append(EventSequence.of(job.queue, job.jobset, *events))
+                # Gang-aware: preempt the remaining preemptible members.
+                if job.spec.gang is not None:
+                    for member in txn.gang_jobs(job.queue, job.spec.gang.id):
+                        if member.id in handled or member.state.terminal:
+                            continue
+                        if not self.config.priority_class(
+                            member.spec.priority_class
+                        ).preemptible:
+                            continue
+                        handled.add(member.id)
+                        mrun = member.latest_run
+                        sequences.append(
+                            EventSequence.of(
+                                member.queue,
+                                member.jobset,
+                                JobRunPreempted(
+                                    created=now,
+                                    job_id=member.id,
+                                    run_id=mrun.id if mrun else "",
+                                    reason=(
+                                        "reconciliation: other gang members"
+                                        f" invalid ({job.id})"
+                                    ),
+                                ),
+                            )
+                        )
+            else:
+                sequences.append(
+                    EventSequence.of(
+                        job.queue,
+                        job.jobset,
+                        JobErrors(
+                            created=now,
+                            job_id=job.id,
+                            error=f"reconciliation: {reason}",
+                        ),
+                    )
+                )
+        return sequences
+
     def _handle_failed_runs(self, now: float) -> list[EventSequence]:
         """Runs reported failed by executors: requeue the job (with the
         failed node recorded for anti-affinity) or fail it after max
@@ -405,13 +560,18 @@ class SchedulerService:
 
         sequences = []
         txn = self.jobdb.read_txn()
-        for job in txn.all_jobs():
-            if job.state.terminal or job.state == JobState.QUEUED:
-                continue
+        # Indexed: only jobs whose latest run failed and await the decision
+        # (no full-store walk; jobdb._failed_pending).
+        for job in txn.failed_run_jobs():
             run = job.latest_run
             if run is None or run.state != RunState.FAILED:
                 continue
-            if job.num_attempts >= self.config.max_retries + 1:
+            if not run.retryable:
+                # Fatal pod issue (podchecks Action.FAIL): no retry.
+                event = JobErrors(
+                    created=now, job_id=job.id, error=job.error or "fatal run error"
+                )
+            elif job.num_attempts >= self.config.max_retries + 1:
                 event = JobErrors(
                     created=now, job_id=job.id, error="max retries exceeded"
                 )
@@ -454,7 +614,9 @@ class SchedulerService:
                     leased_ts=run.leased,
                 )
             )
-        queued_jobs = [j for j in txn.queued_jobs() if j.id not in exclude]
+        # Unsorted: the snapshot builder re-derives fair-share order
+        # vectorized (np.lexsort), so the O(k log k) Python sort is skipped.
+        queued_jobs = [j for j in txn.queued_jobs(sort=False) if j.id not in exclude]
         queued = [j.spec.with_(priority=j.priority) for j in queued_jobs]
         # Retry anti-affinity: nodes where earlier attempts failed
         # (scheduler.go:589-636).
@@ -477,10 +639,12 @@ class SchedulerService:
         from ..core.resources import parse_quantity
 
         penalties: dict[str, dict] = {}
-        for job in txn.all_jobs():
+        # Indexed candidate set: terminal jobs finished inside the window
+        # (jobdb._finished_recent; entries past the window self-prune).
+        for job in txn.finished_since(now - window):
             # Any terminal state except preemption counts (the reference
             # penalizes failed/cancelled churn too, short_job_penalty.go).
-            if not job.state.terminal or job.state == JobState.PREEMPTED:
+            if job.state == JobState.PREEMPTED:
                 continue
             run = job.latest_run
             if run is None or run.pool != pool or not run.started:
@@ -515,6 +679,26 @@ class SchedulerService:
         ) = self._build_pool_inputs(pool, exclude, executors, overrides, skipped)
         if not nodes or not (queued or running):
             return []
+        limits = self.config.rate_limits
+        last = self._rate_last_refill.get(pool)
+        dt = max(0.0, now - last) if last is not None else 0.0
+        self._rate_last_refill[pool] = now
+        g_tokens = min(
+            self._rate_tokens.get(pool, float(limits.maximum_scheduling_burst))
+            + dt * limits.maximum_scheduling_rate,
+            float(limits.maximum_scheduling_burst),
+        )
+        q_tokens = {
+            q.name: min(
+                self._queue_rate_tokens.get(
+                    (pool, q.name),
+                    float(limits.maximum_per_queue_scheduling_burst),
+                )
+                + dt * limits.maximum_per_queue_scheduling_rate,
+                float(limits.maximum_per_queue_scheduling_burst),
+            )
+            for q in queues
+        }
         snap = build_round_snapshot(
             self.config,
             pool,
@@ -525,9 +709,40 @@ class SchedulerService:
             excluded_nodes=excluded_nodes,
             cordoned_queues=cordoned if cordoned is not None else self.cordoned_queues,
             short_job_penalty=self._short_job_penalties(txn, pool, now),
+            global_rate_tokens=g_tokens,
+            queue_rate_tokens=q_tokens,
         )
         solve_started = _time.time()
         result = self._solve(snap)
+        # Spend rate-limit tokens on newly scheduled jobs (ReserveN in the
+        # reference, gang_scheduler.go:118-123); rescheduled evictees are
+        # free (scheduled_mask covers new work only).
+        import numpy as np_
+
+        n_new = int(np_.asarray(result["scheduled_mask"]).sum())
+        self._rate_tokens[pool] = max(0.0, g_tokens - n_new)
+        by_queue: dict[str, int] = {}
+        for j in np_.flatnonzero(result["scheduled_mask"]):
+            qn = snap.queue_names[int(snap.job_queue[j])]
+            by_queue[qn] = by_queue.get(qn, 0) + 1
+        # Persist EVERY queue's refilled balance, not just spenders — an
+        # idle queue's bucket must recover toward its burst.
+        for qn, tokens in q_tokens.items():
+            self._queue_rate_tokens[(pool, qn)] = max(
+                0.0, tokens - by_queue.get(qn, 0)
+            )
+        if self.config.optimiser is not None and self.config.optimiser.enabled:
+            # Experimental fairness-improvement pass over the solved round
+            # (scheduling/optimiser/, preempting_queue_scheduler.go:659-702);
+            # mutates the result arrays with its extra decisions.
+            from ..solver.optimiser import optimise_round
+
+            decisions = optimise_round(snap, result, self.config.optimiser)
+            if decisions:
+                self.log_.with_fields(
+                    cycle=self.cycle_count, pool=pool, stage="optimiser",
+                    gangs=len(decisions),
+                ).info("optimiser placed %d gangs", len(decisions))
         self.last_cycle_stats = {
             "pool": pool,
             "jobs": snap.num_jobs,
@@ -684,4 +899,26 @@ class SchedulerService:
                     self.metrics.preempted_jobs.labels(pool=pool, queue=name).inc(
                         preempt_by_q[q]
                     )
+                    self.metrics.preempted_by_type.labels(
+                        pool=pool, type="round"
+                    ).inc(preempt_by_q[q])
+                # Demand by queue as dominant-share cost (cycle_metrics.go).
+                demand_cost = unweighted_cost(
+                    snap.queue_demand[q : q + 1].astype(float), total, mult
+                )
+                self.metrics.queue_demand.labels(pool=pool, queue=name).set(
+                    float(demand_cost[0])
+                )
             self.metrics.event_log_offset.set(self.log.end_offset)
+            self.metrics.ingester_lag.set(
+                max(0, self.log.end_offset - self.ingester.cursor)
+            )
+            if "num_loops" in result:
+                self.metrics.solve_loops.labels(pool=pool).set(
+                    int(result["num_loops"])
+                )
+            now_hb = _time.time()
+            for ex_name, hb in self.executors.items():
+                self.metrics.executor_heartbeat_age.labels(
+                    executor=ex_name
+                ).set(max(0.0, now_hb - hb.last_seen))

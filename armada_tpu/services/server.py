@@ -34,6 +34,7 @@ class ControlPlane:
         grpc_port: int = 0,
         metrics_port: int | None = None,
         lookout_port: int | None = None,
+        health_port: int | None = None,
         fake_executors: list[dict] | None = None,
         enable_submit_check: bool = False,
         data_dir: str | None = None,
@@ -71,6 +72,8 @@ class ControlPlane:
                         pool=spec.get("pool", "default"),
                         cpu=str(spec.get("cpu", "8")),
                         memory=str(spec.get("memory", "128Gi")),
+                        labels=spec.get("labels"),
+                        extra_resources=spec.get("extra_resources"),
                     ),
                     pool=spec.get("pool", "default"),
                     runtime_for=lambda job_id, rt=float(spec.get("runtime", 30.0)): rt,
@@ -92,15 +95,56 @@ class ControlPlane:
         self.metrics_server = (
             serve_metrics(self.metrics, metrics_port) if metrics_port else None
         )
+        # Independent lookout materialization (the reference's third
+        # ingester): its own cursor + rows, synced in the loop; the lookout
+        # UI queries it, never the scheduler's jobdb.
+        from .lookout_ingester import LookoutStore
+
+        self.lookout_store = LookoutStore(
+            self.log, error_rules=self.config.error_categories
+        )
         self.lookout = None
         if lookout_port is not None:
             from .lookout_http import LookoutHttpServer
 
             self.lookout = LookoutHttpServer(
-                self.query, self.scheduler, self.submit, lookout_port
+                QueryApi(lookout=self.lookout_store),
+                self.scheduler,
+                self.submit,
+                lookout_port,
+            )
+        # Health surface (common/health; schedulerapp.go:71-75).
+        from .health import (
+            FuncChecker,
+            HeartbeatChecker,
+            MultiChecker,
+            StartupCompleteChecker,
+            serve_health,
+        )
+
+        self.startup_checker = StartupCompleteChecker()
+        self.cycle_checker = HeartbeatChecker(
+            "cycle", timeout_s=max(30.0, 20 * cycle_period)
+        )
+        self.health = MultiChecker(
+            self.startup_checker,
+            self.cycle_checker,
+            FuncChecker(
+                "lookout-lag",
+                lambda: (
+                    self.lookout_store.lag_events < 100_000,
+                    f"lag {self.lookout_store.lag_events} events",
+                ),
+            ),
+        )
+        self.health_server = None
+        if health_port is not None:
+            self.health_server, self.health_port = serve_health(
+                self.health, self.startup_checker, health_port
             )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._cycle_n = 0
 
     def _loop(self):
         while not self._stop.is_set():
@@ -110,8 +154,17 @@ class ControlPlane:
                 ex.tick(now)
             try:
                 self.scheduler.cycle(now=now)
+                self.cycle_checker.beat()
             except Exception as e:  # keep the loop alive; next cycle retries
                 print(f"cycle error: {e!r}")
+            self.lookout_store.sync()
+            self._cycle_n += 1
+            if self._cycle_n % 600 == 0:
+                # The lookout pruner (internal/lookout/pruner): bound the
+                # materialization like the scheduler bounds its jobdb.
+                self.lookout_store.prune(
+                    _time.time() - self.config.terminal_job_retention_s
+                )
             if self.metrics.registry is not None:
                 self.metrics.cycle_time.observe(_time.time() - started)
             self._stop.wait(self.cycle_period)
@@ -119,6 +172,7 @@ class ControlPlane:
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        self.startup_checker.mark_complete()
         return self
 
     def stop(self):
@@ -130,6 +184,8 @@ class ControlPlane:
             self.metrics_server.shutdown()
         if self.lookout:
             self.lookout.stop()
+        if self.health_server:
+            self.health_server.shutdown()
         if hasattr(self.log, "close"):
             self.log.close()
 

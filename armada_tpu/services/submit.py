@@ -37,12 +37,15 @@ class SubmissionError(ValueError):
 
 @dataclass
 class Queue:
-    """Control-plane queue record (pkg/client queue API): permissions and
-    cordoning are modeled; auth enforcement lives in the transport."""
+    """Control-plane queue record (pkg/client queue API). Owners and
+    permission grants feed the transport's Authorizer
+    (services/auth.py; permissions.go + queue permission subjects)."""
 
     spec: QueueSpec
     cordoned: bool = False
     labels: dict = field(default_factory=dict)
+    owners: tuple = ()
+    permissions: tuple = ()  # of auth.QueuePermission
 
 
 class SubmitService:
@@ -61,8 +64,21 @@ class SubmitService:
         for entry in self.log.read(0, 10**9):
             for event in entry.sequence.events:
                 if isinstance(event, QueueUpsert):
+                    from .auth import QueuePermission
+
                     spec = QueueSpec(event.name, event.priority_factor)
-                    self.queues[event.name] = Queue(spec=spec, cordoned=event.cordoned)
+                    perms = tuple(
+                        QueuePermission(tuple(p["subjects"]), tuple(p["verbs"]))
+                        if isinstance(p, dict)
+                        else p
+                        for p in getattr(event, "permissions", ())
+                    )
+                    self.queues[event.name] = Queue(
+                        spec=spec,
+                        cordoned=event.cordoned,
+                        owners=tuple(getattr(event, "owners", ())),
+                        permissions=perms,
+                    )
                     if self.scheduler is not None:
                         self.scheduler.upsert_queue(spec, cordoned=event.cordoned)
                 elif isinstance(event, QueueDelete):
@@ -77,10 +93,19 @@ class SubmitService:
 
     # ---- queue CRUD (internal/server/queue) ----
 
-    def create_queue(self, spec: QueueSpec, cordoned: bool = False) -> Queue:
+    def create_queue(
+        self,
+        spec: QueueSpec,
+        cordoned: bool = False,
+        owners: tuple = (),
+        permissions: tuple = (),
+    ) -> Queue:
         if spec.name in self.queues:
             raise SubmissionError(f"queue {spec.name!r} already exists")
-        q = Queue(spec=spec, cordoned=cordoned)
+        q = Queue(
+            spec=spec, cordoned=cordoned, owners=tuple(owners),
+            permissions=tuple(permissions),
+        )
         self.queues[spec.name] = q
         self._publish_queue_event(
             QueueUpsert(
@@ -88,6 +113,13 @@ class SubmitService:
                 name=spec.name,
                 priority_factor=spec.priority_factor,
                 cordoned=cordoned,
+                owners=tuple(owners),
+                permissions=tuple(
+                    {"subjects": list(p.subjects), "verbs": list(p.verbs)}
+                    if not isinstance(p, dict)
+                    else p
+                    for p in permissions
+                ),
             )
         )
         if self.scheduler is not None:
@@ -139,6 +171,7 @@ class SubmitService:
         if queue not in self.queues:
             raise SubmissionError(f"queue {queue!r} does not exist")
         now = _time.time() if now is None else now
+        self._validate_gangs(jobs)
         events = []
         job_ids = []
         for job in jobs:
@@ -192,6 +225,37 @@ class SubmitService:
             if job.gang.cardinality < 1:
                 raise SubmissionError(f"job {job.id}: gang cardinality < 1")
         return job
+
+    def _validate_gangs(self, jobs: list[JobSpec]):
+        """Gang member agreement (internal/scheduler/gang_validator.go):
+        every member of a gang submitted together must declare the same
+        cardinality, node-uniformity label and priority class; a batch
+        must not carry more members than the declared cardinality."""
+        by_gang: dict[str, list[JobSpec]] = {}
+        for job in jobs:
+            if job.gang is not None and job.gang.id:
+                by_gang.setdefault(job.gang.id, []).append(job)
+        for gid, members in by_gang.items():
+            first = members[0]
+            for m in members[1:]:
+                if m.gang.cardinality != first.gang.cardinality:
+                    raise SubmissionError(
+                        f"gang {gid}: members disagree on cardinality "
+                        f"({m.gang.cardinality} vs {first.gang.cardinality})"
+                    )
+                if m.gang.node_uniformity_label != first.gang.node_uniformity_label:
+                    raise SubmissionError(
+                        f"gang {gid}: members disagree on node uniformity label"
+                    )
+                if (m.priority_class or "") != (first.priority_class or ""):
+                    raise SubmissionError(
+                        f"gang {gid}: members disagree on priority class"
+                    )
+            if len(members) > first.gang.cardinality:
+                raise SubmissionError(
+                    f"gang {gid}: {len(members)} members exceed declared "
+                    f"cardinality {first.gang.cardinality}"
+                )
 
     # ---- cancel / reprioritise ----
 

@@ -121,6 +121,12 @@ class RoundSnapshot:
     taint_vocab: TaintVocab
     label_vocab: LabelVocab
 
+    # --- rate-limit token state (scheduler.go carries the limiter across
+    # cycles; the service refills these buckets and passes them in; None =
+    # full burst, the single-round default) ---
+    global_rate_tokens: float | None
+    queue_rate_tokens: dict | None  # {queue name: tokens}
+
     # --- totals ---
     total_resources: np.ndarray  # int64[R] node sums + floating pool totals
     # Pool-level floating resources (docs/floating_resources.md): capped
@@ -181,6 +187,8 @@ def build_round_snapshot(
     excluded_nodes: dict | None = None,
     cordoned_queues: set | None = None,
     short_job_penalty: dict | None = None,
+    global_rate_tokens: float | None = None,
+    queue_rate_tokens: dict | None = None,
 ) -> RoundSnapshot:
     """excluded_nodes: {job_id: [node_id, ...]} — nodes earlier attempts
     failed on; those nodes are infeasible for the retry. cordoned_queues:
@@ -573,6 +581,8 @@ def build_round_snapshot(
         pc_away_tol=pc_away_tol,
         taint_vocab=taint_vocab,
         label_vocab=label_vocab,
+        global_rate_tokens=global_rate_tokens,
+        queue_rate_tokens=queue_rate_tokens,
         total_resources=np.where(
             floating_mask, floating_total, node_total.sum(axis=0)
         ),

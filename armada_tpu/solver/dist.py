@@ -29,7 +29,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..ops.select import lex_argmin
+from ..ops.select import lex_argmin, _sentinel
+
+
+def _fill_sort(keys, mask, B):
+    """Indices of the B lexicographically-smallest masked entries (sorted).
+    Masked-out entries sort last (sentinel keys)."""
+    mk = [jnp.where(mask, k, _sentinel(k.dtype)) for k in keys]
+    # jnp.lexsort: LAST key is primary -> reverse (ours is first-primary).
+    order = jnp.lexsort(tuple(reversed(mk)))
+    return order[:B], mk
 
 
 class LocalDist:
@@ -78,6 +87,13 @@ class LocalDist:
         return jax.ops.segment_sum(
             contrib, jnp.clip(nodes, 0, ln - 1), num_segments=ln
         )
+
+    def fill_candidates(self, keys, mask, caps, gids, B):
+        """The globally best (lex-smallest-key) <=B candidate nodes, in fill
+        order: (caps[B'], gids[B']) with caps 0 for masked-out entries. A
+        batch of <=B jobs needs at most B nodes, so B candidates suffice."""
+        take, _ = _fill_sort(keys, mask, B)
+        return jnp.where(mask[take], caps[take], 0), gids[take]
 
 
 LOCAL = LocalDist()
@@ -151,3 +167,19 @@ class ShardDist:
             local,
             num_segments=ln,
         )
+
+    def fill_candidates(self, keys, mask, caps, gids, B):
+        """Per-shard top-B by local sort, then an all_gather of the K*B
+        shard winners and a small merge sort — the fill analogue of the
+        per-select argmin reduction. Results are shard-invariant."""
+        take, mk = _fill_sort(keys, mask, B)
+        lkeys = [k[take] for k in mk]
+        lcaps = jnp.where(mask[take], caps[take], 0)
+        lgids = gids[take]
+        gkeys = [
+            jax.lax.all_gather(k, self.axis).reshape(-1) for k in lkeys
+        ]
+        gcaps = jax.lax.all_gather(lcaps, self.axis).reshape(-1)
+        ggids = jax.lax.all_gather(lgids, self.axis).reshape(-1)
+        order = jnp.lexsort(tuple(reversed(gkeys)))[:B]
+        return gcaps[order], ggids[order]

@@ -388,14 +388,13 @@ def _bind(dev, dist, carry: Carry, j, n, at_prio) -> Carry:
     )
 
 
-def _gang_attempt(dev, dist, carry: Carry, s, all_ev, fp_order):
-    """GangScheduler.Schedule + ScheduleManyWithTxn. Returns
-    (carry, status_code)."""
+def _constraint_code(dev, carry, s, all_ev):
+    """Round/queue/rate-limit gates for one gang attempt
+    (gang_scheduler.go:100-145). Returns an OK/FAIL* code."""
     q = dev.slot_queue[s]
     card = dev.slot_count[s].astype(jnp.result_type(float))
     pc = dev.job_pc[dev.slot_members[s, 0]]
 
-    # Constraints for non-evicted gangs (gang_scheduler.go:100-145).
     over_round = jnp.any(carry.scheduled_new > dev.max_round_resources)
     no_tokens = carry.tokens < 1
     gang_too_big = dev.global_burst < card
@@ -403,7 +402,12 @@ def _gang_attempt(dev, dist, carry: Carry, s, all_ev, fp_order):
     qno_tokens = carry.qtokens[q] < 1
     qgang_too_big = dev.queue_burst < card
     qtokens_short = carry.qtokens[q] < card
-    pc_over = jnp.any(carry.qpc_alloc[q, pc] > dev.queue_pc_limit[q, pc])
+    # Per-PC cap is would-exceed: CheckJobConstraints runs after
+    # AddGangSchedulingContext, so the compared allocation includes the
+    # candidate gang (gang_scheduler.go:132-140, constraints.go:121-135).
+    pc_over = jnp.any(
+        carry.qpc_alloc[q, pc] + _f(dev.slot_req[s]) > dev.queue_pc_limit[q, pc]
+    )
     cordoned = dev.queue_cordoned[q]
 
     blocked_code = jnp.where(
@@ -430,9 +434,17 @@ def _gang_attempt(dev, dist, carry: Carry, s, all_ev, fp_order):
         dev.floating_mask
         & (carry.floating + _f(dev.slot_req[s]) > dev.floating_total)
     )
-    blocked_code = jnp.where(
-        (blocked_code == OK) & floating_over, FAIL, blocked_code
-    )
+    return jnp.where((blocked_code == OK) & floating_over, FAIL, blocked_code)
+
+
+def _gang_attempt(dev, dist, carry: Carry, s, all_ev, fp_order):
+    """GangScheduler.Schedule + ScheduleManyWithTxn. Returns
+    (carry, status_code)."""
+    q = dev.slot_queue[s]
+    card = dev.slot_count[s].astype(jnp.result_type(float))
+    pc = dev.job_pc[dev.slot_members[s, 0]]
+
+    blocked_code = _constraint_code(dev, carry, s, all_ev)
 
     # Member-by-member placement; extra_sel constrains members to one
     # uniformity-label value during the search.
@@ -659,10 +671,22 @@ def _schedule_pass(
     passes) or behind the pointers already (consumed slots)."""
     Q = dev.queue_slot_start.shape[0]
     S = dev.slot_members.shape[0]
+    # Fill fast path is statically compiled in only for the queued pass of a
+    # non-market round (pass 2 and market ordering stay fully serial).
+    fill_enabled = (
+        dev.batch_window > 0
+        and include_queued
+        and not dev.market_driven
+        and not consider_priority
+    )
+    fast_fill_enabled = fill_enabled and dev.fast_fill
 
     def cond(state):
-        c, ptr = state
-        return ~c.stop & (c.loops < S + 2)
+        c, ptr, _ = state
+        # Every iteration either consumes >=1 slot, flips a validity flag,
+        # or arms force-serial for the next one: 2S+4 bounds the loop even
+        # with fill-miss/serial-retry pairs.
+        return ~c.stop & (c.loops < 2 * S + 4)
 
     # all-evicted flags are stable within a pass: evictions happen between
     # passes, and a rescheduled member's slot is the one being consumed.
@@ -692,8 +716,321 @@ def _schedule_pass(
         heads, has = _queue_heads(dev, valid)
         return jnp.where(has, heads, dev.queue_slot_end)
 
+    def fill_apply(c, qstar, sstar, kmax):
+        """Place up to kmax jobs from the identical-singleton run headed at
+        sstar onto row-0-feasible nodes in best-fit order (the f0 chain,
+        nodedb.go:713-752). Placement parity: a node that wins the best-fit
+        argmin keeps winning until the job no longer fits on it (binding
+        only lowers its key), so identical jobs fill nodes to capacity in
+        best-fit order. Returns (carry, placed); kmax==0 or no capacity
+        leaves the carry bit-identical (scatters drop, deltas zero)."""
+        B = dev.batch_window
+        fdt = jnp.result_type(float)
+        j = jnp.clip(dev.slot_members[sstar, 0], 0, dev.job_req.shape[0] - 1)
+        prio = c.job_prio[j]
+        pc = dev.job_pc[j]
+        preemptible = dev.job_preemptible[j]
+        req_fit = dev.job_req_fit[j]
+        req_full = _f(dev.job_req[j])
+
+        static_ok = _static_ok(dev, j, jnp.zeros_like(dev.uni_value_bits[0]))
+        alloc0 = c.alloc[0]
+        fit0 = static_ok & jnp.all(req_fit <= alloc0, axis=-1)
+        safe_req = jnp.maximum(req_fit, 1)
+        caps = jnp.min(
+            jnp.where(req_fit[None, :] > 0, alloc0 // safe_req[None, :], BIG),
+            axis=-1,
+        )
+        caps = jnp.clip(caps, 0, B).astype(jnp.int32)
+        nkeys = []
+        for k in range(dev.order_res_idx.shape[0]):
+            ri = dev.order_res_idx[k]
+            res = dev.order_res_resolution[k]
+            nkeys.append(alloc0[:, ri] // res)
+        nkeys.append(dev.node_id_rank)
+        cand_caps, cand_gids = dist.fill_candidates(
+            nkeys, fit0, caps, dev.node_gid, B
+        )
+        prefix = jnp.cumsum(cand_caps)
+        total_cap = prefix[-1]
+
+        kstar = jnp.minimum(
+            jnp.minimum(kmax.astype(jnp.int32), total_cap),
+            dev.slot_run_len[sstar],
+        )
+        kstar = jnp.clip(kstar, 0, B)
+
+        cnt = jnp.clip(kstar - (prefix - cand_caps), 0, cand_caps)
+        ln = c.alloc.shape[1]
+        delta = dist.segment_to_nodes(
+            (cnt[:, None] * req_fit[None, :]).astype(c.alloc.dtype),
+            cand_gids,
+            ln,
+        )
+        rows = jnp.where(
+            preemptible,
+            dev.priorities <= prio,
+            jnp.ones_like(dev.priorities, bool),
+        )
+        alloc = c.alloc - jnp.where(rows[:, None, None], delta[None, :, :], 0)
+
+        ivec = jnp.arange(B, dtype=jnp.int32)
+        widx = sstar + ivec
+        wjobs = dev.slot_members[jnp.clip(widx, 0, S - 1), 0]
+        valid_w = ivec < kstar
+        pos = jnp.searchsorted(prefix, ivec, side="right")
+        node_w = cand_gids[jnp.clip(pos, 0, cand_gids.shape[0] - 1)]
+        jdrop = jnp.where(valid_w, wjobs, dev.job_req.shape[0])
+        sdrop = jnp.where(valid_w, widx, S)
+        k_f = kstar.astype(fdt)
+        c2 = c._replace(
+            alloc=alloc,
+            qalloc=c.qalloc.at[qstar].add(k_f * req_full),
+            qpc_alloc=c.qpc_alloc.at[qstar, pc].add(k_f * req_full),
+            job_node=c.job_node.at[jdrop].set(node_w, mode="drop"),
+            job_prio=c.job_prio.at[jdrop].set(prio, mode="drop"),
+            job_scheduled=c.job_scheduled.at[jdrop].set(True, mode="drop"),
+            slot_state=c.slot_state.at[sdrop].set(jnp.int8(DONE), mode="drop"),
+            tokens=c.tokens - k_f,
+            qtokens=c.qtokens.at[qstar].add(-k_f),
+            scheduled_new=c.scheduled_new + k_f * req_full,
+            floating=c.floating
+            + jnp.where(dev.floating_mask, k_f * req_full, 0.0),
+        )
+        return c2, kstar
+
+    def fill_step(c, ptr, qstar, sstar, qkeys, has_head):
+        """Exact single-queue batched fill: stop exactly where the serial
+        loop would have switched queues or hit a constraint gate. The
+        queue's PQ key after i placements is a closed form of i, so the
+        crossover vs the (static) runner-up key is computed vectorized;
+        every gate is monotone in i, so the combined stop point is the min
+        of the individual ones. Returns (carry, ptr, applied);
+        applied=False arms the force-serial handshake."""
+        B = dev.batch_window
+        fdt = jnp.result_type(float)
+        j = jnp.clip(dev.slot_members[sstar, 0], 0, dev.job_req.shape[0] - 1)
+        pc = dev.job_pc[j]
+        req_full = _f(dev.job_req[j])
+
+        # Runner-up queue's key tuple — static during the fill (no other
+        # queue's head or allocation changes while this queue wins).
+        mask2 = has_head & (jnp.arange(Q) != qstar)
+        q2, found2 = lex_argmin(qkeys, mask2)
+        rup = [k[q2] for k in qkeys]
+
+        ivec = jnp.arange(B, dtype=jnp.int32)
+        i_f = ivec.astype(fdt)
+        qa_i = (
+            (c.qalloc[qstar] + _f(dev.queue_short_penalty[qstar]))[None, :]
+            + i_f[:, None] * req_full[None, :]
+        )
+        w_q = jnp.maximum(dev.queue_weight[qstar], 1e-12)
+        cur_i = _drf_cost(qa_i, dev.total_resources, dev.drf_multipliers) / w_q
+        prop_i = (
+            _drf_cost(
+                qa_i + req_full[None, :], dev.total_resources, dev.drf_multipliers
+            )
+            / w_q
+        )
+        my_keys = []
+        if prefer_large:
+            size = (
+                _drf_cost(req_full, dev.total_resources, dev.drf_multipliers)
+                * dev.queue_weight[qstar]
+            )
+            over_i = (prop_i > budgets[qstar]).astype(jnp.int32)
+            my_keys += [
+                over_i,
+                jnp.where(over_i == 1, prop_i, cur_i),
+                jnp.where(over_i == 1, 0.0, -size),
+            ]
+        else:
+            my_keys.append(prop_i)
+        my_keys.append(
+            jnp.full(B, dev.queue_name_rank[qstar], dtype=jnp.int32)
+        )
+        win = jnp.zeros(B, bool)
+        gt = jnp.zeros(B, bool)
+        for a, b in zip(my_keys, rup):
+            win = win | (~gt & (a < b))
+            gt = gt | (a > b)
+        win = win | ~found2
+
+        # Constraint gates per step (the serial loop evaluates these before
+        # each attempt, _constraint_code): i = number already placed.
+        tok_ok = (c.tokens - i_f) >= 1
+        qtok_ok = (c.qtokens[qstar] - i_f) >= 1
+        round_ok = ~jnp.any(
+            c.scheduled_new[None, :] + i_f[:, None] * req_full[None, :]
+            > dev.max_round_resources[None, :],
+            axis=-1,
+        )
+        pc_ok = ~jnp.any(
+            c.qpc_alloc[qstar, pc][None, :]
+            + (i_f + 1.0)[:, None] * req_full[None, :]
+            > dev.queue_pc_limit[qstar, pc][None, :],
+            axis=-1,
+        )
+        float_ok = ~jnp.any(
+            dev.floating_mask[None, :]
+            & (
+                c.floating[None, :] + (i_f + 1.0)[:, None] * req_full[None, :]
+                > dev.floating_total[None, :]
+            ),
+            axis=-1,
+        )
+        allowed = win & tok_ok & qtok_ok & round_ok & pc_ok & float_ok
+        kmax = jnp.sum(jnp.cumprod(allowed.astype(jnp.int32))).astype(jnp.int32)
+
+        c2, placed = fill_apply(c, qstar, sstar, kmax)
+        applied = placed >= 1
+        ptr2 = jnp.where(applied, ptr.at[qstar].set(sstar + placed), ptr)
+        ptr2 = jax.lax.cond(
+            applied, lambda: advance(c2, ptr2, qstar), lambda: ptr2
+        )
+        return c2, ptr2, applied
+
+    def merged_fill_step(c, ptr, heads, has_head, qkeys, all_ev_h, eligible):
+        """Fast-mode multi-queue fill: ONE iteration batches the whole
+        multi-queue sweep. Each eligible queue's candidate-cost sequence is
+        a closed form of its own count, so the exact serial attempt order
+        across queues is a SORT of all (queue, i) entry keys, cut at the
+        first ineligible head's key (the barrier — that attempt needs the
+        serial path, and nothing after it may be batched). Global gates
+        (tokens, round caps, floating) cut the merged suffix; per-queue
+        gates cut only that queue's entries, exactly as the serial loop's
+        FAIL handling skips one queue without stopping others. Placement is
+        then greedy per queue (set-exact vs serial whenever everything fits
+        at row 0; node assignment may differ from the reference trace).
+        Returns (carry, ptr, progressed)."""
+        W = dev.batch_window
+        fdt = jnp.result_type(float)
+        J = dev.job_req.shape[0]
+        j_h = jnp.clip(dev.slot_members[heads, 0], 0, J - 1)
+        run_h = dev.slot_run_len[heads]
+        pc_h = dev.job_pc[j_h]
+        req_q = _f(dev.slot_req[heads])  # [Q, R]; identical within a run
+
+        # Barrier: the best ineligible head's key; batched entries must be
+        # strictly lex-below it (ranks are unique, so strict < suffices).
+        bmask = has_head & ~eligible
+        qb, has_barrier = lex_argmin(qkeys, bmask)
+        bk = [k[qb] for k in qkeys]
+
+        ivec = jnp.arange(W, dtype=jnp.int32)
+        i_f = ivec.astype(fdt)
+        qa = c.qalloc + _f(dev.queue_short_penalty)  # [Q, R]
+        w = jnp.maximum(dev.queue_weight, 1e-12)
+        qa_i = qa[:, None, :] + i_f[None, :, None] * req_q[:, None, :]
+        cur = (
+            _drf_cost(qa_i, dev.total_resources, dev.drf_multipliers)
+            / w[:, None]
+        )
+        prop = (
+            _drf_cost(
+                qa_i + req_q[:, None, :],
+                dev.total_resources,
+                dev.drf_multipliers,
+            )
+            / w[:, None]
+        )
+        ekeys = []
+        if prefer_large:
+            size = (
+                _drf_cost(req_q, dev.total_resources, dev.drf_multipliers)
+                * dev.queue_weight
+            )  # [Q]
+            over = (prop > budgets[:, None]).astype(jnp.int32)
+            ekeys += [
+                over,
+                jnp.where(over == 1, prop, cur),
+                jnp.where(over == 1, 0.0, -size[:, None]),
+            ]
+        else:
+            ekeys.append(prop)
+        rank2d = jnp.broadcast_to(dev.queue_name_rank[:, None], (Q, W))
+        ekeys.append(rank2d)
+
+        # Entry validity: per-queue prefix gates (qtokens, per-PC caps, run
+        # length) and the barrier.
+        qtok_ok = (c.qtokens[:, None] - i_f[None, :]) >= 1
+        qpc = c.qpc_alloc[jnp.arange(Q), pc_h]  # [Q, R]
+        pc_lim = dev.queue_pc_limit[jnp.arange(Q), pc_h]  # [Q, R]
+        pc_ok = ~jnp.any(
+            qpc[:, None, :] + (i_f + 1.0)[None, :, None] * req_q[:, None, :]
+            > pc_lim[:, None, :],
+            axis=-1,
+        )
+        run_ok = ivec[None, :] < run_h[:, None]
+        below = jnp.zeros((Q, W), bool)
+        gt = jnp.zeros((Q, W), bool)
+        for a, b in zip(ekeys, bk):
+            below = below | (~gt & (a < b))
+            gt = gt | (a > b)
+        barrier_ok = below | ~has_barrier
+        entry_ok = eligible[:, None] & qtok_ok & pc_ok & run_ok & barrier_ok
+        entry_ok = jnp.cumprod(entry_ok.astype(jnp.int8), axis=1).astype(bool)
+
+        # Merged order: sort all entries by key; stable + the i tiebreak
+        # keeps same-queue equal-cost entries in stream order.
+        flat_keys = [k.reshape(-1) for k in ekeys] + [
+            jnp.broadcast_to(ivec[None, :], (Q, W)).reshape(-1)
+        ]
+        order = jnp.lexsort(tuple(reversed(flat_keys)))
+        take = entry_ok.reshape(-1)[order]
+        qidx = (jnp.arange(Q * W, dtype=jnp.int32) // W)[order]
+        req_s = req_q[qidx]  # [QW, R]
+        req_taken = jnp.where(take[:, None], req_s, 0.0)
+        cum_cnt_b = jnp.cumsum(take.astype(jnp.int32)) - take.astype(jnp.int32)
+        cum_req = jnp.cumsum(req_taken, axis=0)
+        cum_req_b = cum_req - req_taken
+        tok_ok_g = (c.tokens - cum_cnt_b.astype(fdt)) >= 1
+        round_ok_g = ~jnp.any(
+            c.scheduled_new[None, :] + cum_req_b > dev.max_round_resources[None, :],
+            axis=-1,
+        )
+        float_ok_g = ~jnp.any(
+            dev.floating_mask[None, :]
+            & (c.floating[None, :] + cum_req > dev.floating_total[None, :]),
+            axis=-1,
+        )
+        viol = take & ~(tok_ok_g & round_ok_g & float_ok_g)
+        any_viol = jnp.any(viol)
+        first_viol = jnp.argmax(viol)
+        posn = jnp.arange(Q * W)
+        final_take = take & (~any_viol | (posn < first_viol))
+        k_q = jax.ops.segment_sum(
+            final_take.astype(jnp.int32), qidx, num_segments=Q
+        )
+
+        # Sequential per-queue placement (deterministic queue order); each
+        # queue's fill sees the capacity the previous queues consumed.
+        def apply_q(q, state):
+            c, ptr, progressed = state
+
+            def do(args):
+                c, ptr, progressed = args
+                c2, placed = fill_apply(c, q, heads[q], k_q[q])
+                ptr2 = jnp.where(
+                    placed > 0, ptr.at[q].set(heads[q] + placed), ptr
+                )
+                ptr2 = jax.lax.cond(
+                    placed > 0, lambda: advance(c2, ptr2, q), lambda: ptr2
+                )
+                return c2, ptr2, progressed | (placed > 0)
+
+            return jax.lax.cond(
+                k_q[q] > 0, do, lambda a: a, (c, ptr, progressed)
+            )
+
+        c, ptr, progressed = jax.lax.fori_loop(
+            0, Q, apply_q, (c, ptr, jnp.zeros((), bool))
+        )
+        return c, ptr, progressed
+
     def body(state):
-        c, ptr = state
+        c, ptr, force_serial = state
         has_head = ptr < dev.queue_slot_end
         heads = jnp.clip(ptr, 0, S - 1)
 
@@ -731,65 +1068,118 @@ def _schedule_pass(
         qstar, any_head = lex_argmin(keys, has_head)
         sstar = heads[qstar]
 
-        def attempt(c):
-            c2, status = _gang_attempt(
-                dev, dist, c, sstar, all_ev_flags[sstar], fp_order
+        def serial_step(c, ptr):
+            def attempt(c):
+                c2, status = _gang_attempt(
+                    dev, dist, c, sstar, all_ev_flags[sstar], fp_order
+                )
+                # Terminal handling (queue_scheduler.go:176-190).
+                c2 = c2._replace(
+                    only_ev_global=c2.only_ev_global | (status == FAIL_TERMINAL),
+                    only_ev_queue=c2.only_ev_queue.at[dev.slot_queue[sstar]].set(
+                        c2.only_ev_queue[dev.slot_queue[sstar]]
+                        | (status == FAIL_QUEUE_TERMINAL)
+                    ),
+                )
+                # Register unfeasible keys: single-member, non-evicted slots
+                # with gang-property failures (gang_scheduler.go:80-95).
+                kg = dev.slot_key_group[sstar]
+                register = (
+                    (status == FAIL_GANG_PROPERTY)
+                    & (dev.slot_count[sstar] == 1)
+                    & (kg >= 0)
+                    & ~all_ev_flags[sstar]
+                )
+                safe_kg = jnp.clip(kg, 0, c2.unfeasible.shape[0] - 1)
+                c2 = c2._replace(
+                    unfeasible=c2.unfeasible.at[safe_kg].set(
+                        c2.unfeasible[safe_kg] | register
+                    )
+                )
+                return c2
+
+            flags_before = (c.only_ev_global, c.only_ev_queue, c.unfeasible)
+            c = jax.lax.cond(any_head, attempt, lambda c: c._replace(stop=True), c)
+
+            flags_changed = (
+                (c.only_ev_global != flags_before[0])
+                | jnp.any(c.only_ev_queue != flags_before[1])
+                | jnp.any(c.unfeasible != flags_before[2])
             )
-            # Terminal handling (queue_scheduler.go:176-190).
-            c2 = c2._replace(
-                only_ev_global=c2.only_ev_global | (status == FAIL_TERMINAL),
-                only_ev_queue=c2.only_ev_queue.at[dev.slot_queue[sstar]].set(
-                    c2.only_ev_queue[dev.slot_queue[sstar]]
-                    | (status == FAIL_QUEUE_TERMINAL)
+            # Consume the winning slot and advance its queue's pointer to the
+            # next valid slot; a flag flip can invalidate OTHER queues' heads,
+            # so it triggers the full O(S) recompute instead.
+            ptr = jnp.where(any_head, ptr.at[qstar].set(sstar + 1), ptr)
+            ptr = jax.lax.cond(
+                flags_changed,
+                lambda: ptrs_from_scratch(c),
+                lambda: jax.lax.cond(
+                    any_head,
+                    lambda: advance(c, ptr, qstar),
+                    lambda: ptr,
                 ),
             )
-            # Register unfeasible keys: single-member, non-evicted slots with
-            # gang-property failures (gang_scheduler.go:80-95).
-            kg = dev.slot_key_group[sstar]
-            register = (
-                (status == FAIL_GANG_PROPERTY)
-                & (dev.slot_count[sstar] == 1)
-                & (kg >= 0)
-                & ~all_ev_flags[sstar]
+            return c, ptr
+
+        if fast_fill_enabled:
+            all_ev_h = all_ev_flags[heads]
+            code_h = jax.vmap(
+                lambda s: _constraint_code(dev, c, s, jnp.zeros((), bool))
+            )(heads)
+            eligible = (
+                has_head
+                & (dev.slot_run_len[heads] > 0)
+                & ~all_ev_h
+                & (code_h == OK)
             )
-            safe_kg = jnp.clip(kg, 0, c2.unfeasible.shape[0] - 1)
-            c2 = c2._replace(
-                unfeasible=c2.unfeasible.at[safe_kg].set(
-                    c2.unfeasible[safe_kg] | register
+            do_merge = jnp.any(eligible) & ~force_serial
+
+            def merged_branch(args):
+                c, ptr = args
+                c2, ptr2, progressed = merged_fill_step(
+                    c, ptr, heads, has_head, keys, all_ev_h, eligible
                 )
+                return c2, ptr2, ~progressed
+
+            def serial_branch(args):
+                c2, ptr2 = serial_step(*args)
+                return c2, ptr2, jnp.zeros((), bool)
+
+            c, ptr, fs = jax.lax.cond(
+                do_merge, merged_branch, serial_branch, (c, ptr)
             )
-            return c2
+        elif fill_enabled:
+            do_fill = (
+                any_head
+                & ~force_serial
+                & (dev.slot_run_len[sstar] > 0)
+                & ~all_ev_flags[sstar]
+                & (_constraint_code(dev, c, sstar, jnp.zeros((), bool)) == OK)
+            )
 
-        flags_before = (c.only_ev_global, c.only_ev_queue, c.unfeasible)
-        c = jax.lax.cond(any_head, attempt, lambda c: c._replace(stop=True), c)
+            def fill_branch(args):
+                c, ptr = args
+                c2, ptr2, applied = fill_step(c, ptr, qstar, sstar, keys, has_head)
+                return c2, ptr2, ~applied
 
-        flags_changed = (
-            (c.only_ev_global != flags_before[0])
-            | jnp.any(c.only_ev_queue != flags_before[1])
-            | jnp.any(c.unfeasible != flags_before[2])
-        )
-        # Consume the winning slot and advance its queue's pointer to the
-        # next valid slot; a flag flip can invalidate OTHER queues' heads,
-        # so it triggers the full O(S) recompute instead.
-        ptr = jnp.where(any_head, ptr.at[qstar].set(sstar + 1), ptr)
-        ptr = jax.lax.cond(
-            flags_changed,
-            lambda: ptrs_from_scratch(c),
-            lambda: jax.lax.cond(
-                any_head,
-                lambda: advance(c, ptr, qstar),
-                lambda: ptr,
-            ),
-        )
-        return c._replace(loops=c.loops + 1), ptr
+            def serial_branch(args):
+                c2, ptr2 = serial_step(*args)
+                return c2, ptr2, jnp.zeros((), bool)
 
-    # Each iteration consumes one slot (or stops), so S+2 bounds the loop;
-    # the counter restarts per pass (the reference's loopNumber is also
+            c, ptr, fs = jax.lax.cond(do_fill, fill_branch, serial_branch, (c, ptr))
+        else:
+            c, ptr = serial_step(c, ptr)
+            fs = jnp.zeros((), bool)
+        return c._replace(loops=c.loops + 1), ptr, fs
+
+    # The counter restarts per pass (the reference's loopNumber is also
     # per-QueueScheduler, queue_scheduler.go:99).
     heads0, has0 = _queue_heads(dev, valid0)
     ptr0 = jnp.where(has0, heads0, dev.queue_slot_end)
     carry = carry._replace(stop=jnp.zeros((), bool), loops=jnp.zeros((), jnp.int32))
-    carry, _ = jax.lax.while_loop(cond, body, (carry, ptr0))
+    carry, _, _ = jax.lax.while_loop(
+        cond, body, (carry, ptr0, jnp.zeros((), bool))
+    )
     return carry
 
 

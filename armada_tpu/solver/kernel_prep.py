@@ -39,6 +39,8 @@ _META_FIELDS = (
     "num_key_groups",
     "market_driven",
     "has_away",
+    "batch_window",
+    "fast_fill",
 )
 
 
@@ -90,6 +92,10 @@ class DeviceRound:
     slot_req: np.ndarray  # int32[S, R]
     slot_key_group: np.ndarray  # int32[S] (-1 if N/A)
     slot_jobs_before: np.ndarray  # int32[S] queued jobs before this slot in its queue
+    # Batched-fill runs: for each slot, the number of consecutive slots
+    # (including itself) holding identical batchable singleton gangs — same
+    # queue + scheduling key, no per-job anti-affinity. 0 = not batchable.
+    slot_run_len: np.ndarray  # int32[S]
     # Gang node-uniformity search (gang_scheduler.go:150-224): per slot a
     # range [start, end) into the uniformity-value table; start==end means
     # no uniformity constraint. Each value is a selector bitset.
@@ -135,6 +141,8 @@ class DeviceRound:
     num_key_groups: int
     market_driven: bool
     has_away: bool
+    batch_window: int
+    fast_fill: bool
     spot_price_cutoff: np.ndarray  # float scalar
     job_bid: np.ndarray  # float64[J]
 
@@ -222,6 +230,7 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         slot_req=pad(dev.slot_req, 0, Sp),
         slot_key_group=pad(dev.slot_key_group, 0, Sp, fill=-1),
         slot_jobs_before=pad(dev.slot_jobs_before, 0, Sp),
+        slot_run_len=pad(dev.slot_run_len, 0, Sp),
         slot_uni_start=pad(dev.slot_uni_start, 0, Sp),
         slot_uni_end=pad(dev.slot_uni_end, 0, Sp),
         slot_price=pad(dev.slot_price, 0, Sp),
@@ -293,32 +302,29 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     # ---- slots ----
     # Segment 0: running gangs (eviction candidates), grouped by gang id.
     # Segment 1: queued gangs from the snapshot gang table (complete only).
-    # Built as flat candidate arrays: queue, segment, order, member-range.
-    cand_queue: list = []
-    cand_segment: list = []
-    cand_order: list = []
-    cand_running: list = []
-    cand_kg: list = []
-    cand_uni: list = []
-    cand_member_lists: list = []
+    # Built columnar: the overwhelming bulk (singleton candidates) is pure
+    # array work; only multi-member gangs take per-gang Python paths, so a
+    # 1M-singleton round preps in vectorized time.
+    rj = np.flatnonzero(snap.job_is_running & (snap.job_queue >= 0))
+    r_gids = (
+        np.asarray(snap.job_gang_id, dtype=object)[rj]
+        if len(rj)
+        else np.zeros(0, dtype=object)
+    )
+    r_has_gid = np.asarray([bool(g) for g in r_gids], dtype=bool)
+    r_single = rj[~r_has_gid]
 
+    # Running gang groups (rare): per-gang Python grouping.
     running_groups: dict = {}
-    for j in np.flatnonzero(snap.job_is_running):
+    for j in rj[r_has_gid]:
         j = int(j)
-        if snap.job_queue[j] < 0:
-            continue
-        gid = snap.job_gang_id[j]
-        key = (int(snap.job_queue[j]), gid) if gid else (int(snap.job_queue[j]), f"__r{j}")
-        running_groups.setdefault(key, []).append(j)
-    for (q, _), members in running_groups.items():
-        members = sorted(members, key=lambda x: snap.job_order[x])
-        cand_queue.append(q)
-        cand_segment.append(0)
-        cand_order.append(int(max(snap.job_order[m] for m in members)))
-        cand_running.append(True)
-        cand_kg.append(-1)
-        cand_uni.append("")
-        cand_member_lists.append(members)
+        running_groups.setdefault(
+            (int(snap.job_queue[j]), snap.job_gang_id[j]), []
+        ).append(j)
+    rg_members = [
+        sorted(m, key=lambda x: snap.job_order[x])
+        for m in running_groups.values()
+    ]
 
     # Queued gangs straight off the gang table (first member of a queued
     # gang row is never running: running jobs get their own rows).
@@ -332,26 +338,92 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         & (snap.gang_queue >= 0)
         & ~snap.job_is_running[g_first]
     )
-    for g in np.flatnonzero(g_mask):
-        g = int(g)
-        members = snap.gang_members[
-            snap.gang_member_offsets[g] : snap.gang_member_offsets[g + 1]
-        ].tolist()
-        cand_queue.append(int(snap.gang_queue[g]))
-        cand_segment.append(1)
-        cand_order.append(int(snap.gang_order[g]))
-        cand_running.append(False)
-        cand_kg.append(int(job_key_group[members[0]]) if len(members) == 1 else -1)
-        cand_uni.append(
-            snap.gang_uniformity_key[g] if len(members) > 1 else ""
-        )
-        cand_member_lists.append(members)
+    g_sizes = np.diff(snap.gang_member_offsets)
+    q_single_g = np.flatnonzero(g_mask & (g_sizes == 1))
+    q_single = snap.gang_members[snap.gang_member_offsets[:-1][q_single_g]]
+    q_multi_g = np.flatnonzero(g_mask & (g_sizes > 1))
+
+    # Columnar candidate table: [running singles | running gangs |
+    # queued singles | queued gangs], flattened members alongside.
+    n_rs, n_rg = len(r_single), len(rg_members)
+    n_qs, n_qg = len(q_single), len(q_multi_g)
+    cand_queue = np.concatenate(
+        [
+            snap.job_queue[r_single],
+            np.asarray(
+                [q for (q, _) in running_groups], dtype=np.int32
+            ).reshape(n_rg),
+            snap.gang_queue[q_single_g] if n_qs else np.zeros(0, np.int32),
+            snap.gang_queue[q_multi_g] if n_qg else np.zeros(0, np.int32),
+        ]
+    ).astype(np.int32)
+    cand_segment = np.concatenate(
+        [
+            np.zeros(n_rs + n_rg, dtype=np.int8),
+            np.ones(n_qs + n_qg, dtype=np.int8),
+        ]
+    )
+    cand_order = np.concatenate(
+        [
+            snap.job_order[r_single],
+            np.asarray(
+                [max(snap.job_order[m] for m in ms) for ms in rg_members],
+                dtype=np.int64,
+            ).reshape(n_rg),
+            snap.gang_order[q_single_g] if n_qs else np.zeros(0, np.int64),
+            snap.gang_order[q_multi_g] if n_qg else np.zeros(0, np.int64),
+        ]
+    ).astype(np.int64)
+    cand_running = np.zeros(n_rs + n_rg + n_qs + n_qg, dtype=bool)
+    cand_running[: n_rs + n_rg] = True
+    cand_kg = np.concatenate(
+        [
+            np.full(n_rs + n_rg, -1, dtype=np.int32),
+            job_key_group[q_single] if n_qs else np.zeros(0, np.int32),
+            np.full(n_qg, -1, dtype=np.int32),
+        ]
+    ).astype(np.int32)
+    cand_counts = np.concatenate(
+        [
+            np.ones(n_rs, dtype=np.int32),
+            np.asarray([len(ms) for ms in rg_members], dtype=np.int32).reshape(
+                n_rg
+            ),
+            np.ones(n_qs, dtype=np.int32),
+            g_sizes[q_multi_g].astype(np.int32)
+            if n_qg
+            else np.zeros(0, np.int32),
+        ]
+    )
+    flat_members = np.concatenate(
+        [
+            r_single.astype(np.int32),
+            np.asarray(
+                [m for ms in rg_members for m in ms], dtype=np.int32
+            ),
+            q_single.astype(np.int32),
+            np.concatenate(
+                [
+                    snap.gang_members[
+                        snap.gang_member_offsets[g] : snap.gang_member_offsets[
+                            g + 1
+                        ]
+                    ]
+                    for g in q_multi_g
+                ]
+            ).astype(np.int32)
+            if n_qg
+            else np.zeros(0, np.int32),
+        ]
+    )
+    # Uniformity keys: only multi-member queued gangs carry one.
+    cand_uni_multi = [snap.gang_uniformity_key[int(g)] for g in q_multi_g]
 
     # Uniformity-value table: sorted values per key, as selector bitsets
     # (mirrors the oracle's sorted-value iteration).
     uni_ranges: dict[str, tuple[int, int]] = {}
     uni_bits_rows: list[np.ndarray] = []
-    for key in {u for u in cand_uni if u}:
+    for key in {u for u in cand_uni_multi if u}:
         values = sorted({v for (k, v) in snap.label_vocab.pairs if k == key})
         start = len(uni_bits_rows)
         for value in values:
@@ -368,25 +440,19 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
 
     n_cand = len(cand_queue)
     S = max(1, n_cand)
-    counts = np.asarray([len(m) for m in cand_member_lists], dtype=np.int32)
+    counts = cand_counts
     M = int(counts.max()) if n_cand else 1
     M = max(1, M)
+    cand_offsets = np.zeros(n_cand + 1, dtype=np.int64)
+    np.cumsum(counts, out=cand_offsets[1:])
 
     # Market mode merges evicted and queued candidates by price-rank order
     # (MarketDrivenMultiJobsIterator) instead of evicted-first chaining.
     seg_for_sort = (
-        np.zeros(n_cand, dtype=np.int8)
-        if cfg.market_driven
-        else np.asarray(cand_segment, dtype=np.int8)
+        np.zeros(n_cand, dtype=np.int8) if cfg.market_driven else cand_segment
     )
     order_perm = (
-        np.lexsort(
-            (
-                np.asarray(cand_order, dtype=np.int64),
-                seg_for_sort,
-                np.asarray(cand_queue, dtype=np.int32),
-            )
-        )
+        np.lexsort((cand_order, seg_for_sort, cand_queue))
         if n_cand
         else np.zeros(0, dtype=np.int64)
     )
@@ -405,29 +471,34 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     queue_slot_end = np.zeros(Q, dtype=np.int32)
 
     if n_cand:
-        slot_queue[:n_cand] = np.asarray(cand_queue, dtype=np.int32)[order_perm]
+        slot_queue[:n_cand] = cand_queue[order_perm]
         slot_count[:n_cand] = counts[order_perm]
-        slot_is_running[:n_cand] = np.asarray(cand_running, dtype=bool)[order_perm]
-        slot_key_group[:n_cand] = np.asarray(cand_kg, dtype=np.int32)[order_perm]
+        slot_is_running[:n_cand] = cand_running[order_perm]
+        slot_key_group[:n_cand] = cand_kg[order_perm]
 
-        # Member ranges flattened in sorted-slot order.
-        sorted_lists = [cand_member_lists[i] for i in order_perm]
-        flat = np.asarray(
-            [m for lst in sorted_lists for m in lst], dtype=np.int32
-        )
+        # Member ranges flattened in sorted-slot order (pure gathers).
+        counts_sorted = counts[order_perm].astype(np.int64)
         starts = np.zeros(n_cand, dtype=np.int64)
-        starts[1:] = np.cumsum(slot_count[:n_cand])[:-1]
-        rows = np.repeat(np.arange(n_cand), slot_count[:n_cand])
-        cols = np.arange(len(flat)) - starts[rows]
+        starts[1:] = np.cumsum(counts_sorted)[:-1]
+        rows = np.repeat(np.arange(n_cand), counts_sorted)
+        cols = np.arange(len(flat_members)) - starts[rows]
+        src_starts = cand_offsets[:-1][order_perm]
+        flat = flat_members[(src_starts[rows] + cols).astype(np.int64)]
         slot_members[rows, cols.astype(np.int64)] = flat
         slot_req[:n_cand] = np.add.reduceat(
             req_dev[flat].astype(np.int64), starts
         ).astype(np.int32)
         slot_price[:n_cand] = np.minimum.reduceat(snap.job_bid[flat], starts)
 
-        for i, uni in enumerate(np.asarray(cand_uni, dtype=object)[order_perm]):
-            if uni:
-                slot_uni_start[i], slot_uni_end[i] = uni_ranges[uni]
+        # Uniformity ranges: only multi-member queued gangs carry one.
+        if n_qg:
+            inv_perm = np.empty(n_cand, dtype=np.int64)
+            inv_perm[order_perm] = np.arange(n_cand)
+            base = n_rs + n_rg + n_qs
+            for gi, uni in enumerate(cand_uni_multi):
+                if uni:
+                    pos = inv_perm[base + gi]
+                    slot_uni_start[pos], slot_uni_end[pos] = uni_ranges[uni]
 
         # Lookback accounting: queued jobs in earlier slots of the same
         # queue. Exclusive cumsum of queued member counts, rebased per queue.
@@ -467,17 +538,54 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
                 queue_slot_start[:] = np.searchsorted(sq, np.arange(Q), side="left")
                 queue_slot_end[:] = np.searchsorted(sq, np.arange(Q), side="right")
 
+    # Batched-fill run lengths: maximal runs of consecutive batchable slots
+    # (same queue + scheduling key, singleton, no per-job anti-affinity).
+    # The kernel's fill fast path places a whole prefix of such a run in one
+    # loop iteration (kernel.py _fill_branch); 0 marks non-batchable slots.
+    slot_run_len = np.zeros(S, dtype=np.int32)
+    n_live = int(np.count_nonzero(slot_queue >= 0))
+    if n_live and not cfg.market_driven and cfg.batch_fill_window > 0:
+        j0 = np.clip(slot_members[:n_live, 0], 0, max(J - 1, 0))
+        elig = (
+            (slot_count[:n_live] == 1)
+            & ~slot_is_running[:n_live]
+            & (slot_key_group[:n_live] >= 0)
+            & (snap.job_excluded_nodes[j0] < 0).all(axis=1)
+            & (snap.job_affinity_group[j0] < 0)
+        )
+        same = (
+            elig[1:]
+            & elig[:-1]
+            & (slot_queue[1:n_live] == slot_queue[: n_live - 1])
+            & (slot_key_group[1:n_live] == slot_key_group[: n_live - 1])
+        )
+        break_after = np.ones(n_live, dtype=bool)
+        break_after[:-1] = ~same
+        ends = np.flatnonzero(break_after)
+        k = np.searchsorted(ends, np.arange(n_live))
+        slot_run_len[:n_live] = np.where(
+            elig, ends[k] + 1 - np.arange(n_live), 0
+        )
+
     # ---- queue tensors ----
     queue_name_rank = np.argsort(np.argsort(snap.queue_names)).astype(np.int32)
     queue_alloc0 = np.zeros((Q, R), dtype=np.int64)
     queue_demand_pc = np.zeros((Q, C, R), dtype=np.int64)
-    for j in range(J):
-        q = int(snap.job_queue[j])
-        if q < 0:
-            continue
-        if snap.job_is_running[j]:
-            queue_alloc0[q] += req_dev[j]
-        queue_demand_pc[q, job_pc[j]] += req_dev[j]
+    if J and Q:
+        valid = snap.job_queue >= 0
+        qidx = np.where(valid, snap.job_queue, 0).astype(np.int64)
+        seg = qidx * C + job_pc
+        run_w = valid & snap.job_is_running
+        for r in range(R):
+            col = req_dev[:, r].astype(np.float64)
+            queue_demand_pc[:, :, r] = (
+                np.bincount(seg, weights=np.where(valid, col, 0.0), minlength=Q * C)
+                .reshape(Q, C)
+                .astype(np.int64)
+            )
+            queue_alloc0[:, r] = np.bincount(
+                qidx, weights=np.where(run_w, col, 0.0), minlength=Q
+            )[:Q].astype(np.int64)
 
     queue_pc_limit = np.full((Q, C, R), np.inf)
     # Canonical pool totals in device units (floating columns = pool caps,
@@ -547,6 +655,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         slot_req=slot_req,
         slot_key_group=slot_key_group,
         slot_jobs_before=slot_jobs_before,
+        slot_run_len=slot_run_len,
         slot_uni_start=slot_uni_start,
         slot_uni_end=slot_uni_end,
         slot_price=slot_price,
@@ -580,12 +689,34 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         max_lookback=cfg.max_queue_lookback,
         global_burst=limits.maximum_scheduling_burst,
         queue_burst=limits.maximum_per_queue_scheduling_burst,
-        global_tokens=float(limits.maximum_scheduling_burst),
-        queue_tokens=np.full(Q, float(limits.maximum_per_queue_scheduling_burst)),
+        global_tokens=(
+            float(limits.maximum_scheduling_burst)
+            if snap.global_rate_tokens is None
+            else min(
+                float(snap.global_rate_tokens),
+                float(limits.maximum_scheduling_burst),
+            )
+        ),
+        queue_tokens=np.asarray(
+            [
+                min(
+                    float(
+                        (snap.queue_rate_tokens or {}).get(
+                            name, limits.maximum_per_queue_scheduling_burst
+                        )
+                    ),
+                    float(limits.maximum_per_queue_scheduling_burst),
+                )
+                for name in snap.queue_names
+            ],
+            dtype=np.float64,
+        ),
         prefer_large=cfg.enable_prefer_large_job_ordering,
         num_key_groups=num_key_groups,
         market_driven=cfg.market_driven,
         has_away=bool(snap.pc_away_count.any()),
+        batch_window=(0 if cfg.market_driven else int(cfg.batch_fill_window)),
+        fast_fill=bool(cfg.enable_fast_fill) and not cfg.market_driven,
         spot_price_cutoff=np.float64(cfg.spot_price_cutoff),
         job_bid=snap.job_bid,
     )

@@ -103,13 +103,25 @@ class ReferenceSolver:
         limits = cfg.rate_limits
         self.global_burst = limits.maximum_scheduling_burst
         self.queue_burst = limits.maximum_per_queue_scheduling_burst
-        self.global_tokens = (
-            float(global_tokens) if global_tokens is not None else float(self.global_burst)
+        # Token state carried across cycles by the service (the reference's
+        # rate limiter persists between rounds, scheduler.go); snapshot
+        # overrides feed it in, capped at the burst.
+        if global_tokens is None:
+            global_tokens = snap.global_rate_tokens
+        self.global_tokens = min(
+            float(global_tokens) if global_tokens is not None else float(self.global_burst),
+            float(self.global_burst),
         )
-        self.queue_tokens = (
+        if queue_tokens is None and snap.queue_rate_tokens is not None:
+            queue_tokens = [
+                (snap.queue_rate_tokens or {}).get(name, self.queue_burst)
+                for name in snap.queue_names
+            ]
+        self.queue_tokens = np.minimum(
             np.asarray(queue_tokens, dtype=np.float64)
             if queue_tokens is not None
-            else np.full(snap.num_queues, float(self.queue_burst))
+            else np.full(snap.num_queues, float(self.queue_burst)),
+            float(self.queue_burst),
         )
         self.mult = snap.drf_multipliers()
         self.total = snap.total_resources.astype(np.float64)
@@ -851,8 +863,16 @@ class ReferenceSolver:
             pc_name = self.job_pc_name[members[0]]
             limit = self.queue_pc_limits.get((q, pc_name))
             if limit is not None:
-                allocated = self.queue_pc_alloc.get((q, pc_name), 0)
-                if np.any(np.asarray(allocated) > limit):
+                # CheckJobConstraints runs AFTER AddGangSchedulingContext
+                # (gang_scheduler.go:132-140): the allocation it compares
+                # against the cap INCLUDES the candidate gang, so the gate
+                # is would-exceed, not already-exceeded.
+                allocated = np.asarray(
+                    self.queue_pc_alloc.get((q, pc_name), 0)
+                ) + sum(
+                    self.snap.job_req[m].astype(np.float64) for m in members
+                )
+                if np.any(allocated > limit):
                     return self._fail(members, R_QUEUE_LIMIT)
 
         # Floating-resource pool caps (IsWithinFloatingResourceLimits,

@@ -39,6 +39,9 @@ class TestSpec:
     expected_events: list
     timeout: float = 120.0
     jobset: str = ""
+    # Mid-test actions, e.g. {afterSeconds: 2, reprioritizeJobSet: 0}
+    # (the reference's reprioritization testcases).
+    actions: list = field(default_factory=list)
 
     @staticmethod
     def from_dict(doc: dict) -> "TestSpec":
@@ -49,6 +52,7 @@ class TestSpec:
             expected_events=list(doc.get("expectedEvents", [])),
             timeout=float(doc.get("timeout", 120.0)),
             jobset=doc.get("jobSetId", ""),
+            actions=list(doc.get("actions", [])),
         )
 
 
@@ -61,8 +65,10 @@ class TestResult:
     events_by_job: dict = field(default_factory=dict)
 
 
-def _expand_jobs(spec: TestSpec) -> list[dict]:
-    out = []
+def _expand_groups(spec: TestSpec) -> list[dict]:
+    """Expand job groups, keeping per-group expected events and submit
+    delays: [{jobs: [...], expected: [...], delay: s}]."""
+    groups = []
     for i, item in enumerate(spec.jobs):
         count = int(item.get("count", 1))
         job = {
@@ -78,8 +84,16 @@ def _expand_jobs(spec: TestSpec) -> list[dict]:
                 "id": gang.get("id", f"{spec.name}-gang-{i}"),
                 "cardinality": int(gang.get("cardinality", count)),
             }
-        out.extend(dict(job) for _ in range(count))
-    return out
+        groups.append(
+            {
+                "jobs": [dict(job) for _ in range(count)],
+                "expected": list(
+                    item.get("expectedEvents", spec.expected_events)
+                ),
+                "delay": float(item.get("submitDelaySeconds", 0.0)),
+            }
+        )
+    return groups
 
 
 class TestSuiteRunner:
@@ -93,14 +107,42 @@ class TestSuiteRunner:
             self.client.create_queue(spec.queue)
         except Exception:
             pass  # exists
-        job_ids = self.client.submit_jobs(spec.queue, jobset, _expand_jobs(spec))
 
-        # Watch until every job has emitted the expected sequence (in order,
-        # as a subsequence of its observed events) or timeout.
-        observed: dict[str, list] = {jid: [] for jid in job_ids}
+        # Submit groups in declared order, honoring per-group delays (the
+        # preemption cases submit the preemptor after the victim runs).
+        groups = _expand_groups(spec)
+        expected_by_job: dict[str, list] = {}
+        observed: dict[str, list] = {}
+        pending_actions = sorted(
+            spec.actions, key=lambda a: float(a.get("afterSeconds", 0))
+        )
+        for group in groups:
+            if group["delay"]:
+                time.sleep(group["delay"])
+            ids = self.client.submit_jobs(spec.queue, jobset, group["jobs"])
+            for jid in ids:
+                expected_by_job[jid] = group["expected"]
+                observed[jid] = []
+
         deadline = started + spec.timeout
         cursor = 0
         while time.time() < deadline:
+            while pending_actions and (
+                time.time() - started
+                >= float(pending_actions[0].get("afterSeconds", 0))
+            ):
+                action = pending_actions.pop(0)
+                if "reprioritizeJobSet" in action:
+                    self.client.reprioritize_jobs(
+                        spec.queue,
+                        jobset,
+                        list(observed),
+                        int(action["reprioritizeJobSet"]),
+                    )
+                elif "cancelJobSet" in action:
+                    self.client.cancel_jobs(
+                        spec.queue, jobset, cancel_jobset=True
+                    )
             for event in self.client.watch_jobset(
                 spec.queue, jobset, from_offset=cursor, watch=False
             ):
@@ -109,8 +151,8 @@ class TestSuiteRunner:
                 if jid in observed:
                     observed[jid].append(event["type"])
             if all(
-                _is_subsequence(spec.expected_events, evs)
-                for evs in observed.values()
+                _is_subsequence(expected_by_job[jid], evs)
+                for jid, evs in observed.items()
             ):
                 return TestResult(
                     spec.name, True, duration_s=time.time() - started,
@@ -120,9 +162,9 @@ class TestSuiteRunner:
                 jid
                 for jid, evs in observed.items()
                 if any(t in ("JobErrors", "JobRunPreempted") for t in evs)
-                and not _is_subsequence(spec.expected_events, evs)
-                and "JobErrors" not in spec.expected_events
-                and "JobRunPreempted" not in spec.expected_events
+                and not _is_subsequence(expected_by_job[jid], evs)
+                and "JobErrors" not in expected_by_job[jid]
+                and "JobRunPreempted" not in expected_by_job[jid]
             ]
             if terminal_bad:
                 return TestResult(
@@ -137,14 +179,15 @@ class TestSuiteRunner:
         missing = {
             jid: evs
             for jid, evs in observed.items()
-            if not _is_subsequence(spec.expected_events, evs)
+            if not _is_subsequence(expected_by_job[jid], evs)
         }
         sample = next(iter(missing.items())) if missing else ("", [])
         return TestResult(
             spec.name,
             False,
             reason=f"timeout: {len(missing)} job(s) missing events; "
-            f"sample {sample[0]}: got {sample[1]}, want {spec.expected_events}",
+            f"sample {sample[0]}: got {sample[1]}, "
+            f"want {expected_by_job.get(sample[0], spec.expected_events)}",
             duration_s=time.time() - started,
             events_by_job=observed,
         )

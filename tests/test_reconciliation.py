@@ -1,0 +1,136 @@
+"""Run↔node reconciliation (scheduling/reconciliation.go, consumed at
+scheduling_algo.go:293-398): leased runs are validated against
+executor-reported nodes each cycle."""
+
+from armada_tpu.core.config import PoolConfig, PriorityClass, SchedulingConfig
+from armada_tpu.core.types import Gang, JobSpec, QueueSpec
+from armada_tpu.events import InMemoryEventLog
+from armada_tpu.jobdb import JobState
+from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+from armada_tpu.services.scheduler import ExecutorHeartbeat, SchedulerService
+from armada_tpu.services.submit import SubmitService
+
+
+def mk_stack(run_reconciliation=True, preemptible=True):
+    config = SchedulingConfig(
+        pools=(
+            PoolConfig(name="default", run_reconciliation=run_reconciliation),
+        ),
+        priority_classes={
+            "default": PriorityClass("default", 1000, preemptible=preemptible),
+        },
+        default_priority_class="default",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    submit = SubmitService(config, log, scheduler=sched)
+    executor = FakeExecutor(
+        "cluster-a",
+        log,
+        sched,
+        nodes=make_nodes("cluster-a", count=3, cpu="16", memory="64Gi"),
+        runtime_for=lambda job_id: 1e9,
+    )
+    return config, log, sched, submit, executor
+
+
+def job(i, **kw):
+    return JobSpec(
+        id=f"job-{i:04d}", queue="", requests={"cpu": "2", "memory": "4Gi"}, **kw
+    )
+
+
+def _lease_all(sched, submit, executor, jobs):
+    submit.create_queue(QueueSpec("team"))
+    submit.submit("team", "set1", jobs, now=0.0)
+    executor.tick(0.0)
+    sched.cycle(now=1.0)
+    executor.tick(1.5)  # ack leases, start pods
+    sched.cycle(now=2.0)
+
+
+def test_gang_on_deleted_node_preempted_gang_aware():
+    config, log, sched, submit, executor = mk_stack()
+    gang = Gang(id="g0", cardinality=2)
+    jobs = [job(0, gang=gang), job(1, gang=gang), job(2)]
+    _lease_all(sched, submit, executor, jobs)
+    txn = sched.jobdb.read_txn()
+    leased = {j.id: j for j in txn.leased_jobs()}
+    assert len(leased) == 3
+    gang_nodes = {leased["job-0000"].latest_run.node_id}
+
+    # The node hosting gang member 0 disappears from the heartbeat.
+    hb = sched.executors["cluster-a"]
+    surviving = [n for n in hb.nodes if n.id not in gang_nodes]
+    sched.report_executor(
+        ExecutorHeartbeat(
+            name="cluster-a", pool="default", nodes=surviving, last_seen=3.0
+        )
+    )
+    sched.cycle(now=3.0)
+    txn = sched.jobdb.read_txn()
+    # Both gang members preempted (gang-aware), then rescheduled or queued;
+    # they must not still be leased to the vanished node.
+    for jid in ("job-0000", "job-0001"):
+        j = txn.get(jid)
+        run = j.latest_run
+        assert (
+            j.state == JobState.QUEUED
+            or j.state == JobState.PREEMPTED
+            or (run is not None and run.node_id not in gang_nodes)
+        ), (jid, j.state, run)
+    preempted_runs = [
+        j for jid in ("job-0000", "job-0001")
+        for j in [txn.get(jid)]
+        if any(r.state.value == "preempted" for r in j.runs)
+    ]
+    assert len(preempted_runs) == 2, "gang members not both preempted"
+
+
+def test_non_gang_on_deleted_node_only_warned():
+    config, log, sched, submit, executor = mk_stack()
+    jobs = [job(0)]
+    _lease_all(sched, submit, executor, jobs)
+    txn = sched.jobdb.read_txn()
+    j = txn.get("job-0000")
+    node = j.latest_run.node_id
+    hb = sched.executors["cluster-a"]
+    sched.report_executor(
+        ExecutorHeartbeat(
+            name="cluster-a",
+            pool="default",
+            nodes=[n for n in hb.nodes if n.id != node],
+            last_seen=3.0,
+        )
+    )
+    seqs = sched._reconcile_runs(3.0)
+    assert seqs == []  # logged, not preempted (checkJobsOnDeletedNodes)
+
+
+def test_pool_change_invalidates_any_job():
+    config, log, sched, submit, executor = mk_stack()
+    jobs = [job(0)]
+    _lease_all(sched, submit, executor, jobs)
+    txn = sched.jobdb.read_txn()
+    j = txn.get("job-0000")
+    # The whole executor moves pools: the leased run's node now reports a
+    # different pool than the run was scheduled into.
+    hb = sched.executors["cluster-a"]
+    sched.report_executor(
+        ExecutorHeartbeat(
+            name="cluster-a", pool="gpu-pool", nodes=hb.nodes, last_seen=3.0
+        )
+    )
+    seqs = sched._reconcile_runs(3.0)
+    assert len(seqs) == 1
+    assert "moved from pool" in seqs[0].events[0].reason
+
+
+def test_disabled_reconciliation_is_noop():
+    config, log, sched, submit, executor = mk_stack(run_reconciliation=False)
+    gang = Gang(id="g0", cardinality=2)
+    _lease_all(sched, submit, executor, [job(0, gang=gang), job(1, gang=gang)])
+    sched.report_executor(
+        ExecutorHeartbeat(name="cluster-a", pool="default", nodes=[], last_seen=3.0)
+    )
+    assert sched._reconcile_runs(3.0) == []

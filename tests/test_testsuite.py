@@ -1,31 +1,80 @@
-"""The declarative testsuite runner + simulator CLI + load tester, driven
-against a local ControlPlane over gRPC."""
+"""The declarative testsuite runner: the full case library driven against
+a local ControlPlane over gRPC (the reference's cmd/testsuite against
+testsuite/testcases/{basic,gpu,preemption,reprioritization,categorization,
+performance})."""
 
 import pytest
 
-from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
 from armada_tpu.services.grpc_api import ApiClient
 from armada_tpu.services.server import ControlPlane
 
 
 @pytest.fixture(scope="module")
 def plane():
+    config = SchedulingConfig(
+        priority_classes={
+            "ts-default": PriorityClass("ts-default", 1000, preemptible=True),
+            "ts-low": PriorityClass("ts-low", 100, preemptible=True),
+            "ts-high": PriorityClass("ts-high", 30000, preemptible=False),
+        },
+        default_priority_class="ts-default",
+        protected_fraction_of_fair_share=0.0,
+    )
     p = ControlPlane(
-        SchedulingConfig(),
+        config,
         cycle_period=0.05,
-        fake_executors=[{"name": "ts-exec", "nodes": 4, "cpu": "16", "runtime": 1.0}],
+        fake_executors=[
+            {
+                "name": "ts-exec",
+                "nodes": 6,
+                "cpu": "16",
+                "memory": "64Gi",
+                "runtime": 3.0,
+                "labels": {"zone": "z1"},
+                "extra_resources": {"nvidia.com/gpu": "4"},
+            }
+        ],
     ).start()
     yield p
     p.stop()
 
 
-def test_testsuite_basic_and_gang(plane):
+CASES = [
+    "basic",
+    "gang",
+    "gpu",
+    "node_selector",
+    "reprioritization",
+    "categorization",
+    "cancellation",
+    "performance",
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_testsuite_case(plane, case):
     from armada_tpu.testsuite import run_spec_file
 
-    client = ApiClient(plane.address)
-    for case in ("testsuite_cases/basic.yaml", "testsuite_cases/gang.yaml"):
-        res = run_spec_file(case, client)
-        assert res.passed, f"{res.name}: {res.reason}"
+    res = run_spec_file(f"testsuite_cases/{case}.yaml", ApiClient(plane.address))
+    assert res.passed, f"{res.name}: {res.reason}"
+
+
+def test_testsuite_preemption(plane):
+    """The preemption family needs a full cluster: the low-PC victims fill
+    it before the high-PC preemptor batch arrives."""
+    from armada_tpu.testsuite import run_spec_file
+
+    res = run_spec_file(
+        "testsuite_cases/preemption.yaml", ApiClient(plane.address)
+    )
+    assert res.passed, f"{res.name}: {res.reason}"
+    preempted = [
+        jid
+        for jid, evs in res.events_by_job.items()
+        if "JobRunPreempted" in evs
+    ]
+    assert preempted, "no job was preempted by the high-PC batch"
 
 
 def test_testsuite_detects_failure(plane, tmp_path):
