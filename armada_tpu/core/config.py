@@ -71,6 +71,19 @@ class OptimiserConfig:
 
 
 @dataclass(frozen=True)
+class GangDefinition:
+    """A gang shape the indicative pricer quotes every round
+    (configuration.GangDefinition, configuration.go:449-456)."""
+
+    size: int = 1
+    priority_class: str = ""
+    resources: dict = field(default_factory=dict)  # {resource: quantity}
+    node_uniformity: str = ""
+    node_selector: dict = field(default_factory=dict)
+    tolerations: tuple = ()  # tuple[Toleration, ...]
+
+
+@dataclass(frozen=True)
 class SchedulingConfig:
     pools: tuple[PoolConfig, ...] = (PoolConfig(name="default"),)
     supported_resource_types: tuple[ResourceType, ...] = (
@@ -161,6 +174,12 @@ class SchedulingConfig:
     # is recorded once scheduled cost crosses the cutoff fraction.
     market_driven: bool = False
     spot_price_cutoff: float = 0.0
+    # Gang shapes the indicative pricer quotes each market round, and its
+    # per-round budget (MarketSchedulingConfig.GangsToPrice /
+    # GangIndicativePricingTimeout, configuration.go:440-447). Prices land
+    # in metrics and the round report.
+    gangs_to_price: dict = field(default_factory=dict)  # {name: GangDefinition}
+    gang_pricing_timeout_s: float = 1.0
     # Assert jobdb invariants at the end of each cycle (the reference's
     # enableAssertions, scheduler.go:143; config.yaml:84).
     enable_assertions: bool = False
@@ -303,9 +322,32 @@ class SchedulingConfig:
             kwargs["max_retries"] = int(d["maxRetries"])
         if "nodeIdLabel" in d:
             kwargs["node_id_label"] = d["nodeIdLabel"]
+        if "gangsToPrice" in d:
+            from .types import Toleration
+
+            kwargs["gangs_to_price"] = {
+                name: GangDefinition(
+                    size=int(g.get("size", 1)),
+                    priority_class=g.get("priorityClassName", ""),
+                    resources=dict(g.get("resources", {})),
+                    node_uniformity=g.get("nodeUniformity", ""),
+                    node_selector=dict(g.get("nodeSelector", {})),
+                    tolerations=tuple(
+                        Toleration(
+                            key=t.get("key", ""),
+                            operator=t.get("operator", "Equal"),
+                            value=t.get("value", ""),
+                            effect=t.get("effect", ""),
+                        )
+                        for t in g.get("tolerations", [])
+                    ),
+                )
+                for name, g in d["gangsToPrice"].items()
+            }
         for yaml_key, attr, conv in [
             ("enableAssertions", "enable_assertions", bool),
             ("marketDriven", "market_driven", bool),
+            ("gangIndicativePricingTimeout", "gang_pricing_timeout_s", float),
             ("spotPriceCutoff", "spot_price_cutoff", float),
             ("shortJobPenaltySeconds", "short_job_penalty_s", float),
             ("executorTimeout", "executor_timeout_s", float),

@@ -149,13 +149,18 @@ class JobSpec:
     # Market mode: bid price per pool (pkg/bidstore; job.GetBidPrice).
     bid_prices: dict = field(default_factory=dict)
 
-    def bid_price(self, pool: str) -> float:
+    def bid_price(self, pool: str, *, running: bool = False) -> float:
         """Bid for this pool; malformed or non-finite user-supplied values
         count as 0 (one bad annotation must not abort scheduling rounds or
-        poison price ordering)."""
+        poison price ordering). Values may be scalars or (queued, running)
+        phase pairs as written by the bid-price provider
+        (pricing.Bid / jobdb job.getBidPrice phase selection)."""
         for key in (pool, ""):
             if key in self.bid_prices:
-                return _clean_price(self.bid_prices[key])
+                v = self.bid_prices[key]
+                if isinstance(v, (tuple, list)) and len(v) == 2:
+                    v = v[1] if running else v[0]
+                return _clean_price(v)
         return _clean_price(self.annotations.get("armadaproject.io/bidPrice", 0.0))
 
     def with_(self, **kw) -> "JobSpec":

@@ -1,0 +1,122 @@
+"""Event-stream index: the per-jobset materialized view feeding watchers.
+
+The reference's event ingester (/root/reference/internal/eventingester/
+{ingester.go,store/eventstore.go:24-46}) converts the firehose into
+per-jobset Redis streams with sequence ids and a retention policy, so
+`armadactl watch` readers never scan unrelated traffic. Same role here:
+an IngestPipeline consumer materializes {(queue, jobset): [offsets]} with
+its own cursor, the watch RPC reads only its jobset's offsets, and
+retention trims whole jobsets that have gone quiet.
+
+O(log) work happens once in the indexer instead of once per watcher; a
+watcher resuming from offset k binary-searches the jobset's offset list
+instead of replaying the log from k.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+from ..events.pipeline import IngestPipeline
+
+
+class EventStreamIndex:
+    def __init__(self, log, *, batch_size: int = 1000):
+        self.log = log
+        self._lock = threading.Lock()
+        # (queue, jobset) -> sorted list of log offsets holding its events.
+        self._streams: dict[tuple, list[int]] = {}
+        # (queue, jobset) -> created ts of the jobset's last event, for
+        # retention (eventstore retention policy).
+        self._last_activity: dict[tuple, float] = {}
+        self._pipeline = IngestPipeline(
+            log, self._convert, self._sink, batch_size=batch_size
+        )
+        # Serializes concurrent sync() callers (every watcher thread pumps
+        # the view); the sink stays idempotent regardless.
+        self._sync_lock = threading.Lock()
+
+    # ---- pipeline stages ----
+
+    @staticmethod
+    def _convert(entries):
+        ops: dict[tuple, list[int]] = {}
+        activity: dict[tuple, float] = {}
+        for entry in entries:
+            seq = entry.sequence
+            key = (seq.queue, seq.jobset)
+            ops.setdefault(key, []).append(entry.offset)
+            for event in seq.events:
+                created = getattr(event, "created", 0.0)
+                if created:
+                    activity[key] = max(activity.get(key, 0.0), created)
+        return (ops, activity)
+
+    def _sink(self, ops):
+        stream_ops, activity = ops
+        with self._lock:
+            for key, offsets in stream_ops.items():
+                bucket = self._streams.setdefault(key, [])
+                # Idempotent under at-least-once replay: offsets are
+                # monotone per batch, so drop any already-indexed tail.
+                start = 0
+                if bucket:
+                    while (
+                        start < len(offsets) and offsets[start] <= bucket[-1]
+                    ):
+                        start += 1
+                bucket.extend(offsets[start:])
+            for key, ts in activity.items():
+                if ts > self._last_activity.get(key, 0.0):
+                    self._last_activity[key] = ts
+
+    # ---- consumer API ----
+
+    def sync(self) -> int:
+        with self._sync_lock:
+            return self._pipeline.sync()
+
+    @property
+    def lag_events(self) -> int:
+        return self._pipeline.lag_events
+
+    def offsets_from(self, queue: str, jobset: str, cursor: int, limit: int = 1000):
+        """Offsets >= cursor for one jobset (the per-stream read that
+        replaces scanning the whole log), or None when the jobset is not in
+        the index (never seen, or pruned by retention) — callers must fall
+        back to the log scan in that case, because the log may still hold
+        the history the index dropped."""
+        with self._lock:
+            bucket = self._streams.get((queue, jobset))
+            if bucket is None:
+                return None
+            i = bisect.bisect_left(bucket, cursor)
+            return list(bucket[i : i + limit])
+
+    def read_from(self, queue: str, jobset: str, cursor: int, limit: int = 1000):
+        """(offset, EventSequence) pairs for one jobset from cursor; None
+        when the jobset is unknown to the index (see offsets_from)."""
+        offsets = self.offsets_from(queue, jobset, cursor, limit)
+        if offsets is None:
+            return None
+        out = []
+        for offset in offsets:
+            entries = self.log.read(offset, 1)
+            if entries and entries[0].offset == offset:
+                out.append((offset, entries[0].sequence))
+        return out
+
+    def prune(self, older_than: float) -> int:
+        """Drop jobsets whose last event predates `older_than` (the
+        reference's per-jobset retention)."""
+        with self._lock:
+            stale = [
+                key
+                for key, ts in self._last_activity.items()
+                if ts < older_than
+            ]
+            for key in stale:
+                self._streams.pop(key, None)
+                self._last_activity.pop(key, None)
+            return len(stale)

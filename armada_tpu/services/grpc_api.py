@@ -112,6 +112,7 @@ class ApiServer:
         binoculars=None,
         auth=None,
         authorizer=None,
+        event_index=None,
     ):
         self.submit = submit
         self.scheduler = scheduler
@@ -119,6 +120,10 @@ class ApiServer:
         self.log = log
         self.submit_checker = submit_checker
         self.binoculars = binoculars
+        # Optional per-jobset event-stream index (services/event_index.py,
+        # the event-ingester view): watchers read only their jobset's
+        # offsets instead of scanning the whole log.
+        self.event_index = event_index
         # Authentication chain + permission mapping (services/auth.py;
         # common/auth/{multi,permissions}.go). None = open server (tests,
         # trusted in-process deployments).
@@ -458,16 +463,31 @@ class ApiServer:
         cond = self.log.watcher() if watch else None
         try:
             while context.is_active():
-                entries = self.log.read(cursor, 1000)
-                for entry in entries:
-                    cursor = entry.offset + 1
-                    seq = entry.sequence
-                    if seq.queue != queue or seq.jobset != jobset:
-                        continue
+                batch = None
+                if self.event_index is not None:
+                    # Per-jobset stream read (eventstore.go:24-46): the
+                    # index has already partitioned the log, so this
+                    # watcher touches only its jobset's entries. Sync here
+                    # keeps the view current even between scheduler cycles.
+                    self.event_index.sync()
+                    batch = self.event_index.read_from(
+                        queue, jobset, cursor, 1000
+                    )
+                if batch is None:
+                    # No index, or the jobset aged out of it (retention):
+                    # the log is the source of truth, scan it directly.
+                    batch = []
+                    for entry in self.log.read(cursor, 1000):
+                        cursor = entry.offset + 1
+                        seq = entry.sequence
+                        if seq.queue == queue and seq.jobset == jobset:
+                            batch.append((entry.offset, seq))
+                for offset, seq in batch:
+                    cursor = offset + 1
                     for event in seq.events:
                         payload = {
                             "type": type(event).__name__,
-                            "offset": entry.offset,
+                            "offset": offset,
                             **{
                                 k: v
                                 for k, v in dataclasses.asdict(event).items()

@@ -187,6 +187,23 @@ class LookoutHttpServer:
                         self._json(
                             {"report": outer.scheduler.reports.scheduling_report()}
                         )
+                    elif parsed.path == "/api/prices":
+                        # Market mode: last round's indicative gang prices
+                        # (MarketDrivenIndicativePrices surfaced by
+                        # cycle_metrics.go:681; spot price per pool).
+                        self._json(
+                            {
+                                pool: {
+                                    "spot_price": rep.spot_price,
+                                    "gangs": {
+                                        name: asdict(pr)
+                                        for name, pr in rep.indicative_prices.items()
+                                    },
+                                }
+                                for pool, rep in
+                                outer.scheduler.reports.latest_reports().items()
+                            }
+                        )
                     elif parsed.path == "/api/errors":
                         filters = []
                         if params.get("queue"):

@@ -136,6 +136,20 @@ class SchedulerMetrics:
             ["pool"],
             registry=r,
         )
+        # Market mode: per-shape indicative gang price
+        # (cycle_metrics.go:681 indicativePrice gauges).
+        self.indicative_gang_price = Gauge(
+            "scheduler_indicative_gang_price",
+            "Minimum bid at which the configured gang shape would schedule",
+            ["pool", "shape"],
+            registry=r,
+        )
+        self.indicative_gang_schedulable = Gauge(
+            "scheduler_indicative_gang_schedulable",
+            "1 if the configured gang shape is currently schedulable",
+            ["pool", "shape"],
+            registry=r,
+        )
         self.executor_heartbeat_age = Gauge(
             "scheduler_executor_heartbeat_age_seconds",
             "Seconds since each executor's last heartbeat",

@@ -34,6 +34,9 @@ class RoundReport:
     spot_price: float | None = None  # market mode
     queues: dict = field(default_factory=dict)  # queue -> QueueReport
     job_reasons: dict = field(default_factory=dict)  # job_id -> reason
+    # Market mode: indicative gang prices by configured shape name
+    # (solver.pricer.GangPricingResult per shape).
+    indicative_prices: dict = field(default_factory=dict)
 
     def report_string(self) -> str:
         lines = [
@@ -44,6 +47,15 @@ class RoundReport:
         ]
         if self.spot_price is not None:
             lines.append(f"spot price: {self.spot_price}")
+        for name in sorted(self.indicative_prices):
+            r = self.indicative_prices[name]
+            if not r.evaluated:
+                detail = "not evaluated (pricing deadline)"
+            elif r.schedulable:
+                detail = f"price={r.price}"
+            else:
+                detail = f"unschedulable: {r.unschedulable_reason}"
+            lines.append(f"  indicative gang {name}: {detail}")
         for q in sorted(self.queues):
             r = self.queues[q]
             lines.append(
@@ -77,6 +89,12 @@ class SchedulingReportsRepository:
                 oldest = sorted(self._job_reports.items(), key=lambda kv: kv[1][0])
                 for job_id, _ in oldest[: len(oldest) // 2]:
                     del self._job_reports[job_id]
+
+    def latest_reports(self) -> dict:
+        """Locked snapshot of the per-pool reports for external readers
+        (the HTTP/gRPC threads must never iterate by_pool unlocked)."""
+        with self._lock:
+            return dict(self.by_pool)
 
     def queue_report(self, queue: str) -> str:
         with self._lock:

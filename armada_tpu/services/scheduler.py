@@ -56,6 +56,7 @@ class SchedulerService:
         queues: list[QueueSpec] | None = None,
         is_leader=lambda: True,
         runner=None,
+        bid_price_provider=None,
     ):
         self.config = config
         self.log = log
@@ -97,6 +98,11 @@ class SchedulerService:
 
         self.reports = SchedulingReportsRepository()
         self.metrics = None  # set via attach_metrics
+        # Market mode: bid-price provider + last applied snapshot
+        # (scheduler.go:540-585 updateBidPrices; bids are not event-sourced,
+        # a restarted leader re-fetches).
+        self.bid_price_provider = bid_price_provider
+        self._bid_snapshot = None
         self.ingester.sync()  # restore jobdb + event-sourced settings
         from ..utils.logging import get_logger
 
@@ -266,6 +272,7 @@ class SchedulerService:
             self.started_at = now
             self._orphan_sweep_done = False
         self.ingester.sync()
+        self._refresh_bid_prices()
         sequences: list[EventSequence] = []
         sequences += self._expire_stale_executors(now)
         sequences += self._handle_failed_runs(now)
@@ -321,6 +328,29 @@ class SchedulerService:
             self.runner.submit(lambda now=now: self._schedule_all_pools(now))
         self.cycle_count += 1
         return sequences
+
+    def _refresh_bid_prices(self):
+        """Fetch the latest bid snapshot and re-price exactly the jobs whose
+        (queue, band) key changed (scheduler.go:540-585). Provider errors
+        keep the previous snapshot — a flaky bid store must not stall
+        scheduling cycles."""
+        if self.bid_price_provider is None or not self.config.market_driven:
+            return
+        from .pricing import refresh_job_bids
+
+        try:
+            snapshot = self.bid_price_provider.get_bid_prices()
+        except Exception as e:
+            self.log_.with_fields(cycle=self.cycle_count).warning(
+                "bid price fetch failed, keeping previous snapshot: %r", e
+            )
+            return
+        updated = refresh_job_bids(self.jobdb, snapshot, self._bid_snapshot)
+        if updated:
+            self.log_.with_fields(cycle=self.cycle_count, jobs=updated).info(
+                "re-priced jobs from bid snapshot %s", snapshot.id
+            )
+        self._bid_snapshot = snapshot
 
     def _schedule_all_pools(self, now: float) -> list[EventSequence]:
         """Per-pool rounds against one jobdb snapshot; jobs leased by an
@@ -743,6 +773,29 @@ class SchedulerService:
                     cycle=self.cycle_count, pool=pool, stage="optimiser",
                     gangs=len(decisions),
                 ).info("optimiser placed %d gangs", len(decisions))
+        indicative = {}
+        if self.config.market_driven and self.config.gangs_to_price:
+            # Indicative gang pricing against the post-round snapshot
+            # (MarketDrivenIndicativePricer, invoked at
+            # preempting_queue_scheduler.go:637-646). Advisory: a pricer
+            # failure must not fail the round.
+            from ..solver.pricer import price_gangs
+
+            try:
+                scheduled_req = np_.asarray(
+                    snap.job_req[np_.asarray(result["scheduled_mask"], bool)]
+                ).sum(axis=0)
+                indicative = price_gangs(
+                    snap,
+                    self.config.gangs_to_price,
+                    result=result,
+                    scheduled_this_round=scheduled_req,
+                    timeout_s=self.config.gang_pricing_timeout_s,
+                )
+            except Exception as e:
+                self.log_.with_fields(cycle=self.cycle_count, pool=pool).error(
+                    "indicative pricing failed: %r", e
+                )
         self.last_cycle_stats = {
             "pool": pool,
             "jobs": snap.num_jobs,
@@ -757,7 +810,7 @@ class SchedulerService:
             preempted=self.last_cycle_stats["preempted"],
             solve_s=round(_time.time() - solve_started, 4),
         ).info("scheduling round complete")
-        self._record_round(pool, snap, result, solve_started)
+        self._record_round(pool, snap, result, solve_started, indicative)
 
         by_jobset: dict[tuple, list] = {}
         import numpy as np
@@ -831,7 +884,7 @@ class SchedulerService:
             "termination_reason": res.termination_reason,
         }
 
-    def _record_round(self, pool, snap, result, started):
+    def _record_round(self, pool, snap, result, started, indicative=None):
         import numpy as np
 
         from ..solver.drf import unweighted_cost
@@ -848,6 +901,7 @@ class SchedulerService:
             num_nodes=snap.num_nodes,
             termination_reason=result.get("termination_reason", ""),
             spot_price=result.get("spot_price"),
+            indicative_prices=dict(indicative or {}),
         )
         sched_by_q = {}
         preempt_by_q = {}
@@ -909,6 +963,16 @@ class SchedulerService:
                 self.metrics.queue_demand.labels(pool=pool, queue=name).set(
                     float(demand_cost[0])
                 )
+            for shape, pr in (indicative or {}).items():
+                ok = pr.evaluated and pr.schedulable
+                # NaN when unschedulable/unevaluated: a gauge left at its
+                # last price would read as a live quote on dashboards.
+                self.metrics.indicative_gang_price.labels(
+                    pool=pool, shape=shape
+                ).set(pr.price if ok else float("nan"))
+                self.metrics.indicative_gang_schedulable.labels(
+                    pool=pool, shape=shape
+                ).set(1.0 if ok else 0.0)
             self.metrics.event_log_offset.set(self.log.end_offset)
             self.metrics.ingester_lag.set(
                 max(0, self.log.end_offset - self.ingester.cursor)

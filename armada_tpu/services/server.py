@@ -83,6 +83,12 @@ class ControlPlane:
         from .binoculars import BinocularsService
 
         self.binoculars = BinocularsService(self.scheduler, self.executors)
+        # Per-jobset event-stream view (the event-ingester's Redis streams,
+        # eventingester/store/eventstore.go): watchers read partitioned
+        # streams instead of scanning the shared log.
+        from .event_index import EventStreamIndex
+
+        self.event_index = EventStreamIndex(self.log)
         self.api = ApiServer(
             self.submit,
             self.scheduler,
@@ -90,6 +96,7 @@ class ControlPlane:
             self.log,
             self.submit_checker,
             binoculars=self.binoculars,
+            event_index=self.event_index,
         )
         self.grpc_server, self.grpc_port = self.api.serve(grpc_port)
         self.metrics_server = (
@@ -163,6 +170,11 @@ class ControlPlane:
                 # The lookout pruner (internal/lookout/pruner): bound the
                 # materialization like the scheduler bounds its jobdb.
                 self.lookout_store.prune(
+                    _time.time() - self.config.terminal_job_retention_s
+                )
+                # Per-jobset stream retention (the event ingester's Redis
+                # stream expiry): quiet jobsets drop out of the index.
+                self.event_index.prune(
                     _time.time() - self.config.terminal_job_retention_s
                 )
             if self.metrics.registry is not None:

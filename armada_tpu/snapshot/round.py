@@ -207,10 +207,18 @@ def build_round_snapshot(
     # vocabulary and the per-job tensors can never diverge.
     jobs: list[JobSpec] = [r.job for r in running] + list(queued)
 
-    # Vocabularies over this snapshot's population.
+    # Vocabularies over this snapshot's population, plus the config-declared
+    # indexed labels (nodedb.go:107-120 indexedNodeLabels) and any keys the
+    # indicative-pricing shapes reference — the pricer groups and matches
+    # through the same interned bitsets.
+    extra_keys = set(config.indexed_node_labels)
+    for shape in config.gangs_to_price.values():
+        if shape.node_uniformity:
+            extra_keys.add(shape.node_uniformity)
+        extra_keys.update((shape.node_selector or {}).keys())
     taint_vocab = TaintVocab.build(nodes)
     label_vocab = LabelVocab.build(
-        nodes, referenced_label_keys(jobs, config.node_id_label)
+        nodes, referenced_label_keys(jobs, config.node_id_label, extra_keys)
     )
 
     # --- node tensors ---
@@ -313,7 +321,13 @@ def build_round_snapshot(
     jids = np.asarray([j.id for j in jobs])
     # Bid prices only matter in market mode; skip 1M python calls otherwise.
     job_bid = (
-        np.asarray([j.bid_price(pool) for j in jobs], dtype=np.float64)
+        np.asarray(
+            [
+                j.bid_price(pool, running=bool(job_is_running[i]))
+                for i, j in enumerate(jobs)
+            ],
+            dtype=np.float64,
+        )
         if config.market_driven
         else np.zeros(J, dtype=np.float64)
     )

@@ -32,3 +32,23 @@ _force_cpu()
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compile cache: the solver kernel recompiles per padded shape,
+# which dominates suite wall-clock on this 1-core box (and two full-suite
+# runs have segfaulted inside XLA's CPU JIT after ~140 in-process
+# compilations). Caching executables on disk makes repeat runs load
+# instead of compile; clearing the in-process caches at module boundaries
+# bounds the live JITed-code footprint that appears to trigger the crash.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.environ["REPO_ROOT"], ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jit_cache():
+    yield
+    jax.clear_caches()

@@ -1,0 +1,66 @@
+"""Broadside load bench: in-process backend end-to-end + report shape
+(internal/broadside/orchestrator lifecycle, metrics/output.go report)."""
+
+import json
+
+from armada_tpu.clients.broadside import (
+    BroadsideConfig,
+    InprocBackend,
+    OpStats,
+    Runner,
+)
+
+
+def test_opstats_reset_and_snapshot():
+    s = OpStats("x")
+    for ms in (1, 2, 3):
+        s.record(ms / 1000.0, units=10)
+    s.error()
+    snap = s.snapshot(wall_s=1.0)
+    assert snap["ops"] == 3 and snap["errors"] == 1
+    assert snap["units"] == 30 and snap["units_per_s"] == 30.0
+    assert snap["p50_ms"] == 2.0 and snap["max_ms"] == 3.0
+    s.reset()
+    assert s.snapshot(1.0)["ops"] == 0
+
+
+def test_inproc_backend_lifecycle_mix():
+    cfg = BroadsideConfig(batch=20)
+    backend = InprocBackend()
+    try:
+        backend.submit_batch("broadside-0", "bs", 20, cfg)
+        # Pump the store to convergence.
+        while backend.lag_events() > 0:
+            pass
+        groups = {g["name"]: g["count"] for g in backend.group_jobs("broadside-0")}
+        # 60% succeed, 10% fail, 5% cancel (->1 of 20), rest running.
+        assert groups.get("succeeded") == 12
+        assert groups.get("failed") == 2
+        assert groups.get("cancelled") == 1
+        assert sum(groups.values()) == 20
+        rows = backend.get_jobs("broadside-0")
+        assert len(rows) == 20
+        details = backend.job_details(backend.recent_ids[0])
+        assert details is not None and details["job_id"] == backend.recent_ids[0]
+    finally:
+        backend.teardown()
+
+
+def test_runner_report_shape():
+    cfg = BroadsideConfig(
+        duration_s=0.8,
+        ingest_actors=1,
+        query_actors=2,
+        batch=10,
+        queues=2,
+        seed_jobs=20,
+        warmup_s=0.2,
+    )
+    report = Runner(cfg).run()
+    assert report["backend"] == "inproc"
+    for op in ("ingest", "get_jobs", "group_jobs", "job_details"):
+        assert "ops" in report[op] and "errors" in report[op]
+    assert report["ingest"]["errors"] == 0
+    assert report["ingest"]["ops"] > 0 and report["ingest"]["units"] > 0
+    assert report["get_jobs"]["ops"] > 0
+    json.dumps(report)  # must be JSON-serializable as emitted by the CLI

@@ -1,0 +1,161 @@
+"""Generic ingest pipeline + per-jobset event-stream index
+(common/ingest/ingestion_pipeline.go; eventingester/store/eventstore.go)."""
+
+import time
+
+from armada_tpu.core.types import JobSpec
+from armada_tpu.events import (
+    EventSequence,
+    InMemoryEventLog,
+    JobRunLeased,
+    SubmitJob,
+)
+from armada_tpu.events.pipeline import IngestPipeline
+from armada_tpu.services.event_index import EventStreamIndex
+
+
+def submit(log, queue, jobset, job_id, created=1.0):
+    log.publish(
+        EventSequence.of(
+            queue,
+            jobset,
+            SubmitJob(
+                created=created,
+                job=JobSpec(id=job_id, queue=queue, jobset=jobset,
+                            requests={"cpu": "1"}),
+            ),
+        )
+    )
+
+
+def test_pipeline_batches_and_advances_cursor():
+    log = InMemoryEventLog()
+    batches = []
+    pipe = IngestPipeline(
+        log,
+        convert=lambda entries: [e.offset for e in entries],
+        sink=batches.append,
+        batch_size=3,
+    )
+    for i in range(7):
+        submit(log, "q", "js", f"j{i}")
+    assert pipe.lag_events == 7
+    applied = pipe.sync()
+    assert applied == 7
+    assert [len(b) for b in batches] == [3, 3, 1]
+    assert pipe.cursor == log.end_offset and pipe.lag_events == 0
+    # Idempotent on drained log.
+    assert pipe.sync() == 0
+
+
+def test_pipeline_merge_hook():
+    log = InMemoryEventLog()
+    merged = []
+    pipe = IngestPipeline(
+        log,
+        convert=lambda entries: [(e.sequence.queue, 1) for e in entries],
+        merge=lambda ops: {
+            q: sum(n for qq, n in ops if qq == q) for q, _ in ops
+        },
+        sink=merged.append,
+        batch_size=100,
+    )
+    for i in range(4):
+        submit(log, "qa" if i % 2 else "qb", "js", f"j{i}")
+    pipe.sync()
+    assert merged == [{"qa": 2, "qb": 2}]
+
+
+def test_pipeline_time_batching_holds_partial_batches():
+    log = InMemoryEventLog()
+    batches = []
+    pipe = IngestPipeline(
+        log,
+        convert=lambda entries: list(entries),
+        sink=batches.append,
+        batch_size=10,
+        max_batch_delay_s=0.1,
+    )
+    submit(log, "q", "js", "j0")
+    assert pipe.sync() == 0  # held: batch not full, delay not elapsed
+    assert batches == []
+    time.sleep(0.12)
+    assert pipe.sync() == 1  # delay elapsed: partial batch flushes
+    assert len(batches) == 1
+
+
+def test_event_index_partitions_streams():
+    log = InMemoryEventLog()
+    index = EventStreamIndex(log)
+    for i in range(5):
+        submit(log, "q", "js-a", f"a{i}", created=float(i))
+    for i in range(3):
+        submit(log, "q", "js-b", f"b{i}", created=float(i))
+    index.sync()
+    assert index.lag_events == 0
+    a = index.read_from("q", "js-a", 0)
+    b = index.read_from("q", "js-b", 0)
+    assert len(a) == 5 and len(b) == 3
+    assert all(seq.jobset == "js-a" for _, seq in a)
+    # Resume from a mid-stream cursor: only later offsets return.
+    mid = a[2][0] + 1
+    assert [off for off, _ in index.read_from("q", "js-a", mid)] == [
+        off for off, _ in a[3:]
+    ]
+    # Unknown jobset: None — the watch path must fall back to the log
+    # scan, because "not indexed" never means "no events exist".
+    assert index.read_from("q", "nope", 0) is None
+
+
+def test_event_index_idempotent_replay():
+    log = InMemoryEventLog()
+    index = EventStreamIndex(log)
+    submit(log, "q", "js", "j0")
+    index.sync()
+    # Simulate at-least-once replay: rewind the cursor and re-sync.
+    index._pipeline.cursor = 0
+    index.sync()
+    assert len(index.read_from("q", "js", 0)) == 1
+
+
+def test_event_index_retention_prune():
+    log = InMemoryEventLog()
+    index = EventStreamIndex(log)
+    submit(log, "q", "old", "j0", created=10.0)
+    submit(log, "q", "new", "j1", created=100.0)
+    log.publish(
+        EventSequence.of(
+            "q", "new",
+            JobRunLeased(created=110.0, job_id="j1", run_id="r1",
+                         executor="e", node_id="n", pool="p"),
+        )
+    )
+    index.sync()
+    assert index.prune(older_than=50.0) == 1
+    # Pruned jobset reads as unknown (None), NOT empty: watchers fall back
+    # to the log, which still holds the history.
+    assert index.read_from("q", "old", 0) is None
+    assert len(index.read_from("q", "new", 0)) == 2
+
+
+def test_watch_uses_index_end_to_end():
+    """The full stack's watch path serves from the index."""
+    from armada_tpu.clients.grpc_client import connect
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.services.server import ControlPlane
+
+    plane = ControlPlane(SchedulingConfig(), grpc_port=0)
+    try:
+        client = connect(f"127.0.0.1:{plane.grpc_port}")
+        client.create_queue("q")
+        ids = client.submit_jobs(
+            "q", "js", [{"requests": {"cpu": "1", "memory": "1Gi"}}]
+        )
+        events = list(client.watch_jobset("q", "js", watch=False))
+        assert any(
+            e["type"] == "SubmitJob" and e.get("job_id") == ids[0]
+            for e in events
+        )
+        assert plane.event_index.read_from("q", "js", 0)
+    finally:
+        plane.stop()
