@@ -382,11 +382,14 @@ class Runner:
         # Seed historical rows before the measured window (the reference
         # populates historical job data before starting actors).
         if cfg.seed_jobs:
-            seeded = 0
+            seeded = batch_i = 0
             while seeded < cfg.seed_jobs:
                 n = min(cfg.batch, cfg.seed_jobs - seeded)
-                self.backend.submit_batch(self._queue(seeded), "bs-seed", n, cfg)
+                # Rotate batches across every queue (indexing by job count
+                # skips queues whenever batch % queues == 0).
+                self.backend.submit_batch(self._queue(batch_i), "bs-seed", n, cfg)
                 seeded += n
+                batch_i += 1
         threads = [
             threading.Thread(target=self._ingest_actor, args=(i,), daemon=True)
             for i in range(cfg.ingest_actors)

@@ -76,6 +76,11 @@ class GangDefinition:
     (configuration.GangDefinition, configuration.go:449-456)."""
 
     size: int = 1
+    # Carried for config parity; price-neutral by construction here AND in
+    # the reference: the synthetic gang job's class only sets the bind
+    # priority in the pricer's scratch state, and member fit always reads
+    # the evicted-priority row, which subtracts every bound job regardless
+    # of priority (node_scheduler.go:53, gang_pricer.go:181).
     priority_class: str = ""
     resources: dict = field(default_factory=dict)  # {resource: quantity}
     node_uniformity: str = ""

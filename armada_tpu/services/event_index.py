@@ -36,6 +36,12 @@ class EventStreamIndex:
         # Serializes concurrent sync() callers (every watcher thread pumps
         # the view); the sink stays idempotent regardless.
         self._sync_lock = threading.Lock()
+        # Log offset below which the index cannot prove completeness for
+        # keys it (re-)created after a retention prune: set by prune(),
+        # consulted by offsets_from. A key holding offsets from BEFORE the
+        # watermark provably survived every prune, so it stays
+        # authoritative from zero.
+        self._pruned_through = 0
 
     # ---- pipeline stages ----
 
@@ -91,6 +97,15 @@ class EventStreamIndex:
             bucket = self._streams.get((queue, jobset))
             if bucket is None:
                 return None
+            if cursor < self._pruned_through and (
+                not bucket or bucket[0] >= self._pruned_through
+            ):
+                # The key only has post-prune offsets, so it may be a
+                # re-created jobset whose earlier history was pruned; the
+                # log, not the index, must answer reads from before the
+                # watermark. (A genuinely-new jobset pays one log scan
+                # until its watcher advances past the watermark.)
+                return None
             i = bisect.bisect_left(bucket, cursor)
             return list(bucket[i : i + limit])
 
@@ -119,4 +134,8 @@ class EventStreamIndex:
             for key in stale:
                 self._streams.pop(key, None)
                 self._last_activity.pop(key, None)
+            if stale:
+                self._pruned_through = max(
+                    self._pruned_through, self._pipeline.cursor
+                )
             return len(stale)

@@ -473,9 +473,16 @@ class ApiServer:
                     batch = self.event_index.read_from(
                         queue, jobset, cursor, 1000
                     )
-                if batch is None:
+                if batch is not None:
+                    # Index path: the cursor advances only over this
+                    # jobset's own offsets.
+                    if batch:
+                        cursor = batch[-1][0] + 1
+                else:
                     # No index, or the jobset aged out of it (retention):
                     # the log is the source of truth, scan it directly.
+                    # The cursor advances past every scanned entry,
+                    # matching or not — never rewound to the last match.
                     batch = []
                     for entry in self.log.read(cursor, 1000):
                         cursor = entry.offset + 1
@@ -483,7 +490,6 @@ class ApiServer:
                         if seq.queue == queue and seq.jobset == jobset:
                             batch.append((entry.offset, seq))
                 for offset, seq in batch:
-                    cursor = offset + 1
                     for event in seq.events:
                         payload = {
                             "type": type(event).__name__,

@@ -168,6 +168,7 @@ def refresh_job_bids(
     jobdb,
     snapshot: BidPriceSnapshot,
     previous: BidPriceSnapshot | None,
+    new_job_ids=(),
 ) -> int:
     """Apply a new snapshot to the job store: only jobs whose (queue, band)
     price actually changed are touched (scheduler.go:542-577). Returns the
@@ -176,21 +177,29 @@ def refresh_job_bids(
     place — the spec object is shared with API threads serializing job
     details); JobSpec.bid_price resolves the phase at snapshot build time."""
     changed = snapshot.changed_price_keys(previous)
-    if not changed:
+    if not changed and not new_job_ids:
         return 0
     txn = jobdb.write_txn()
     changed_queues = {queue for queue, _ in changed}
     # Indexed walk: queued jobs per changed queue + the leased set — never
-    # the whole store (terminal jobs need no re-pricing).
+    # the whole store (terminal jobs need no re-pricing). `new_job_ids`
+    # (jobs submitted since the last refresh, tracked by the caller) are
+    # priced from the current snapshot regardless of the diff, or a job
+    # arriving under stable prices would sort at bid 0 forever.
     candidates = [
         job
         for queue in changed_queues
         for job in txn.queued_jobs(queue, sort=False)
     ] + [job for job in txn.leased_jobs() if job.queue in changed_queues]
+    seen = {job.id for job in candidates}
+    for job_id in new_job_ids:
+        job = txn.get(job_id)
+        if job is not None and not job.state.terminal and job.id not in seen:
+            candidates.append(job)
     updated = []
     for job in candidates:
         key = (job.queue, job_price_band(job.spec))
-        if key not in changed:
+        if key not in changed and job.spec.bid_prices:
             continue
         bids = snapshot.bids.get(key)
         if bids is None:

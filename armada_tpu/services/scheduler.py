@@ -103,6 +103,9 @@ class SchedulerService:
         # a restarted leader re-fetches).
         self.bid_price_provider = bid_price_provider
         self._bid_snapshot = None
+        # Jobs submitted since the last bid refresh: priced from the
+        # current snapshot even when no (queue, band) key changed.
+        self._unpriced_jobs: set[str] = set()
         self.ingester.sync()  # restore jobdb + event-sourced settings
         from ..utils.logging import get_logger
 
@@ -119,15 +122,24 @@ class SchedulerService:
         """State-transition metrics with time-in-previous-state
         (metrics/state_metrics.go): called before each event applies, so
         the previous state's entry time is still on the record."""
-        m = self.metrics
-        if m is None or m.registry is None:
-            return
         from ..events import (
             JobErrors as _JE,
             JobRunLeased as _JRL,
             JobRunRunning as _JRR,
             JobSucceeded as _JS,
+            SubmitJob as _SJ,
         )
+
+        if (
+            isinstance(event, _SJ)
+            and self.bid_price_provider is not None
+            and self.config.market_driven
+            and event.job is not None
+        ):
+            self._unpriced_jobs.add(event.job.id)
+        m = self.metrics
+        if m is None or m.registry is None:
+            return
 
         name, transition, since = None, None, None
         job = txn.get(getattr(event, "job_id", "")) if hasattr(event, "job_id") else None
@@ -345,7 +357,10 @@ class SchedulerService:
                 "bid price fetch failed, keeping previous snapshot: %r", e
             )
             return
-        updated = refresh_job_bids(self.jobdb, snapshot, self._bid_snapshot)
+        new_ids, self._unpriced_jobs = self._unpriced_jobs, set()
+        updated = refresh_job_bids(
+            self.jobdb, snapshot, self._bid_snapshot, new_job_ids=new_ids
+        )
         if updated:
             self.log_.with_fields(cycle=self.cycle_count, jobs=updated).info(
                 "re-priced jobs from bid snapshot %s", snapshot.id

@@ -135,7 +135,27 @@ def test_event_index_retention_prune():
     # Pruned jobset reads as unknown (None), NOT empty: watchers fall back
     # to the log, which still holds the history.
     assert index.read_from("q", "old", 0) is None
+    # A surviving jobset has pre-watermark offsets, so it stays
+    # authoritative from zero.
     assert len(index.read_from("q", "new", 0)) == 2
+
+
+def test_event_index_pruned_then_recreated_jobset_defers_to_log():
+    log = InMemoryEventLog()
+    index = EventStreamIndex(log)
+    submit(log, "q", "js", "j0", created=10.0)
+    index.sync()
+    assert index.prune(older_than=50.0) == 1
+    # The jobset comes back to life: the index re-creates the key with
+    # only the new offset...
+    submit(log, "q", "js", "j1", created=100.0)
+    index.sync()
+    # ...so a read from before the prune watermark must defer to the log
+    # (None), never serve an amputated history.
+    assert index.read_from("q", "js", 0) is None
+    # Reads past the watermark serve from the index.
+    later = index.read_from("q", "js", index._pruned_through)
+    assert later is not None and len(later) == 1
 
 
 def test_watch_uses_index_end_to_end():

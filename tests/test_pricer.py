@@ -390,6 +390,21 @@ def test_refresh_job_bids_touches_only_changed_keys():
     assert txn2.get("b").spec.bid_prices == {"default": (9.0, 9.5)}
     assert txn2.get("a").spec.bid_prices == {"default": (2.0, 2.5)}
 
+    # A job submitted under STABLE prices (no changed keys) must still be
+    # priced — callers pass it via new_job_ids.
+    j_c = JobSpec(
+        id="c", queue="q",
+        requests={"cpu": "1"},
+        annotations={"armadaproject.io/priceBand": "A"},
+    )
+    txn3 = db.write_txn()
+    txn3.upsert(Job(spec=j_c))
+    txn3.commit()
+    third = BidPriceSnapshot(id="3", timestamp=2.0, bids=second.bids)
+    assert refresh_job_bids(db, third, second) == 0  # not known as new
+    assert refresh_job_bids(db, third, second, new_job_ids=["c"]) == 1
+    assert db.read_txn().get("c").spec.bid_prices == {"default": (2.0, 2.5)}
+
 
 # ---- scheduler integration --------------------------------------------------
 

@@ -59,14 +59,20 @@ def test_churn_soak():
             n = int(rng.integers(1, 5))
             jobs = []
             gang = None
+            # Gang members must agree on priority class (the submit-side
+            # member-agreement validator mirrors gang_validator.go); pin
+            # one class per gang, randomize only for singletons.
+            gang_pc = None
             if rng.random() < 0.2:
                 gang = Gang(id=f"soak-gang-{step}", cardinality=n)
+                gang_pc = str(rng.choice(["low", "low", "high"]))
             for _ in range(n):
                 jobs.append(
                     JobSpec(
                         id=f"soak-{jid:05d}",
                         queue=q,
-                        priority_class=str(rng.choice(["low", "low", "high"])),
+                        priority_class=gang_pc
+                        or str(rng.choice(["low", "low", "high"])),
                         requests={
                             "cpu": str(int(rng.choice([1, 2, 4]))),
                             "memory": f"{int(rng.choice([1, 2]))}Gi",

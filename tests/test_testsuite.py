@@ -126,11 +126,16 @@ def test_broadside(plane, capsys):
 
     from armada_tpu.clients.broadside import main
 
-    rc = main(["--server", plane.address, "--duration", "2",
+    rc = main(["--backend", "grpc", "--server", plane.address,
+               "--duration", "2",
                "--ingest-actors", "1", "--query-actors", "2", "--batch", "5"])
     out = capsys.readouterr().out.strip().splitlines()[-1]
     report = json.loads(out)
-    assert rc == 0 and report["errors"] == 0
+    assert rc == 0 and report["backend"] == "grpc"
+    assert all(
+        report[op]["errors"] == 0
+        for op in ("ingest", "get_jobs", "group_jobs", "job_details")
+    )
     assert report["ingest"]["ops"] > 0
     assert report["get_jobs"]["ops"] > 0
 
