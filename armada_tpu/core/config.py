@@ -204,10 +204,17 @@ class SchedulingConfig:
     )
 
     def resource_factory(self) -> ResourceListFactory:
-        return ResourceListFactory.create(
-            [(t.name, t.resolution) for t in self.supported_resource_types],
-            [(t.name, t.resolution) for t in self.floating_resources],
-        )
+        # One factory per config instance: spec-object row caches are
+        # tagged by factory serial, so a fresh factory per snapshot would
+        # defeat them (and factories are immutable anyway).
+        cached = self.__dict__.get("_factory")
+        if cached is None:
+            cached = ResourceListFactory.create(
+                [(t.name, t.resolution) for t in self.supported_resource_types],
+                [(t.name, t.resolution) for t in self.floating_resources],
+            )
+            object.__setattr__(self, "_factory", cached)
+        return cached
 
     def priority_class(self, name: str | None) -> PriorityClass:
         """Resolve a priority-class name, falling back to the default class

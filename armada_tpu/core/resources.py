@@ -121,6 +121,9 @@ def _resolution_to_scale(resolution) -> int:
     return math.floor(math.log10(float(r)))
 
 
+_factory_serial = 0
+
+
 @dataclass(frozen=True)
 class ResourceListFactory:
     """Fixed resource-name vocabulary with exact int64 host encoding.
@@ -136,6 +139,9 @@ class ResourceListFactory:
     # True for pool-level floating resources (not attached to nodes).
     floating: tuple[bool, ...] = ()
     name_to_index: dict[str, int] = field(default_factory=dict)
+    # Process-unique id tagging rows cached on spec objects (see
+    # encode_cached_batch); id() is unsafe across GC reuse.
+    serial: int = 0
 
     @staticmethod
     def create(
@@ -163,11 +169,14 @@ class ResourceListFactory:
                 # Default: keep cpu-like milli resources as-is; compress
                 # byte-like resources (scale 0 with huge ranges) to ~Mi.
                 divisors.append(1 if scale != 0 else _default_divisor(name))
+        global _factory_serial
+        _factory_serial += 1
         factory = ResourceListFactory(
             names=tuple(names),
             scales=tuple(scales),
             device_divisor=tuple(divisors),
             floating=tuple(floating_flags),
+            serial=_factory_serial,
         )
         factory.name_to_index.update({n: i for i, n in enumerate(names)})
         return factory
@@ -232,6 +241,37 @@ class ResourceListFactory:
             rows[j] = i
         parsed = self._encode_unique(uniq_reqs, ceil=ceil)
         return parsed[rows] if J else np.zeros((0, R), dtype=np.int64)
+
+    def encode_cached_batch(self, objs: list, get, *, ceil: bool, tag: str) -> np.ndarray:
+        """encode_requests_batch with a per-object row cache.
+
+        The scheduler re-snapshots the same JobSpec/NodeSpec objects every
+        cycle; their encoded rows never change, so each object carries its
+        row (stored via object.__setattr__ — the spec dataclasses are
+        frozen but not slotted), tagged with (factory serial, ceil, tag) so
+        a different factory or rounding mode never reads a stale row. Warm
+        cycles skip all quantity parsing: cost is one dict probe per
+        object. `get(obj)` returns the {name: quantity} dict for misses."""
+        J = len(objs)
+        rows = np.empty((J, self.num_resources), dtype=np.int64)
+        want = (self.serial, ceil, tag)
+        misses: list = []
+        miss_at: list = []
+        for j, obj in enumerate(objs):
+            cached = obj.__dict__.get("_enc_row")
+            if cached is not None and cached[0] == want:
+                rows[j] = cached[1]
+            else:
+                misses.append(obj)
+                miss_at.append(j)
+        if misses:
+            enc = self.encode_requests_batch(
+                [get(o) for o in misses], ceil=ceil
+            )
+            for k, obj in enumerate(misses):
+                rows[miss_at[k]] = enc[k]
+                object.__setattr__(obj, "_enc_row", (want, enc[k]))
+        return rows
 
     def _encode_unique(self, requests: list, *, ceil: bool) -> np.ndarray:
         U = len(requests)

@@ -222,8 +222,8 @@ def build_round_snapshot(
     )
 
     # --- node tensors ---
-    node_total = factory.encode_requests_batch(
-        [n.total_resources for n in nodes], ceil=False
+    node_total = factory.encode_cached_batch(
+        nodes, lambda n: n.total_resources, ceil=False, tag="node"
     )
     # Floating resources are not node resources: node-fit arithmetic uses
     # requests with floating columns zeroed (job_req_fit), so node tensors
@@ -256,7 +256,11 @@ def build_round_snapshot(
 
     # --- job table ---
     J = len(jobs)
-    job_req = factory.encode_requests_batch([j.requests for j in jobs], ceil=True)
+    # Row-cached on the spec objects: warm cycles (same jobs re-snapshotted)
+    # skip quantity parsing entirely.
+    job_req = factory.encode_cached_batch(
+        jobs, lambda j: j.requests, ceil=True, tag="req"
+    )
     job_tolerated = np.zeros((J, taint_vocab.n_words), dtype=np.uint32)
     job_selector = np.zeros((J, label_vocab.n_words), dtype=np.uint32)
     job_possible = np.ones(J, dtype=bool)
@@ -579,7 +583,7 @@ def build_round_snapshot(
         affinity_allowed=affinity_allowed,
         job_gang=job_gang,
         job_gang_id=[j.gang.id if j.gang is not None else "" for j in jobs],
-        job_pc_name=[config.priority_class(j.priority_class).name for j in jobs],
+        job_pc_name=pc_names_per_job,
         job_bid=job_bid,
         gang_queue=gang_queue,
         gang_card=gang_card,

@@ -121,8 +121,9 @@ def referenced_label_keys(
 ) -> set[str]:
     keys = {node_id_label}
     for job in jobs:
-        keys.update(job.node_selector.keys())
-        if job.gang and job.gang.node_uniformity_label:
+        if job.node_selector:
+            keys.update(job.node_selector.keys())
+        if job.gang is not None and job.gang.node_uniformity_label:
             keys.add(job.gang.node_uniformity_label)
     if extra:
         keys.update(extra)

@@ -1,0 +1,145 @@
+"""Kernel <-> oracle parity at realistic scale (hundreds of nodes,
+hundreds-to-thousands of jobs) — tie-breaking and ordering bugs that tiny
+2-8-node scenarios (test_kernel_parity.rand_scenario) cannot expose:
+resolution-rounded best-fit key collisions across many near-identical
+nodes, deep queue interleavings, protected-share boundaries under load.
+
+The default run covers 128-256 nodes; set ARMADA_TPU_BIG_PARITY=1 to add
+a 1000-node x 2000-job sweep (several minutes of oracle time — the oracle
+is deliberately sequential Python).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import (
+    Gang,
+    JobSpec,
+    NodeSpec,
+    QueueSpec,
+    RunningJob,
+    Taint,
+    Toleration,
+)
+from tests.test_kernel_parity import assert_parity
+
+CFG = SchedulingConfig(
+    priority_classes={
+        "high": PriorityClass("high", 30000, preemptible=False),
+        "low": PriorityClass("low", 1000, preemptible=True),
+    },
+    default_priority_class="low",
+    protected_fraction_of_fair_share=0.5,
+)
+
+
+def big_scenario(seed, n_nodes, n_jobs, n_queues=6, running_fraction=0.3):
+    """Production-shaped population: few node flavors (so best-fit keys
+    collide constantly), mixed selectors/taints/gangs, a running base load."""
+    rng = np.random.default_rng(seed)
+    nodes = []
+    for i in range(n_nodes):
+        flavor = i % 3
+        cpu = [16, 32, 64][flavor]
+        labels = {"zone": ["a", "b"][i % 2]}
+        taints = (Taint("special", "true"),) if i % 11 == 0 else ()
+        nodes.append(
+            NodeSpec(
+                id=f"node-{i:05d}",
+                pool="default",
+                total_resources={"cpu": str(cpu), "memory": f"{cpu * 4}Gi"},
+                labels=labels,
+                taints=taints,
+            )
+        )
+    queues = [QueueSpec(f"q{i}", 1.0 + (i % 3)) for i in range(n_queues)]
+
+    running = []
+    jid = 0
+    n_running = int(n_nodes * running_fraction)
+    for i in range(n_running):
+        node = nodes[int(rng.integers(0, n_nodes))]
+        pc = "low" if rng.random() < 0.8 else "high"
+        running.append(
+            RunningJob(
+                job=JobSpec(
+                    id=f"run-{jid:05d}",
+                    queue=f"q{int(rng.integers(0, n_queues))}",
+                    priority_class=pc,
+                    requests={
+                        "cpu": str(int(rng.choice([2, 4, 8]))),
+                        "memory": f"{int(rng.choice([2, 4, 8]))}Gi",
+                    },
+                    submitted_ts=float(jid),
+                    tolerations=(Toleration(key="special", value="true"),),
+                ),
+                node_id=node.id,
+                scheduled_at_priority=1000 if pc == "low" else 30000,
+            )
+        )
+        jid += 1
+
+    queued = []
+    g = 0
+    while len(queued) < n_jobs:
+        q = f"q{int(rng.integers(0, n_queues))}"
+        cpu = int(rng.choice([1, 2, 4, 8, 16]))
+        kw = {}
+        roll = rng.random()
+        if roll < 0.15:
+            kw["tolerations"] = (Toleration(key="special", value="true"),)
+        elif roll < 0.3:
+            kw["node_selector"] = {"zone": str(rng.choice(["a", "b"]))}
+        if rng.random() < 0.1:
+            card = int(rng.integers(2, 6))
+            gang = Gang(id=f"gang-{g}", cardinality=card)
+            g += 1
+            for _ in range(card):
+                queued.append(
+                    JobSpec(
+                        id=f"job-{jid:05d}",
+                        queue=q,
+                        priority_class="low",
+                        requests={"cpu": str(cpu), "memory": f"{cpu}Gi"},
+                        submitted_ts=float(jid),
+                        gang=gang,
+                        **kw,
+                    )
+                )
+                jid += 1
+        else:
+            queued.append(
+                JobSpec(
+                    id=f"job-{jid:05d}",
+                    queue=q,
+                    priority_class=str(rng.choice(["low", "low", "high"])),
+                    requests={"cpu": str(cpu), "memory": f"{cpu}Gi"},
+                    submitted_ts=float(jid),
+                    **kw,
+                )
+            )
+            jid += 1
+    return nodes, queues, running, queued
+
+
+@pytest.mark.parametrize("seed,n_nodes,n_jobs", [(1, 128, 400), (2, 256, 600)])
+def test_scale_parity(seed, n_nodes, n_jobs):
+    nodes, queues, running, queued = big_scenario(seed, n_nodes, n_jobs)
+    snap, oracle, out = assert_parity(
+        CFG, nodes, queues, running, queued, label=f"scale-{seed}"
+    )
+    # The scenario must actually exercise the machinery at scale.
+    assert oracle.scheduled_mask.sum() > n_jobs * 0.2
+
+
+@pytest.mark.skipif(
+    not os.environ.get("ARMADA_TPU_BIG_PARITY"),
+    reason="1000-node sweep: minutes of sequential oracle time; "
+    "set ARMADA_TPU_BIG_PARITY=1",
+)
+def test_thousand_node_parity():
+    nodes, queues, running, queued = big_scenario(7, 1000, 2000, n_queues=10)
+    assert_parity(CFG, nodes, queues, running, queued, label="scale-1000")
