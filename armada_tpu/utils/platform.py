@@ -45,9 +45,36 @@ def _probe_once(timeout: float) -> tuple[str | None, str]:
     return None, f"probe exited rc={proc.returncode}: {' | '.join(tail)}"
 
 
+def enable_persistent_compile_cache(path: str | None = None):
+    """Cache compiled XLA executables on disk: the solver kernel compiles
+    in minutes per padded shape on TPU, and every fresh process (bench,
+    services, driver runs) would otherwise pay it again. Safe to call
+    before or after backend selection; idempotent."""
+    import jax
+
+    if path is None:
+        path = os.environ.get(
+            "ARMADA_TPU_COMPILE_CACHE",
+            os.path.join(
+                os.environ.get(
+                    "REPO_ROOT", os.path.dirname(os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))
+                    ))
+                ),
+                ".jax_cache",
+            ),
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # never let cache config break the solve
+        print(f"[platform] compile cache disabled: {e!r}")
+
+
 def ensure_healthy_backend(probe_timeout: float = 120.0, retries: int = 1) -> str:
     """Returns the platform that will be used ("axon"/"tpu"/"cpu")."""
     global last_probe_report
+    enable_persistent_compile_cache()
     want = os.environ.get("JAX_PLATFORMS", "")
     if want and "cpu" in want.split(","):
         _force_cpu()
