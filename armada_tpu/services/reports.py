@@ -21,6 +21,10 @@ class QueueReport:
     actual_share: float = 0.0
     scheduled_jobs: int = 0
     preempted_jobs: int = 0
+    # Unschedulable-reason histogram for this queue's jobs in the round
+    # (the reference's queue report surfaces per-job context samples;
+    # an aggregated view scales to 1M-job rounds).
+    top_reasons: dict = field(default_factory=dict)  # reason -> count
 
 
 @dataclass
@@ -34,6 +38,9 @@ class RoundReport:
     spot_price: float | None = None  # market mode
     queues: dict = field(default_factory=dict)  # queue -> QueueReport
     job_reasons: dict = field(default_factory=dict)  # job_id -> reason
+    # Per-job success context (jctx detail: node + priority), bounded by
+    # the round's scheduling burst.
+    job_contexts: dict = field(default_factory=dict)  # job_id -> context str
     # Market mode: indicative gang prices by configured shape name
     # (solver.pricer.GangPricingResult per shape).
     indicative_prices: dict = field(default_factory=dict)
@@ -85,6 +92,8 @@ class SchedulingReportsRepository:
             self.by_pool[report.pool] = report
             for job_id, reason in report.job_reasons.items():
                 self._job_reports[job_id] = (report.finished, reason)
+            for job_id, context in report.job_contexts.items():
+                self._job_reports[job_id] = (report.finished, context)
             if len(self._job_reports) > self._retained:
                 oldest = sorted(self._job_reports.items(), key=lambda kv: kv[1][0])
                 for job_id, _ in oldest[: len(oldest) // 2]:
@@ -106,8 +115,13 @@ class SchedulingReportsRepository:
                 parts.append(
                     f"pool {pool}: fairShare={r.fair_share:.4f} "
                     f"adjustedFairShare={r.adjusted_fair_share:.4f} "
-                    f"actualShare={r.actual_share:.4f}"
+                    f"actualShare={r.actual_share:.4f} "
+                    f"scheduled={r.scheduled_jobs} preempted={r.preempted_jobs}"
                 )
+                for reason, count in sorted(
+                    r.top_reasons.items(), key=lambda kv: -kv[1]
+                )[:5]:
+                    parts.append(f"  {count} jobs: {reason}")
         return "\n".join(parts) or f"no reports for queue {queue}"
 
     def job_report(self, job_id: str) -> str:

@@ -948,6 +948,27 @@ class SchedulerService:
                 for j in range(snap.num_jobs)
                 if reasons[j]
             }
+            # Per-queue unschedulable-reason histogram (queue report depth).
+            for j in range(snap.num_jobs):
+                if not reasons[j]:
+                    continue
+                q = int(snap.job_queue[j])
+                if q < 0:
+                    continue
+                qr = report.queues.get(snap.queue_names[q])
+                if qr is not None:
+                    qr.top_reasons[reasons[j]] = (
+                        qr.top_reasons.get(reasons[j], 0) + 1
+                    )
+        # Per-job success contexts: bounded by the burst cap, so this stays
+        # cheap even in 1M-job rounds (the reference's jctx detail,
+        # reports/repository.go job reports).
+        for j in np.flatnonzero(result["scheduled_mask"]):
+            report.job_contexts[snap.job_ids[int(j)]] = (
+                f"scheduled: pool={pool} "
+                f"node={snap.node_ids[int(result['assigned_node'][int(j)])]} "
+                f"priority={int(result['scheduled_priority'][int(j)])}"
+            )
         self.reports.record(report)
 
         if self.metrics is not None and self.metrics.registry is not None:

@@ -1,0 +1,68 @@
+"""Asyncio client against a live in-process control plane
+(client/python asyncio_client.py parity: same surface as the sync client,
+multiplexed watches on one loop)."""
+
+import asyncio
+
+import pytest
+
+from armada_tpu.clients.aio import AsyncApiClient
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.services.server import ControlPlane
+
+
+@pytest.fixture
+def plane():
+    plane = ControlPlane(
+        SchedulingConfig(),
+        grpc_port=0,
+        cycle_period=0.2,
+        fake_executors=[{"name": "fx", "nodes": 2, "cpu": "8"}],
+    )
+    plane.start()
+    yield plane
+    plane.stop()
+
+
+def test_async_client_end_to_end(plane):
+    async def run():
+        client = AsyncApiClient(f"127.0.0.1:{plane.grpc_port}")
+        try:
+            await client.create_queue("aq", priority_factor=2.0)
+            queues = await client.list_queues()
+            assert any(q["name"] == "aq" for q in queues)
+            ids = await client.submit_jobs(
+                "aq", "ajs", [{"requests": {"cpu": "1", "memory": "1Gi"}}] * 2
+            )
+            assert len(ids) == 2
+
+            # Two watches multiplexed on one loop: both see the submits.
+            async def first_events(n):
+                events = []
+                async for e in client.watch_jobset("aq", "ajs", watch=False):
+                    events.append(e)
+                    if len(events) >= n:
+                        break
+                return events
+
+            ev1, ev2 = await asyncio.gather(first_events(2), first_events(2))
+            assert {e["type"] for e in ev1} == {"SubmitJob"}
+            assert {e["type"] for e in ev2} == {"SubmitJob"}
+
+            # The query view catches up on the next scheduler cycle.
+            rows = {"total": 0}
+            for _ in range(50):
+                rows = await client.get_jobs(
+                    filters=[{"field": "queue", "value": "aq"}], take=10
+                )
+                if rows["total"] == 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert rows["total"] == 2
+            await client.cancel_jobs("aq", "ajs", job_ids=[ids[0]])
+            report = await client.scheduling_report()
+            assert isinstance(report, str)
+        finally:
+            await client.close()
+
+    asyncio.run(run())

@@ -104,7 +104,15 @@ def test_scheduling_report(client, plane):
     client.submit_jobs("team", "set3", [dict(JOB) for _ in range(2)])
     assert _wait(lambda: "team" in client.scheduling_report())
     report = client.queue_report("team")
-    assert "fairShare" in report
+    assert "fairShare" in report and "scheduled=" in report
+    # Per-job success context (reports/repository.go job reports): a
+    # scheduled job's report names its node and priority.
+    jobs = client.get_jobs(filters=[{"field": "queue", "value": "team"}])
+    assert _wait(
+        lambda: "scheduled: pool=" in client.job_report(
+            jobs["jobs"][0]["job_id"]
+        )
+    )
 
 
 def test_submit_checker_rejects_impossible():
