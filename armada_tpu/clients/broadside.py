@@ -176,9 +176,12 @@ class InprocBackend:
                 ],
             )
         )
-        n_succeed = int(n * cfg.succeed_fraction)
-        n_fail = int(n * cfg.fail_fraction)
-        n_cancel = int(n * cfg.cancel_fraction)
+        # Clamp the ranges to be disjoint: succeed takes the head, fail the
+        # next slice, cancel the tail — fractions summing past 1 must not
+        # emit conflicting terminal events for one job id.
+        n_succeed = min(int(n * cfg.succeed_fraction), n)
+        n_fail = min(int(n * cfg.fail_fraction), n - n_succeed)
+        n_cancel = min(int(n * cfg.cancel_fraction), n - n_succeed - n_fail)
         leases = [
             JobRunLeased(
                 created=now,

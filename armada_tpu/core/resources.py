@@ -270,7 +270,10 @@ class ResourceListFactory:
             )
             for k, obj in enumerate(misses):
                 rows[miss_at[k]] = enc[k]
-                object.__setattr__(obj, "_enc_row", (want, enc[k]))
+                # Copy: enc[k] is a view whose base is the full [misses, R]
+                # batch; caching the view would pin the whole batch in
+                # memory for as long as any one job object lives.
+                object.__setattr__(obj, "_enc_row", (want, enc[k].copy()))
         return rows
 
     def _encode_unique(self, requests: list, *, ceil: bool) -> np.ndarray:

@@ -150,18 +150,28 @@ class JobSpec:
     bid_prices: dict = field(default_factory=dict)
 
     def bid_price(self, pool: str, *, running: bool = False) -> float:
-        """Bid for this pool; malformed or non-finite user-supplied values
-        count as 0 (one bad annotation must not abort scheduling rounds or
-        poison price ordering). Values may be scalars or (queued, running)
-        phase pairs as written by the bid-price provider
-        (pricing.Bid / jobdb job.getBidPrice phase selection)."""
+        """Bid for this pool's given phase (see bid_price_pair)."""
+        pair = self.bid_price_pair(pool)
+        return pair[1] if running else pair[0]
+
+    def bid_price_pair(self, pool: str) -> tuple[float, float]:
+        """(queued, running) bids for this pool in one key lookup — the
+        snapshot builder needs both phases per job (post-round pricing
+        reads running-phase bids for just-leased jobs). Malformed or
+        non-finite user-supplied values count as 0 (one bad annotation
+        must not abort scheduling rounds or poison price ordering).
+        Values may be scalars or (queued, running) phase pairs as written
+        by the bid-price provider (pricing.Bid / jobdb job.getBidPrice
+        phase selection)."""
         for key in (pool, ""):
             if key in self.bid_prices:
                 v = self.bid_prices[key]
                 if isinstance(v, (tuple, list)) and len(v) == 2:
-                    v = v[1] if running else v[0]
-                return _clean_price(v)
-        return _clean_price(self.annotations.get("armadaproject.io/bidPrice", 0.0))
+                    return _clean_price(v[0]), _clean_price(v[1])
+                p = _clean_price(v)
+                return p, p
+        p = _clean_price(self.annotations.get("armadaproject.io/bidPrice", 0.0))
+        return p, p
 
     def with_(self, **kw) -> "JobSpec":
         return replace(self, **kw)

@@ -137,7 +137,14 @@ class ExternalBidPriceService:
             for band in PRICE_BANDS.values():
                 per_pool = {}
                 for pool, band_bids in pool_bids.items():
-                    bb = band_bids.get(band, band_bids.get(PRICE_BAND_NAMES[band]))
+                    # Probe int key, JSON-stringified int key (this repo's
+                    # gRPC encoding stringifies int dict keys), then name.
+                    bb = band_bids.get(
+                        band,
+                        band_bids.get(
+                            str(band), band_bids.get(PRICE_BAND_NAMES[band])
+                        ),
+                    )
                     fb = fallback.get(queue, {}).get(pool, {})
                     queued = _phase(bb, fb, "queued")
                     running = _phase(bb, fb, "running")

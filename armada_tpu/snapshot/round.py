@@ -98,6 +98,12 @@ class RoundSnapshot:
     job_pc_name: list
     # Market mode: bid price per job for this snapshot's pool.
     job_bid: np.ndarray  # float64[J]
+    # Running-phase bid per job (== job_bid for already-running jobs).
+    # Consumers that price the POST-round cluster (solver/pricer.py) use
+    # this for jobs the round just scheduled: the reference reads
+    # job.GetBidPrice on the post-round jobdb, where a just-leased job
+    # resolves to its running-phase bid.
+    job_bid_running: np.ndarray  # float64[J]
 
     # --- gangs (every job belongs to exactly one; singletons common) ---
     gang_queue: np.ndarray  # int32[G]
@@ -324,24 +330,27 @@ def build_round_snapshot(
     jts = np.asarray([j.submitted_ts for j in jobs], dtype=np.float64)
     jids = np.asarray([j.id for j in jobs])
     # Bid prices only matter in market mode; skip 1M python calls otherwise.
-    job_bid = (
-        np.asarray(
-            [
-                j.bid_price(pool, running=bool(job_is_running[i]))
-                for i, j in enumerate(jobs)
-            ],
-            dtype=np.float64,
-        )
-        if config.market_driven
-        else np.zeros(J, dtype=np.float64)
-    )
     if config.market_driven:
-        # Running non-preemptible jobs carry an effectively infinite price
-        # (pricing.NonPreemptibleRunningPrice): they always win rescheduling.
+        # One pass, both phases: the scheduling order needs the job's
+        # current-phase bid; post-round pricing needs the running-phase
+        # bid every queued job would carry once leased.
+        pairs = np.asarray(
+            [j.bid_price_pair(pool) for j in jobs], dtype=np.float64
+        ).reshape(J, 2)
+        job_bid = np.where(job_is_running, pairs[:, 1], pairs[:, 0])
+        job_bid_running = pairs[:, 1]
+        # Non-preemptible jobs carry an effectively infinite price once
+        # running (pricing.NonPreemptibleRunningPrice): they always win
+        # rescheduling. The running-phase array applies it to EVERY
+        # non-preemptible job — in the post-round view a just-leased
+        # non-preemptible job is running too.
         job_bid = np.where(
             job_is_running & ~job_preemptible,
             NON_PREEMPTIBLE_RUNNING_PRICE,
             job_bid,
+        )
+        job_bid_running = np.where(
+            ~job_preemptible, NON_PREEMPTIBLE_RUNNING_PRICE, job_bid_running
         )
         # MarketJobPriorityComparer (comparison.go MarketSchedulingOrderCompare):
         # priority-class priority first, then highest bid, then running jobs
@@ -354,6 +363,8 @@ def build_round_snapshot(
         ts_key = np.where(job_is_running, leased_ts, jts)
         perm = np.lexsort((jids, ts_key, running_rank, -job_bid, -job_pc_priority))
     else:
+        job_bid = np.zeros(J, dtype=np.float64)
+        job_bid_running = job_bid
         perm = np.lexsort((jids, jts, jprio))
     job_order = np.empty(J, dtype=np.int64)
     job_order[perm] = np.arange(J)
@@ -585,6 +596,7 @@ def build_round_snapshot(
         job_gang_id=[j.gang.id if j.gang is not None else "" for j in jobs],
         job_pc_name=pc_names_per_job,
         job_bid=job_bid,
+        job_bid_running=job_bid_running,
         gang_queue=gang_queue,
         gang_card=gang_card,
         gang_member_offsets=gang_member_offsets,

@@ -78,6 +78,10 @@ class _NodeState:
         # (AllocatableByPriority[EvictedPriority], node_scheduler.go:53).
         self.free0 = snap.allocatable[0].copy()  # int64 [N, R]
         self.req_fit = snap.job_req_fit()
+        # Eviction prices: the reference reads job.GetBidPrice on the
+        # POST-round jobdb, where a job this round just leased resolves to
+        # its running-phase bid — so re-resolve those here.
+        self.bid = snap.job_bid.copy()
         node_of = snap.job_node.copy()
         if result is not None:
             assigned = np.asarray(result["assigned_node"])
@@ -87,6 +91,7 @@ class _NodeState:
             for j in np.flatnonzero(scheduled):
                 self.free0[int(assigned[j])] -= self.req_fit[j]
                 node_of[j] = int(assigned[j])
+                self.bid[j] = snap.job_bid_running[j]
             for j in np.flatnonzero(snap.job_is_running):
                 if preempted[j]:
                     # Preempted: capacity returns, job leaves the node.
@@ -103,7 +108,7 @@ class _NodeState:
         # Eviction order (bid asc, job id asc) applied globally once;
         # per-node slices inherit it.
         ids = np.asarray([snap.job_ids[j] for j in bound])
-        order = np.lexsort((ids, snap.job_bid[bound])) if len(bound) else []
+        order = np.lexsort((ids, self.bid[bound])) if len(bound) else []
         bound = bound[order] if len(bound) else bound
         self.node_jobs: list[list[int]] = [[] for _ in range(snap.num_nodes)]
         for j in bound:
@@ -268,7 +273,7 @@ def _price_on_group(snap, state, nodes, req_fit, size) -> float | None:
         feasible = first < LARGE
         if not feasible.any():
             return None
-        price = np.where(feasible, snap.job_bid[flat[first % total]], np.inf)
+        price = np.where(feasible, state.bid[flat[first % total]], np.inf)
         order = np.lexsort((rank, price))
         g = int(order[0])
         k = int(first[g] - starts[g]) + 1

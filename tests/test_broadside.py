@@ -64,3 +64,32 @@ def test_runner_report_shape():
     assert report["ingest"]["ops"] > 0 and report["ingest"]["units"] > 0
     assert report["get_jobs"]["ops"] > 0
     json.dumps(report)  # must be JSON-serializable as emitted by the CLI
+
+
+def test_overlapping_fractions_stay_disjoint():
+    # succeed+fail+cancel fractions summing past 1 must not emit
+    # conflicting terminal events for one job id.
+    from armada_tpu.clients.broadside import InprocBackend
+
+    cfg = BroadsideConfig(
+        batch=10, succeed_fraction=0.8, fail_fraction=0.5, cancel_fraction=0.5
+    )
+    backend = InprocBackend()
+    try:
+        backend.submit_batch("q-frac", "js-frac", 10, cfg)
+        seen = {}
+        for entry in backend.log.read(0, 10_000):
+            for ev in entry.sequence.events:
+                kind = type(ev).__name__
+                if kind in ("JobSucceeded", "JobErrors", "CancelJob"):
+                    assert ev.job_id not in seen, (
+                        f"{ev.job_id}: {seen[ev.job_id]} then {kind}"
+                    )
+                    seen[ev.job_id] = kind
+        # 8 succeed, fail clamped to 2, cancel clamped to 0.
+        kinds = sorted(seen.values())
+        assert kinds.count("JobSucceeded") == 8
+        assert kinds.count("JobErrors") == 2
+        assert kinds.count("CancelJob") == 0
+    finally:
+        backend.teardown()

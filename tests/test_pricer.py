@@ -447,3 +447,87 @@ def test_scheduler_records_indicative_prices():
     assert report.indicative_prices["small"].price == 0.0
     assert not report.indicative_prices["huge"].schedulable
     assert "indicative gang small" in report.report_string()
+
+
+def test_post_round_eviction_priced_at_running_phase_bid():
+    # A job the round just scheduled must be priced for eviction at its
+    # RUNNING-phase bid: the reference reads job.GetBidPrice on the
+    # post-round jobdb, where a just-leased job resolves as running
+    # (preempting_queue_scheduler.go:637-646 + jobdb getBidPrice).
+    from armada_tpu.solver.reference import ReferenceSolver
+
+    queued = [
+        JobSpec(
+            id="j0",
+            queue="q",
+            requests={"cpu": "8", "memory": "1Gi"},
+            bid_prices={"default": (1.0, 10.0)},  # (queued, running)
+        )
+    ]
+    snap = build_round_snapshot(MKT, "default", [node()], [QueueSpec("q")], [], queued)
+    res = ReferenceSolver(snap).solve()
+    assert res.scheduled_mask[snap.job_ids.index("j0")]
+    result = {
+        "assigned_node": res.assigned_node,
+        "scheduled_mask": res.scheduled_mask,
+        "preempted_mask": res.preempted_mask,
+    }
+    post = price_gangs(
+        snap, {"s": GangDefinition(size=1, resources={"cpu": "8", "memory": "1Gi"})},
+        result=result,
+    )["s"]
+    assert post.schedulable and post.price == 10.0
+
+
+def test_external_bid_service_json_stringified_band_keys():
+    # A response that round-tripped through JSON stringifies int dict keys;
+    # band bids keyed "1" must still resolve, not fall to the fallback.
+    class FakeClient:
+        def retrieve_bids(self):
+            return {
+                "queue_bids": {"q": {"default": {"1": {"queued": 5.0, "running": 6.0}}}},
+                "fallback": {"q": {"default": {"queued": 1.0, "running": 2.0}}},
+            }
+
+    snap = ExternalBidPriceService(FakeClient()).get_bid_prices()
+    assert snap.get_price("q", 1)["default"] == Bid(5.0, 6.0)
+
+
+def test_just_leased_nonpreemptible_priced_at_sentinel():
+    # A queued NON-preemptible job the round just scheduled resolves to
+    # NonPreemptibleRunningPrice in the post-round view (jobdb getBidPrice
+    # returns the sentinel for any non-queued non-preemptible job).
+    from armada_tpu.snapshot.round import NON_PREEMPTIBLE_RUNNING_PRICE
+    from armada_tpu.solver.reference import ReferenceSolver
+
+    cfg = SchedulingConfig(
+        priority_classes={
+            "np": PriorityClass("np", 2000, preemptible=False),
+        },
+        default_priority_class="np",
+        market_driven=True,
+    )
+    queued = [
+        JobSpec(
+            id="j0",
+            queue="q",
+            priority_class="np",
+            requests={"cpu": "8", "memory": "1Gi"},
+            bid_prices={"default": (1.0, 10.0)},
+        )
+    ]
+    snap = build_round_snapshot(cfg, "default", [node()], [QueueSpec("q")], [], queued)
+    res = ReferenceSolver(snap).solve()
+    assert res.scheduled_mask[snap.job_ids.index("j0")]
+    assert snap.job_bid_running[0] == NON_PREEMPTIBLE_RUNNING_PRICE
+    result = {
+        "assigned_node": res.assigned_node,
+        "scheduled_mask": res.scheduled_mask,
+        "preempted_mask": res.preempted_mask,
+    }
+    post = price_gangs(
+        snap, {"s": GangDefinition(size=1, resources={"cpu": "8", "memory": "1Gi"})},
+        result=result,
+    )["s"]
+    # Only eviction candidate is the sentinel-priced job.
+    assert post.schedulable and post.price == NON_PREEMPTIBLE_RUNNING_PRICE
