@@ -96,6 +96,10 @@ class DeviceRound:
     # (including itself) holding identical batchable singleton gangs — same
     # queue + scheduling key, no per-job anti-affinity. 0 = not batchable.
     slot_run_len: np.ndarray  # int32[S]
+    # Fast-fill batchability per slot (heterogeneous window fill): queued
+    # singleton, interned scheduling key, no anti-affinity/affinity/
+    # uniformity. Unlike slot_run_len, neighbours need NOT share a key.
+    slot_batchable: np.ndarray  # bool[S]
     # Gang node-uniformity search (gang_scheduler.go:150-224): per slot a
     # range [start, end) into the uniformity-value table; start==end means
     # no uniformity constraint. Each value is a selector bitset.
@@ -543,6 +547,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     # The kernel's fill fast path places a whole prefix of such a run in one
     # loop iteration (kernel.py _fill_branch); 0 marks non-batchable slots.
     slot_run_len = np.zeros(S, dtype=np.int32)
+    slot_batchable = np.zeros(S, dtype=bool)
     n_live = int(np.count_nonzero(slot_queue >= 0))
     if n_live and not cfg.market_driven and cfg.batch_fill_window > 0:
         j0 = np.clip(slot_members[:n_live, 0], 0, max(J - 1, 0))
@@ -550,9 +555,11 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
             (slot_count[:n_live] == 1)
             & ~slot_is_running[:n_live]
             & (slot_key_group[:n_live] >= 0)
+            & (slot_uni_end[:n_live] <= slot_uni_start[:n_live])
             & (snap.job_excluded_nodes[j0] < 0).all(axis=1)
             & (snap.job_affinity_group[j0] < 0)
         )
+        slot_batchable[:n_live] = elig
         same = (
             elig[1:]
             & elig[:-1]
