@@ -235,6 +235,7 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         slot_key_group=pad(dev.slot_key_group, 0, Sp, fill=-1),
         slot_jobs_before=pad(dev.slot_jobs_before, 0, Sp),
         slot_run_len=pad(dev.slot_run_len, 0, Sp),
+        slot_batchable=pad(dev.slot_batchable, 0, Sp, fill=False),
         slot_uni_start=pad(dev.slot_uni_start, 0, Sp),
         slot_uni_end=pad(dev.slot_uni_end, 0, Sp),
         slot_price=pad(dev.slot_price, 0, Sp),
@@ -663,6 +664,7 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         slot_key_group=slot_key_group,
         slot_jobs_before=slot_jobs_before,
         slot_run_len=slot_run_len,
+        slot_batchable=slot_batchable,
         slot_uni_start=slot_uni_start,
         slot_uni_end=slot_uni_end,
         slot_price=slot_price,
