@@ -164,6 +164,13 @@ class SchedulingConfig:
     # rather than attempt-interleaved, so placements may differ from the
     # reference trace. OFF by default (parity mode).
     enable_fast_fill: bool = False
+    # Fast mode only: per iteration each queue batches a window of
+    # consecutive batchable slots whose scheduling keys may DIFFER
+    # (heterogeneous fill). Placement groups window entries by interned
+    # key; this caps the distinct keys handled per queue-window — windows
+    # are cut at the first entry introducing key number fill_group_max+1
+    # (the cut entry batches next iteration instead).
+    fill_group_max: int = 8
     executor_timeout_s: float = 600.0
     max_unacknowledged_jobs_per_executor: int = 2500
     # Short-job penalty (scheduling/short_job_penalty.go): jobs that finish
@@ -371,6 +378,7 @@ class SchedulingConfig:
             ("enablePreferLargeJobOrdering", "enable_prefer_large_job_ordering", bool),
             ("batchFillWindow", "batch_fill_window", int),
             ("enableFastFill", "enable_fast_fill", bool),
+            ("fillGroupMax", "fill_group_max", int),
         ]:
             if yaml_key in d:
                 kwargs[attr] = conv(d[yaml_key])
@@ -450,6 +458,8 @@ def validate_config(config: SchedulingConfig):
         problems.append("maxQueueLookback must be >= 0")
     if config.batch_fill_window < 0:
         problems.append("batchFillWindow must be >= 0")
+    if config.fill_group_max < 1:
+        problems.append("fillGroupMax must be >= 1")
     for name, frac in config.maximum_resource_fraction_to_schedule.items():
         if frac < 0:
             problems.append(f"maximumResourceFractionToSchedule[{name}] < 0")

@@ -716,6 +716,30 @@ def _schedule_pass(
         heads, has = _queue_heads(dev, valid)
         return jnp.where(has, heads, dev.queue_slot_end)
 
+    def f0_chain(alloc0, j):
+        """Best-fit candidate-chain inputs for one job key against row-0
+        capacity: (fit0 mask, per-node placement caps, node order keys).
+        Shared by the serial fill and the heterogeneous window fill so the
+        two paths can never drift apart (set parity depends on identical
+        node ordering)."""
+        B = dev.batch_window
+        req_fit = dev.job_req_fit[j]
+        static_ok = _static_ok(dev, j, jnp.zeros_like(dev.uni_value_bits[0]))
+        fit0 = static_ok & jnp.all(req_fit <= alloc0, axis=-1)
+        safe_req = jnp.maximum(req_fit, 1)
+        caps = jnp.min(
+            jnp.where(req_fit[None, :] > 0, alloc0 // safe_req[None, :], BIG),
+            axis=-1,
+        )
+        caps = jnp.clip(caps, 0, B).astype(jnp.int32)
+        nkeys = []
+        for k in range(dev.order_res_idx.shape[0]):
+            ri = dev.order_res_idx[k]
+            res = dev.order_res_resolution[k]
+            nkeys.append(alloc0[:, ri] // res)
+        nkeys.append(dev.node_id_rank)
+        return fit0, caps, nkeys
+
     def fill_apply(c, qstar, sstar, kmax):
         """Place up to kmax jobs from the identical-singleton run headed at
         sstar onto row-0-feasible nodes in best-fit order (the f0 chain,
@@ -733,21 +757,7 @@ def _schedule_pass(
         req_fit = dev.job_req_fit[j]
         req_full = _f(dev.job_req[j])
 
-        static_ok = _static_ok(dev, j, jnp.zeros_like(dev.uni_value_bits[0]))
-        alloc0 = c.alloc[0]
-        fit0 = static_ok & jnp.all(req_fit <= alloc0, axis=-1)
-        safe_req = jnp.maximum(req_fit, 1)
-        caps = jnp.min(
-            jnp.where(req_fit[None, :] > 0, alloc0 // safe_req[None, :], BIG),
-            axis=-1,
-        )
-        caps = jnp.clip(caps, 0, B).astype(jnp.int32)
-        nkeys = []
-        for k in range(dev.order_res_idx.shape[0]):
-            ri = dev.order_res_idx[k]
-            res = dev.order_res_resolution[k]
-            nkeys.append(alloc0[:, ri] // res)
-        nkeys.append(dev.node_id_rank)
+        fit0, caps, nkeys = f0_chain(c.alloc[0], j)
         cand_caps, cand_gids = dist.fill_candidates(
             nkeys, fit0, caps, dev.node_gid, B
         )
@@ -891,26 +901,218 @@ def _schedule_pass(
         )
         return c2, ptr2, applied
 
-    def merged_fill_step(c, ptr, heads, has_head, qkeys, all_ev_h, eligible):
-        """Fast-mode multi-queue fill: ONE iteration batches the whole
-        multi-queue sweep. Each eligible queue's candidate-cost sequence is
-        a closed form of its own count, so the exact serial attempt order
-        across queues is a SORT of all (queue, i) entry keys, cut at the
-        first ineligible head's key (the barrier — that attempt needs the
-        serial path, and nothing after it may be batched). Global gates
-        (tokens, round caps, floating) cut the merged suffix; per-queue
-        gates cut only that queue's entries, exactly as the serial loop's
-        FAIL handling skips one queue without stopping others. Placement is
-        then greedy per queue (set-exact vs serial whenever everything fits
-        at row 0; node assignment may differ from the reference trace).
-        Returns (carry, ptr, progressed)."""
+    def window_fill_apply(c, q, widx_q, j_q, gid_q, rank_q, kq, pc):
+        """Place the accepted window prefix (kq entries, keys may DIFFER)
+        for one queue. Entries are grouped by interned scheduling key
+        (identical req + static feasibility within a group); groups place
+        sequentially — each sees row-0 capacity net of earlier groups —
+        through the same best-fit candidate chain as fill_apply. Placement
+        is cut at the FIRST window entry whose group ran out of capacity,
+        so what is applied is always a stream prefix (the pointer
+        contract); under-capacity leftovers re-enter as heads next
+        iteration and degrade to the serial path. Returns (carry, placed)."""
         W = dev.batch_window
+        G = dev.fill_groups
+        fdt = jnp.result_type(float)
+        ln = c.alloc.shape[1]
+        ivec = jnp.arange(W, dtype=jnp.int32)
+        ent = ivec < kq
+        gidc = jnp.clip(gid_q, 0, G - 1)
+        cnt_g = jax.ops.segment_sum(
+            jnp.where(ent, 1, 0).astype(jnp.int32), gidc, num_segments=G
+        )
+        rep = jax.ops.segment_min(
+            jnp.where(ent, ivec, BIG), gidc, num_segments=G
+        )
+        live_g = rep < BIG
+        j_g = jnp.clip(j_q[jnp.clip(rep, 0, W - 1)], 0, dev.job_req.shape[0] - 1)
+        j0 = j_q[0]
+        prio = c.job_prio[j0]
+        preemptible = dev.job_preemptible[j0]
+
+        def g_step(used, g):
+            alloc0 = c.alloc[0] - used
+            j = j_g[g]
+            req_fit = dev.job_req_fit[j]
+            fit0, caps, nkeys = f0_chain(alloc0, j)
+
+            def do(used):
+                cand_caps, cand_gids = dist.fill_candidates(
+                    nkeys, fit0, caps, dev.node_gid, W
+                )
+                prefix = jnp.cumsum(cand_caps)
+                placed = jnp.minimum(cnt_g[g], prefix[-1]).astype(jnp.int32)
+                cnt = jnp.clip(placed - (prefix - cand_caps), 0, cand_caps)
+                used2 = used + dist.segment_to_nodes(
+                    (cnt[:, None] * req_fit[None, :]).astype(used.dtype),
+                    cand_gids,
+                    ln,
+                )
+                # Fewer than W candidate nodes (small clusters / shard
+                # merges): pad so both cond branches agree; prefix pads
+                # with its last value to stay a valid searchsorted input.
+                Bc = cand_caps.shape[0]
+                if Bc < W:
+                    cand_gids = jnp.pad(cand_gids, (0, W - Bc))
+                    prefix = jnp.pad(prefix, (0, W - Bc), mode="edge")
+                return used2, (cand_gids, prefix, placed)
+
+            def skip(used):
+                return used, (
+                    jnp.zeros(W, jnp.int32),
+                    jnp.zeros(W, jnp.int32),
+                    jnp.zeros((), jnp.int32),
+                )
+
+            return jax.lax.cond(live_g[g] & (cnt_g[g] > 0), do, skip, used)
+
+        _, (cand_gids_g, prefix_g, placed_g) = jax.lax.scan(
+            g_step, jnp.zeros_like(c.alloc[0]), jnp.arange(G, dtype=jnp.int32)
+        )
+
+        ok_e = ent & (rank_q < placed_g[gidc])
+        fail_pos = jnp.min(jnp.where(ent & ~ok_e, ivec, W))
+        applied_n = jnp.minimum(kq, fail_pos).astype(jnp.int32)
+        app = ivec < applied_n
+        pos = jax.vmap(lambda a, v: jnp.searchsorted(a, v, side="right"))(
+            prefix_g[gidc], rank_q
+        )
+        node_e = cand_gids_g[gidc, jnp.clip(pos, 0, W - 1)]
+        safe_j = jnp.clip(j_q, 0, dev.job_req.shape[0] - 1)
+        req_fit_e = jnp.where(app[:, None], dev.job_req_fit[safe_j], 0)
+        req_full_e = jnp.where(app[:, None], _f(dev.job_req[safe_j]), 0.0)
+        delta = dist.segment_to_nodes(
+            req_fit_e.astype(c.alloc.dtype), jnp.where(app, node_e, -1), ln
+        )
+        rows = jnp.where(
+            preemptible,
+            dev.priorities <= prio,
+            jnp.ones_like(dev.priorities, bool),
+        )
+        alloc = c.alloc - jnp.where(rows[:, None, None], delta[None, :, :], 0)
+        k_f = applied_n.astype(fdt)
+        sum_full = jnp.sum(req_full_e, axis=0)
+        jdrop = jnp.where(app, j_q, dev.job_req.shape[0])
+        sdrop = jnp.where(app, widx_q, S)
+        c2 = c._replace(
+            alloc=alloc,
+            qalloc=c.qalloc.at[q].add(sum_full),
+            qpc_alloc=c.qpc_alloc.at[q, pc].add(sum_full),
+            job_node=c.job_node.at[jdrop].set(node_e, mode="drop"),
+            job_prio=c.job_prio.at[jdrop].set(prio, mode="drop"),
+            job_scheduled=c.job_scheduled.at[jdrop].set(True, mode="drop"),
+            slot_state=c.slot_state.at[sdrop].set(jnp.int8(DONE), mode="drop"),
+            tokens=c.tokens - k_f,
+            qtokens=c.qtokens.at[q].add(-k_f),
+            scheduled_new=c.scheduled_new + sum_full,
+            floating=c.floating
+            + jnp.where(dev.floating_mask, sum_full, 0.0),
+        )
+        return c2, applied_n
+
+    def merged_fill_step(c, ptr, heads, has_head, qkeys, all_ev_h, eligible):
+        """Fast-mode multi-queue HETEROGENEOUS fill: ONE iteration batches
+        the whole multi-queue sweep over windows of consecutive batchable
+        slots whose scheduling keys may differ. Each queue's candidate-cost
+        sequence is computed from the cumulative window requests (costs are
+        monotone in the cumulative allocation, so each queue's key stream
+        is non-decreasing and the exact serial attempt order across queues
+        is a SORT of all (queue, i) entry keys), cut at the first
+        ineligible head's key (the barrier — that attempt needs the serial
+        path, and nothing after it may be batched). Global gates (tokens,
+        round caps, floating) cut the merged suffix; per-queue gates cut
+        only that queue's entries, exactly as the serial loop's FAIL
+        handling skips one queue without stopping others. Placement is then
+        greedy per queue grouped by key (set-exact vs serial whenever
+        everything fits at row 0; node assignment may differ from the
+        reference trace). Returns (carry, ptr, progressed)."""
+        W = dev.batch_window
+        G = dev.fill_groups
         fdt = jnp.result_type(float)
         J = dev.job_req.shape[0]
-        j_h = jnp.clip(dev.slot_members[heads, 0], 0, J - 1)
-        run_h = dev.slot_run_len[heads]
-        pc_h = dev.job_pc[j_h]
-        req_q = _f(dev.slot_req[heads])  # [Q, R]; identical within a run
+        ivec = jnp.arange(W, dtype=jnp.int32)
+        i_f = ivec.astype(fdt)
+
+        # Per-queue windows: maximal prefix of consecutive in-range,
+        # batchable, valid slots sharing the head's priority class.
+        raw = heads[:, None] + ivec[None, :]
+        widx = jnp.clip(raw, 0, S - 1)  # [Q, W]
+        in_range = raw < dev.queue_slot_end[:, None]
+        j_w = jnp.clip(dev.slot_members[widx, 0], 0, J - 1)
+        pc_h = dev.job_pc[j_w[:, 0]]
+        vv = jax.vmap(lambda s: lazy_valid(c, s))(widx.reshape(-1)).reshape(Q, W)
+        base = (
+            eligible[:, None]
+            & in_range
+            & dev.slot_batchable[widx]
+            & vv
+            & (dev.job_pc[j_w] == pc_h[:, None])
+        )
+        base = jnp.cumprod(base.astype(jnp.int8), axis=1).astype(bool)
+
+        # Group structure by interned key. Masked entries get unique
+        # sentinels so they only self-match. gid = first-appearance rank of
+        # the entry's key within the window; rank_in_g = how many earlier
+        # window entries share its key. Windows are cut at key number G+1.
+        grp = jnp.where(base, dev.slot_key_group[widx], -2 - ivec[None, :])
+        eqm = (grp[:, :, None] == grp[:, None, :]) & (
+            ivec[None, None, :] <= ivec[None, :, None]
+        )
+        first_j = jnp.argmax(eqm, axis=2).astype(jnp.int32)
+        first_occ = (first_j == ivec[None, :]) & base
+        gnum = jnp.cumsum(first_occ.astype(jnp.int32), axis=1)
+        gid = jnp.take_along_axis(gnum, first_j, axis=1) - 1
+        rank_in_g = jnp.sum(eqm, axis=2).astype(jnp.int32) - 1
+        base = base & (gid < G)
+        base = jnp.cumprod(base.astype(jnp.int8), axis=1).astype(bool)
+
+        # Entry costs from cumulative window requests (exact serial
+        # closed form: entry i's queue allocation is qalloc + sum of the
+        # i previous window requests).
+        req_e = jnp.where(base[:, :, None], _f(dev.slot_req[widx]), 0.0)
+        csum_incl = jnp.cumsum(req_e, axis=1)  # [Q, W, R]
+        csum_prev = csum_incl - req_e
+        qa = c.qalloc + _f(dev.queue_short_penalty)  # [Q, R]
+        w = jnp.maximum(dev.queue_weight, 1e-12)
+        qa_i = qa[:, None, :] + csum_prev
+        cur = (
+            _drf_cost(qa_i, dev.total_resources, dev.drf_multipliers)
+            / w[:, None]
+        )
+        prop = (
+            _drf_cost(qa_i + req_e, dev.total_resources, dev.drf_multipliers)
+            / w[:, None]
+        )
+        ekeys = []
+        if prefer_large:
+            size = (
+                _drf_cost(req_e, dev.total_resources, dev.drf_multipliers)
+                * dev.queue_weight[:, None]
+            )  # [Q, W]
+            over = (prop > budgets[:, None]).astype(jnp.int32)
+            ekeys += [
+                over,
+                jnp.where(over == 1, prop, cur),
+                jnp.where(over == 1, 0.0, -size),
+            ]
+        else:
+            ekeys.append(prop)
+        rank2d = jnp.broadcast_to(dev.queue_name_rank[:, None], (Q, W))
+        ekeys.append(rank2d)
+
+        # Merge exactness requires each queue's key stream non-decreasing
+        # (costs are monotone in the cumulative allocation; only the
+        # prefer-large -size tiebreak at exactly tied costs can invert).
+        # Cut the window at the first inversion.
+        dec = jnp.zeros((Q, W), bool)
+        gtp = jnp.zeros((Q, W), bool)
+        for k in ekeys:
+            prev = jnp.concatenate([k[:, :1], k[:, :-1]], axis=1)
+            dec = dec | (~gtp & (k < prev))
+            gtp = gtp | (k > prev)
+        dec = dec.at[:, 0].set(False)
+        base = base & ~dec
+        base = jnp.cumprod(base.astype(jnp.int8), axis=1).astype(bool)
 
         # Barrier: the best ineligible head's key; batched entries must be
         # strictly lex-below it (ranks are unique, so strict < suffices).
@@ -918,58 +1120,22 @@ def _schedule_pass(
         qb, has_barrier = lex_argmin(qkeys, bmask)
         bk = [k[qb] for k in qkeys]
 
-        ivec = jnp.arange(W, dtype=jnp.int32)
-        i_f = ivec.astype(fdt)
-        qa = c.qalloc + _f(dev.queue_short_penalty)  # [Q, R]
-        w = jnp.maximum(dev.queue_weight, 1e-12)
-        qa_i = qa[:, None, :] + i_f[None, :, None] * req_q[:, None, :]
-        cur = (
-            _drf_cost(qa_i, dev.total_resources, dev.drf_multipliers)
-            / w[:, None]
-        )
-        prop = (
-            _drf_cost(
-                qa_i + req_q[:, None, :],
-                dev.total_resources,
-                dev.drf_multipliers,
-            )
-            / w[:, None]
-        )
-        ekeys = []
-        if prefer_large:
-            size = (
-                _drf_cost(req_q, dev.total_resources, dev.drf_multipliers)
-                * dev.queue_weight
-            )  # [Q]
-            over = (prop > budgets[:, None]).astype(jnp.int32)
-            ekeys += [
-                over,
-                jnp.where(over == 1, prop, cur),
-                jnp.where(over == 1, 0.0, -size[:, None]),
-            ]
-        else:
-            ekeys.append(prop)
-        rank2d = jnp.broadcast_to(dev.queue_name_rank[:, None], (Q, W))
-        ekeys.append(rank2d)
-
-        # Entry validity: per-queue prefix gates (qtokens, per-PC caps, run
-        # length) and the barrier.
+        # Entry validity: per-queue prefix gates (qtokens, per-PC caps)
+        # and the barrier.
         qtok_ok = (c.qtokens[:, None] - i_f[None, :]) >= 1
-        qpc = c.qpc_alloc[jnp.arange(Q), pc_h]  # [Q, R]
-        pc_lim = dev.queue_pc_limit[jnp.arange(Q), pc_h]  # [Q, R]
+        aq = jnp.arange(Q)
+        qpc = c.qpc_alloc[aq, pc_h]  # [Q, R]
+        pc_lim = dev.queue_pc_limit[aq, pc_h]  # [Q, R]
         pc_ok = ~jnp.any(
-            qpc[:, None, :] + (i_f + 1.0)[None, :, None] * req_q[:, None, :]
-            > pc_lim[:, None, :],
-            axis=-1,
+            qpc[:, None, :] + csum_incl > pc_lim[:, None, :], axis=-1
         )
-        run_ok = ivec[None, :] < run_h[:, None]
         below = jnp.zeros((Q, W), bool)
         gt = jnp.zeros((Q, W), bool)
         for a, b in zip(ekeys, bk):
             below = below | (~gt & (a < b))
             gt = gt | (a > b)
         barrier_ok = below | ~has_barrier
-        entry_ok = eligible[:, None] & qtok_ok & pc_ok & run_ok & barrier_ok
+        entry_ok = base & qtok_ok & pc_ok & barrier_ok
         entry_ok = jnp.cumprod(entry_ok.astype(jnp.int8), axis=1).astype(bool)
 
         # Merged order: sort all entries by key; stable + the i tiebreak
@@ -980,7 +1146,7 @@ def _schedule_pass(
         order = jnp.lexsort(tuple(reversed(flat_keys)))
         take = entry_ok.reshape(-1)[order]
         qidx = (jnp.arange(Q * W, dtype=jnp.int32) // W)[order]
-        req_s = req_q[qidx]  # [QW, R]
+        req_s = req_e.reshape(Q * W, -1)[order]  # [QW, R]
         req_taken = jnp.where(take[:, None], req_s, 0.0)
         cum_cnt_b = jnp.cumsum(take.astype(jnp.int32)) - take.astype(jnp.int32)
         cum_req = jnp.cumsum(req_taken, axis=0)
@@ -1011,7 +1177,9 @@ def _schedule_pass(
 
             def do(args):
                 c, ptr, progressed = args
-                c2, placed = fill_apply(c, q, heads[q], k_q[q])
+                c2, placed = window_fill_apply(
+                    c, q, widx[q], j_w[q], gid[q], rank_in_g[q], k_q[q], pc_h[q]
+                )
                 ptr2 = jnp.where(
                     placed > 0, ptr.at[q].set(heads[q] + placed), ptr
                 )
@@ -1128,7 +1296,7 @@ def _schedule_pass(
             )(heads)
             eligible = (
                 has_head
-                & (dev.slot_run_len[heads] > 0)
+                & dev.slot_batchable[heads]
                 & ~all_ev_h
                 & (code_h == OK)
             )

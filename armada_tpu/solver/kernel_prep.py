@@ -41,6 +41,7 @@ _META_FIELDS = (
     "has_away",
     "batch_window",
     "fast_fill",
+    "fill_groups",
 )
 
 
@@ -147,6 +148,7 @@ class DeviceRound:
     has_away: bool
     batch_window: int
     fast_fill: bool
+    fill_groups: int
     spot_price_cutoff: np.ndarray  # float scalar
     job_bid: np.ndarray  # float64[J]
 
@@ -726,6 +728,11 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
         has_away=bool(snap.pc_away_count.any()),
         batch_window=(0 if cfg.market_driven else int(cfg.batch_fill_window)),
         fast_fill=bool(cfg.enable_fast_fill) and not cfg.market_driven,
+        # A window of batch_fill_window entries holds at most that many
+        # distinct keys; more groups would be dead scan iterations.
+        fill_groups=max(
+            1, min(int(cfg.fill_group_max), max(1, int(cfg.batch_fill_window)))
+        ),
         spot_price_cutoff=np.float64(cfg.spot_price_cutoff),
         job_bid=snap.job_bid,
     )

@@ -156,6 +156,70 @@ def test_fast_fill_respects_burst_caps():
     assert_set_parity(snap, serial, fast, "burst")
 
 
+def test_fast_fill_heterogeneous_stream():
+    """Mixed scheduling keys WITHIN each queue's stream (random sizes, so
+    same-key runs average ~1.3 slots): the heterogeneous window must batch
+    across key changes — set parity, invariants, and a loop count far
+    below the number of scheduled jobs."""
+    rng = np.random.default_rng(7)
+    cfg = SchedulingConfig()
+    nodes = [
+        NodeSpec(
+            id=f"n{i:03d}",
+            pool="default",
+            total_resources={"cpu": "32", "memory": "256Gi"},
+        )
+        for i in range(100)
+    ]
+    queues = [QueueSpec(f"q{i}", 1.0) for i in range(4)]
+    sizes = rng.choice([1, 2, 4, 8], size=600)
+    queued = [
+        JobSpec(
+            id=f"j{i:04d}",
+            queue=f"q{i % 4}",
+            requests={"cpu": str(int(sizes[i])), "memory": f"{int(sizes[i])}Gi"},
+            submitted_ts=float(i),
+        )
+        for i in range(600)
+    ]
+    snap, serial, fast = solve_both(cfg, nodes, queues, [], queued)
+    assert_set_parity(snap, serial, fast, "hetero-stream")
+    assert_no_overcommit(snap, fast)
+    # 600 mixed-key jobs over 4 queues: a run-length-limited fill needs
+    # ~100+ iterations; the heterogeneous window needs a handful.
+    assert int(fast["num_loops"]) <= 12, f"fast loops {fast['num_loops']}"
+
+
+def test_fast_fill_group_cap_cut():
+    """More distinct keys than fill_group_max in one window: the window is
+    cut, extra keys batch next iteration — still set-exact."""
+    import dataclasses as _dc
+
+    cfg = _dc.replace(SchedulingConfig(), fill_group_max=3)
+    nodes = [
+        NodeSpec(
+            id=f"n{i:03d}",
+            pool="default",
+            total_resources={"cpu": "64", "memory": "512Gi"},
+        )
+        for i in range(12)
+    ]
+    queues = [QueueSpec("q0", 1.0), QueueSpec("q1", 1.0)]
+    # 8 distinct cpu sizes cycling -> every window holds > 3 keys.
+    queued = [
+        JobSpec(
+            id=f"j{i:04d}",
+            queue=f"q{i % 2}",
+            requests={"cpu": str(1 + (i % 8)), "memory": "1Gi"},
+            submitted_ts=float(i),
+        )
+        for i in range(160)
+    ]
+    snap, serial, fast = solve_both(cfg, nodes, queues, [], queued)
+    assert_set_parity(snap, serial, fast, "group-cap")
+    assert_no_overcommit(snap, fast)
+
+
 def test_fast_fill_heterogeneous_queues():
     """Queues with different request shapes: the merged order is still the
     serial order (closed-form costs), set parity must hold."""
