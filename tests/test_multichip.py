@@ -6,6 +6,8 @@ Mirrors the reference's multi-cluster union semantics
 change any placement. The 8-device CPU mesh stands in for an 8-chip slice
 (conftest forces xla_force_host_platform_device_count=8)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -130,4 +132,50 @@ def test_fewer_nodes_than_shards(sharded):
     nodes, queues, running, queued = _mixed_scenario(n_nodes=4, n_jobs=12)
     assert_shard_parity(
         sharded, PREEMPT_CFG, nodes, queues, running, queued, "tiny"
+    )
+
+
+@pytest.mark.skipif(
+    os.environ.get("ARMADA_SCALE_TESTS") != "1",
+    reason="benchmark-scale sharded parity: minutes of compile; "
+    "set ARMADA_SCALE_TESTS=1",
+)
+def test_benchmark_scale_parity(sharded):
+    """Sharded vs single-device parity at the flagship bench's NODE extent
+    (50k nodes over the 8-device mesh — the sharded axis), with 100k jobs
+    (10x below the flagship's 1M: jobs are replicated, not sharded, so the
+    shard layout is identical and the smaller extent keeps this CPU-mesh
+    run in minutes). Also times both paths so regressions in the
+    collective layout are visible in the test log."""
+    import time
+
+    from bench import build_inputs
+
+    inputs = build_inputs(100_000, 50_000)
+    snap = build_round_snapshot(*inputs)
+    dev = pad_nodes(prep_device_round(snap), 8)
+
+    t0 = time.time()
+    single = solve_round(dev)
+    single_compile = time.time() - t0
+    t0 = time.time()
+    single = solve_round(dev)
+    single_s = time.time() - t0
+
+    t0 = time.time()
+    multi = sharded(dev)
+    multi_compile = time.time() - t0
+    t0 = time.time()
+    multi = {k: np.asarray(v) for k, v in sharded(dev).items()}
+    multi_s = time.time() - t0
+
+    for k, v in single.items():
+        assert np.array_equal(np.asarray(multi[k]), v, equal_nan=True), (
+            f"scale: {k} diverges between sharded and single-device"
+        )
+    assert int(np.asarray(single["scheduled_mask"]).sum()) > 0
+    print(
+        f"\n[scale 100k x 50k] single: {single_s:.3f}s "
+        f"(compile {single_compile:.0f}s)  sharded x8: {multi_s:.3f}s "
+        f"(compile {multi_compile:.0f}s)"
     )
