@@ -102,6 +102,11 @@ class JobRunErrors(Event):
     run_id: str = ""
     error: str = ""
     retryable: bool = True
+    # Executor-side diagnostic dump for the run (pod state / conditions /
+    # container statuses) — the reference stores it compressed in the
+    # lookout job_run.debug column (getjobrundebugmessage.go) for the UI's
+    # debug drilldown, separate from the user-facing error.
+    debug: str = ""
 
 
 @dataclass(frozen=True)

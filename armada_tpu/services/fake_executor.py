@@ -11,6 +11,7 @@ state back through the event log.
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field, replace
 
 from ..core.types import NodeSpec
@@ -276,6 +277,14 @@ class FakeExecutor:
                         run_id=run.run_id,
                         error=f"pod issue: {issue['message']}",
                         retryable=issue["retryable"],
+                        debug=json.dumps(
+                            {
+                                "running_reported": run.running_reported,
+                                "started": run.started,
+                                "age_s": round(now - run.started, 3),
+                            },
+                            sort_keys=True,
+                        ),
                     ),
                 )
             )

@@ -3,15 +3,23 @@
 The reference serves a React/MUI UI (internal/lookoutui) against a REST API
 (internal/lookout) backed by its own Postgres materialization. Here the
 same surface is a JSON-over-HTTP gateway onto the QueryApi/reports (the
-grpc-gateway pattern, pkg/api/*.pb.gw.go) plus an embedded single-page UI:
-job table with filtering/grouping, queue overview, scheduling report.
+grpc-gateway pattern, pkg/api/*.pb.gw.go) plus an embedded single-page UI
+(lookout_ui.py): job table with server-side filter/sort/group, job-details
+drawer with per-run error/debug/termination drilldown, queue fair-share
+view, scheduling report.
 
-  GET /api/jobs?queue=&state=&skip=&take=
-  GET /api/groups?by=state|queue|jobset
+  GET /api/jobs?filters=<json>&order=&direction=&skip=&take=
+      (filters: [{"field","value","match","isAnnotation"}]; the simple
+       queue=/state=/jobset= params still work)
+  GET /api/groups?by=F[&byAnnotation=1]&aggregates=<json>&filters=<json>
   GET /api/queues
+  GET /api/fairshare             (per-pool queue shares, latest round)
   GET /api/report
-  GET /api/job/<id>          (spec + runs)
-  GET /                      (the UI)
+  GET /api/errors
+  GET /api/runs/<run_id>/error|debug|termination
+  GET /api/details/<job_id>      (row + runs incl. debug)
+  GET /api/job/<id>              (spec + runs)
+  GET /                          (the UI)
 """
 
 from __future__ import annotations
@@ -22,96 +30,31 @@ import threading
 import urllib.parse
 from dataclasses import asdict
 
+from .lookout_ui import UI_HTML
 from .queryapi import JobFilter, Order
 
-UI_HTML = """<!DOCTYPE html>
-<html><head><meta charset="utf-8"><title>armada-tpu lookout</title>
-<style>
-body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1a1d21}
-header{background:#101828;color:#fff;padding:10px 20px;display:flex;gap:16px;align-items:baseline}
-header h1{font-size:16px;margin:0} header span{color:#98a2b3;font-size:12px}
-main{padding:16px 20px;max-width:1200px;margin:auto}
-.controls{display:flex;gap:8px;margin-bottom:12px}
-input,select,button{padding:6px 8px;border:1px solid #d0d5dd;border-radius:6px;font-size:13px}
-button{background:#101828;color:#fff;cursor:pointer}
-table{width:100%;border-collapse:collapse;background:#fff;border-radius:8px;overflow:hidden;
-box-shadow:0 1px 2px rgba(0,0,0,.06);font-size:13px}
-th,td{padding:8px 10px;text-align:left;border-bottom:1px solid #eaecf0}
-th{background:#f9fafb;font-weight:600;font-size:12px;color:#475467}
-.state{padding:2px 8px;border-radius:10px;font-size:11px;font-weight:600}
-.state.queued{background:#eff8ff;color:#175cd3}.state.running{background:#ecfdf3;color:#067647}
-.state.leased{background:#fffaeb;color:#b54708}.state.succeeded{background:#f0fdf4;color:#15803d}
-.state.failed,.state.preempted{background:#fef3f2;color:#b42318}
-.state.cancelled{background:#f2f4f7;color:#475467}
-.cards{display:flex;gap:12px;margin-bottom:16px}
-.card{background:#fff;border-radius:8px;padding:12px 16px;box-shadow:0 1px 2px rgba(0,0,0,.06)}
-.card b{display:block;font-size:20px}.card span{font-size:12px;color:#475467}
-pre{background:#fff;padding:12px;border-radius:8px;font-size:12px;overflow:auto}
-</style></head><body>
-<header><h1>armada-tpu</h1><span>lookout</span></header>
-<main>
-<div class="cards" id="cards"></div>
-<div class="controls">
-<input id="q" placeholder="queue filter">
-<select id="st"><option value="">any state</option>
-<option>queued</option><option>leased</option><option>running</option>
-<option>succeeded</option><option>failed</option><option>cancelled</option><option>preempted</option></select>
-<button onclick="load()">refresh</button>
-<button onclick="toggleReport()">scheduling report</button>
-<button onclick="toggleErrors()">errors</button>
-</div>
-<pre id="report" style="display:none"></pre>
-<pre id="errors" style="display:none"></pre>
-<div id="details" style="display:none;position:fixed;top:8%;left:50%;transform:translateX(-50%);
-background:#fff;border-radius:8px;box-shadow:0 8px 30px rgba(0,0,0,.25);padding:16px;
-max-width:700px;max-height:80%;overflow:auto;z-index:10">
-<button style="float:right" onclick="hideDetails()">close</button>
-<pre id="details-body" style="background:none"></pre></div>
-<table id="jobs"><thead><tr>
-<th>job</th><th>queue</th><th>jobset</th><th>state</th><th>node</th><th>executor</th>
-<th>attempts</th><th>error</th>
-</tr></thead><tbody></tbody></table>
-</main>
-<script>
-async function jget(u){const r=await fetch(u);return r.json()}
-function esc(x){return String(x??'').replace(/[&<>"']/g,
-  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
-async function load(){
-  const q=document.getElementById('q').value, st=document.getElementById('st').value;
-  const groups=await jget('/api/groups?by=state'+(q?'&queue='+encodeURIComponent(q):''));
-  document.getElementById('cards').innerHTML=groups.groups.map(g=>
-    `<div class="card"><b>${g.count}</b><span>${esc(g.name)}</span></div>`).join('');
-  let u='/api/jobs?take=200';if(q)u+='&queue='+encodeURIComponent(q);if(st)u+='&state='+st;
-  const data=await jget(u);
-  document.querySelector('#jobs tbody').innerHTML=data.jobs.map(j=>
-    `<tr style="cursor:pointer" onclick="showDetails('${esc(j.job_id)}')">
-     <td>${esc(j.job_id)}</td><td>${esc(j.queue)}</td><td>${esc(j.jobset)}</td>
-     <td><span class="state ${esc(j.state)}">${esc(j.state)}</span></td>
-     <td>${esc(j.node)}</td><td>${esc(j.executor)}</td><td>${esc(j.attempts)}</td>
-     <td title="${esc(j.error)}">${esc(j.error_category||(j.error?'error':''))}</td></tr>`).join('');
-}
-async function showDetails(id){
-  const d=await jget('/api/details/'+encodeURIComponent(id));
-  document.getElementById('details-body').textContent=JSON.stringify(d,null,2);
-  document.getElementById('details').style.display='block';
-}
-function hideDetails(){document.getElementById('details').style.display='none'}
-async function toggleReport(){
-  const el=document.getElementById('report');
-  if(el.style.display==='none'){el.textContent=(await jget('/api/report')).report;el.style.display='block'}
-  else el.style.display='none';
-}
-async function toggleErrors(){
-  const el=document.getElementById('errors');
-  if(el.style.display==='none'){
-    const d=await jget('/api/errors');
-    el.textContent=d.errors.map(e=>`${e.job_id} [${e.error_category}] ${e.error}`).join('\\n')||'no errors';
-    el.style.display='block'
-  } else el.style.display='none';
-}
-load();setInterval(load,3000);
-</script></body></html>
-"""
+
+def _parse_filters(params: dict) -> list[JobFilter]:
+    """Filters from the JSON `filters` param plus the legacy simple
+    params (queue=, state=, jobset=)."""
+    filters = []
+    raw = params.get("filters")
+    if raw:
+        for f in json.loads(raw):
+            filters.append(
+                JobFilter(
+                    field=f["field"],
+                    value=f.get("value"),
+                    match=f.get("match", "exact"),
+                    is_annotation=bool(
+                        f.get("isAnnotation", f.get("is_annotation", False))
+                    ),
+                )
+            )
+    for key in ("queue", "state", "jobset"):
+        if params.get(key):
+            filters.append(JobFilter(key, params[key]))
+    return filters
 
 
 class LookoutHttpServer:
@@ -134,109 +77,150 @@ class LookoutHttpServer:
                 parsed = urllib.parse.urlparse(self.path)
                 params = dict(urllib.parse.parse_qsl(parsed.query))
                 try:
-                    if parsed.path == "/" or parsed.path == "/index.html":
-                        body = UI_HTML.encode()
-                        self.send_response(200)
-                        self.send_header("Content-Type", "text/html")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                    elif parsed.path == "/api/jobs":
-                        filters = []
-                        if params.get("queue"):
-                            filters.append(JobFilter("queue", params["queue"]))
-                        if params.get("state"):
-                            filters.append(JobFilter("state", params["state"]))
-                        if params.get("jobset"):
-                            filters.append(JobFilter("jobset", params["jobset"]))
-                        rows, total = outer.query.get_jobs(
-                            filters,
-                            Order(
-                                params.get("order", "submitted"),
-                                params.get("direction", "desc"),
-                            ),
-                            int(params.get("skip", 0)),
-                            int(params.get("take", 100)),
-                        )
-                        self._json({"jobs": [asdict(r) for r in rows], "total": total})
-                    elif parsed.path == "/api/groups":
-                        filters = []
-                        if params.get("queue"):
-                            filters.append(JobFilter("queue", params["queue"]))
-                        self._json(
-                            {
-                                "groups": outer.query.group_jobs(
-                                    params.get("by", "state"), filters
-                                )
-                            }
-                        )
-                    elif parsed.path == "/api/queues":
-                        self._json(
-                            {
-                                "queues": [
-                                    {
-                                        "name": q.spec.name,
-                                        "priority_factor": q.spec.priority_factor,
-                                        "cordoned": q.cordoned,
-                                    }
-                                    for q in outer.submit.queues.values()
-                                ]
-                            }
-                        )
-                    elif parsed.path == "/api/report":
-                        self._json(
-                            {"report": outer.scheduler.reports.scheduling_report()}
-                        )
-                    elif parsed.path == "/api/prices":
-                        # Market mode: last round's indicative gang prices
-                        # (MarketDrivenIndicativePrices surfaced by
-                        # cycle_metrics.go:681; spot price per pool).
-                        self._json(
-                            {
-                                pool: {
-                                    "spot_price": rep.spot_price,
-                                    "gangs": {
-                                        name: asdict(pr)
-                                        for name, pr in rep.indicative_prices.items()
-                                    },
-                                }
-                                for pool, rep in
-                                outer.scheduler.reports.latest_reports().items()
-                            }
-                        )
-                    elif parsed.path == "/api/errors":
-                        filters = []
-                        if params.get("queue"):
-                            filters.append(JobFilter("queue", params["queue"]))
-                        self._json(
-                            {"errors": outer.query.get_job_errors(filters)}
-                        )
-                    elif parsed.path.startswith("/api/details/"):
-                        job_id = parsed.path.rsplit("/", 1)[1]
-                        details = outer.query.job_details(job_id)
-                        if details is None:
-                            self._json({"error": "not found"}, 404)
-                        else:
-                            self._json(details)
-                    elif parsed.path.startswith("/api/job/"):
-                        job_id = parsed.path.rsplit("/", 1)[1]
-                        spec = outer.query.get_job_spec(job_id)
-                        if spec is None:
-                            self._json({"error": "not found"}, 404)
-                        else:
-                            self._json(
-                                {
-                                    "spec": asdict(spec),
-                                    "runs": [
-                                        asdict(r)
-                                        for r in outer.query.get_job_runs(job_id)
-                                    ],
-                                }
-                            )
-                    else:
-                        self._json({"error": "not found"}, 404)
+                    self._route(parsed, params)
                 except Exception as e:  # surface handler errors as 500s
                     self._json({"error": str(e)}, 500)
+
+            def _route(self, parsed, params):
+                if parsed.path == "/" or parsed.path == "/index.html":
+                    body = UI_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif parsed.path == "/api/jobs":
+                    rows, total = outer.query.get_jobs(
+                        _parse_filters(params),
+                        Order(
+                            params.get("order", "submitted"),
+                            params.get("direction", "desc"),
+                        ),
+                        int(params.get("skip", 0)),
+                        int(params.get("take", 100)),
+                    )
+                    self._json({"jobs": [asdict(r) for r in rows], "total": total})
+                elif parsed.path == "/api/groups":
+                    aggregates = []
+                    if params.get("aggregates"):
+                        aggregates = json.loads(params["aggregates"])
+                    self._json(
+                        {
+                            "groups": outer.query.group_jobs(
+                                params.get("by", "state"),
+                                _parse_filters(params),
+                                aggregates=aggregates,
+                                group_by_annotation=params.get("byAnnotation")
+                                in ("1", "true"),
+                                order_by=params.get("orderBy", "count"),
+                                direction=params.get("direction", "desc"),
+                                skip=int(params.get("skip", 0)),
+                                take=int(params.get("take", 0)),
+                            )
+                        }
+                    )
+                elif parsed.path == "/api/queues":
+                    self._json(
+                        {
+                            "queues": [
+                                {
+                                    "name": q.spec.name,
+                                    "priority_factor": q.spec.priority_factor,
+                                    "cordoned": q.cordoned,
+                                }
+                                for q in outer.submit.queues.values()
+                            ]
+                        }
+                    )
+                elif parsed.path == "/api/fairshare":
+                    # Queue oversight: the latest round's per-queue shares
+                    # (lookoutui's fair-share/oversight columns; reports
+                    # QueueReport per pool).
+                    pools = {}
+                    for pool, rep in (
+                        outer.scheduler.reports.latest_reports().items()
+                    ):
+                        pools[pool] = [
+                            {
+                                "queue": qr.queue,
+                                "fair_share": qr.fair_share,
+                                "adjusted_fair_share": qr.adjusted_fair_share,
+                                "actual_share": qr.actual_share,
+                                "scheduled_jobs": qr.scheduled_jobs,
+                                "preempted_jobs": qr.preempted_jobs,
+                                "top_reasons": dict(qr.top_reasons),
+                            }
+                            for qr in rep.queues.values()
+                        ]
+                    self._json({"pools": pools})
+                elif parsed.path == "/api/report":
+                    self._json(
+                        {"report": outer.scheduler.reports.scheduling_report()}
+                    )
+                elif parsed.path == "/api/prices":
+                    # Market mode: last round's indicative gang prices
+                    # (MarketDrivenIndicativePrices surfaced by
+                    # cycle_metrics.go:681; spot price per pool).
+                    self._json(
+                        {
+                            pool: {
+                                "spot_price": rep.spot_price,
+                                "gangs": {
+                                    name: asdict(pr)
+                                    for name, pr in rep.indicative_prices.items()
+                                },
+                            }
+                            for pool, rep in
+                            outer.scheduler.reports.latest_reports().items()
+                        }
+                    )
+                elif parsed.path == "/api/errors":
+                    self._json(
+                        {"errors": outer.query.get_job_errors(
+                            _parse_filters(params)
+                        )}
+                    )
+                elif parsed.path.startswith("/api/runs/"):
+                    # /api/runs/<run_id>/<error|debug|termination>
+                    parts = parsed.path.split("/")
+                    if len(parts) != 5:
+                        self._json({"error": "bad run path"}, 404)
+                        return
+                    run_id, kind = parts[3], parts[4]
+                    fn = {
+                        "error": outer.query.get_job_run_error,
+                        "debug": outer.query.get_job_run_debug_message,
+                        "termination":
+                            outer.query.get_job_run_termination_reason,
+                    }.get(kind)
+                    if fn is None:
+                        self._json({"error": f"unknown drilldown {kind}"}, 404)
+                    else:
+                        self._json({"run_id": run_id, "message": fn(run_id)})
+                elif parsed.path.startswith("/api/details/"):
+                    job_id = parsed.path.rsplit("/", 1)[1]
+                    details = outer.query.job_details(job_id)
+                    if details is None:
+                        self._json({"error": "not found"}, 404)
+                    else:
+                        self._json(details)
+                elif parsed.path.startswith("/api/job/"):
+                    job_id = parsed.path.rsplit("/", 1)[1]
+                    spec = outer.query.get_job_spec(job_id)
+                    if spec is None:
+                        self._json({"error": "not found"}, 404)
+                    else:
+                        self._json(
+                            {
+                                "spec": asdict(spec),
+                                "runs": [
+                                    asdict(r)
+                                    for r in outer.query.get_job_runs(job_id)
+                                ],
+                            }
+                        )
+                else:
+                    self._json({"error": "not found"}, 404)
 
             def log_message(self, *a):
                 pass

@@ -11,6 +11,7 @@ own row store, and lag observability (common/ingest topic_delay_monitor).
 
 from __future__ import annotations
 
+import copy
 import threading
 from dataclasses import dataclass, field
 
@@ -28,6 +29,11 @@ class LookoutRun:
     finished: float = 0.0
     state: str = "leased"
     error: str = ""
+    # Executor diagnostic dump (job_run.debug, getjobrundebugmessage.go).
+    debug: str = ""
+    # Why the scheduler ended this run (preemption reason — the
+    # getjobrunschedulerterminationreason.go surface).
+    termination_reason: str = ""
 
 
 @dataclass
@@ -58,12 +64,30 @@ class LookoutStore:
     """The lookout view: rows by job id + jobset/queue indexes, built by
     replaying the log. Thread-safe (UI reads while the ingester writes)."""
 
-    def __init__(self, log, error_rules=()):
+    def __init__(self, log, error_rules=(), checkpoint=None):
         self.log = log
         self.error_rules = error_rules
         self.rows: dict[str, LookoutRow] = {}
+        self.run_to_job: dict[str, str] = {}  # run_id -> job_id
         self.cursor = 0
         self._lock = threading.Lock()
+        if checkpoint is not None:
+            # Bounded restart (services/checkpoint.py): seed rows, then
+            # sync() replays only the suffix past the cursor.
+            self.cursor, state = checkpoint
+            self.rows.update(state["rows"])
+            self.run_to_job.update(state["run_to_job"])
+        self.cursor = max(self.cursor, log.start_offset)
+
+    def checkpoint_state(self):
+        with self._lock:
+            # Rows are mutated in place by _apply: deep-copy so a
+            # checkpoint written after more syncs doesn't see newer state
+            # under an older cursor.
+            return self.cursor, {
+                "rows": copy.deepcopy(self.rows),
+                "run_to_job": dict(self.run_to_job),
+            }
 
     # ---- ingestion ----
 
@@ -135,6 +159,7 @@ class LookoutStore:
                     leased=t,
                 )
             )
+            self.run_to_job[event.run_id] = row.job_id
         elif isinstance(event, ev.JobRunPending):
             row.state, row.last_transition = "pending", t
             if row.latest_run:
@@ -155,11 +180,13 @@ class LookoutStore:
             if row.latest_run:
                 row.latest_run.state = "preempted"
                 row.latest_run.finished = t
+                row.latest_run.termination_reason = event.reason
         elif isinstance(event, ev.JobRunErrors):
             if row.latest_run:
                 row.latest_run.state = "failed"
                 row.latest_run.finished = t
                 row.latest_run.error = event.error
+                row.latest_run.debug = event.debug
             row.error = event.error
             row.error_category = categorize_error(event.error, self.error_rules)
         elif isinstance(event, ev.JobRequeued):
@@ -179,6 +206,17 @@ class LookoutStore:
         with self._lock:
             return self.rows.get(job_id)
 
+    def get_run(self, run_id: str) -> LookoutRun | None:
+        """Run-level drilldown (job_run row by run_id)."""
+        with self._lock:
+            row = self.rows.get(self.run_to_job.get(run_id, ""))
+            if row is None:
+                return None
+            for r in row.runs:
+                if r.run_id == run_id:
+                    return r
+            return None
+
     def prune(self, older_than: float) -> int:
         """Drop terminal rows older than the retention window (the lookout
         pruner, internal/lookout/pruner)."""
@@ -190,5 +228,7 @@ class LookoutStore:
                 if row.state in terminal and row.last_transition < older_than
             ]
             for jid in drop:
+                for run in self.rows[jid].runs:
+                    self.run_to_job.pop(run.run_id, None)
                 del self.rows[jid]
         return len(drop)

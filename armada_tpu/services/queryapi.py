@@ -20,14 +20,22 @@ from ..jobdb import JobDb, JobState
 
 @dataclass(frozen=True)
 class JobFilter:
-    field: str  # queue | jobset | state | job_id | priority_class
+    """One predicate, mirroring the reference's model.Filter
+    (lookout/model/model.go:8-16 match constants; querybuilder.go:616-650
+    operator translation). With is_annotation the field names an
+    annotation key instead of a column."""
+
+    field: str  # queue | jobset | state | job_id | priority_class | ...
     value: object = None
-    match: str = "exact"  # exact | anyOf | startsWith
+    match: str = "exact"  # exact | anyOf | startsWith | contains |
+    #  greaterThan | lessThan | greaterThanOrEqualTo | lessThanOrEqualTo |
+    #  exists
+    is_annotation: bool = False
 
 
 @dataclass(frozen=True)
 class Order:
-    field: str = "submitted"  # submitted | job_id | priority | state
+    field: str = "submitted"  # submitted | job_id | priority | state | ...
     direction: str = "asc"
 
 
@@ -47,6 +55,8 @@ class JobRow:
     error_category: str
     last_transition: float = 0.0
     runtime_s: float = 0.0  # latest run start -> finish (0 while running)
+    run_id: str = ""  # latest run
+    annotations: dict = field(default_factory=dict)
 
     @staticmethod
     def from_job(job) -> "JobRow":
@@ -74,6 +84,8 @@ class JobRow:
                 run.leased if run else 0.0,
             ),
             runtime_s=runtime,
+            run_id=run.id if run else "",
+            annotations=dict(job.spec.annotations),
         )
 
     @staticmethod
@@ -97,17 +109,39 @@ class JobRow:
             error_category=row.error_category,
             last_transition=row.last_transition,
             runtime_s=runtime,
+            run_id=run.run_id if run else "",
+            annotations=dict(row.annotations),
         )
 
 
 def _matches(row: JobRow, f: JobFilter) -> bool:
-    actual = getattr(row, f.field, None)
+    if f.is_annotation:
+        present = f.field in row.annotations
+        if f.match == "exists":
+            return present
+        if not present:
+            return False
+        actual = row.annotations[f.field]
+    else:
+        actual = getattr(row, f.field, None)
+        if f.match == "exists":
+            return actual not in (None, "")
     if f.match == "exact":
         return actual == f.value
     if f.match == "anyOf":
         return actual in f.value
     if f.match == "startsWith":
         return isinstance(actual, str) and actual.startswith(str(f.value))
+    if f.match == "contains":
+        return isinstance(actual, str) and str(f.value) in actual
+    if f.match == "greaterThan":
+        return actual is not None and actual > f.value
+    if f.match == "lessThan":
+        return actual is not None and actual < f.value
+    if f.match == "greaterThanOrEqualTo":
+        return actual is not None and actual >= f.value
+    if f.match == "lessThanOrEqualTo":
+        return actual is not None and actual <= f.value
     raise ValueError(f"unknown match {f.match!r}")
 
 
@@ -147,20 +181,69 @@ class QueryApi:
         self,
         group_by: str,
         filters: list[JobFilter] = (),
-        aggregates: list[str] = (),
+        aggregates: list = (),
+        group_by_annotation: bool = False,
+        order_by: str = "count",
+        direction: str = "desc",
+        skip: int = 0,
+        take: int = 0,
     ) -> list[dict]:
-        """Counts (+ aggregates) per group value (groupjobs.go)."""
+        """Counts (+ aggregates) per group value (groupjobs.go).
+
+        group_by names a column, or an annotation key with
+        group_by_annotation (rows missing the key are excluded, matching
+        the reference's implicit exists-filter, querybuilder.go:273).
+        Aggregates: legacy strings ("submitted_min", "state_counts", ...)
+        or reference-style dicts {"field": col, "type": "min|max|average"}
+        (aggregates.go GetAggregatorsForColumn). Groups are ordered by
+        order_by ("count", "name", or an aggregate name) and paginated
+        when take > 0."""
         groups: dict = {}
+        agg_specs = []
+        for agg in aggregates:
+            if isinstance(agg, dict):
+                agg_specs.append((f"{agg['field']}_{agg['type']}",
+                                  agg["field"], agg["type"]))
+            else:
+                agg_specs.append((agg, None, None))
         for row in self._rows():
             if not all(_matches(row, f) for f in filters):
                 continue
-            key = getattr(row, group_by)
+            if group_by_annotation:
+                if group_by not in row.annotations:
+                    continue
+                key = row.annotations[group_by]
+            else:
+                key = getattr(row, group_by)
             g = groups.setdefault(
                 key, {"name": key, "count": 0, "aggregates": {}}
             )
             g["count"] += 1
-            for agg in aggregates:
-                if agg == "submitted_min":
+            for agg, col, typ in agg_specs:
+                if col is not None:
+                    val = getattr(row, col, None)
+                    if typ == "min":
+                        cur = g["aggregates"].get(agg)
+                        g["aggregates"][agg] = (
+                            val if cur is None else min(cur, val)
+                        )
+                    elif typ == "max":
+                        cur = g["aggregates"].get(agg)
+                        g["aggregates"][agg] = (
+                            val if cur is None else max(cur, val)
+                        )
+                    elif typ == "average":
+                        bucket = g["aggregates"].setdefault(
+                            agg, {"sum": 0.0, "n": 0}
+                        )
+                        bucket["sum"] += float(val or 0.0)
+                        bucket["n"] += 1
+                    elif typ == "state_counts":
+                        sc = g["aggregates"].setdefault(agg, {})
+                        sc[row.state] = sc.get(row.state, 0) + 1
+                    else:
+                        raise ValueError(f"unknown aggregate type {typ!r}")
+                elif agg == "submitted_min":
                     cur = g["aggregates"].get(agg)
                     g["aggregates"][agg] = (
                         row.submitted if cur is None else min(cur, row.submitted)
@@ -190,12 +273,22 @@ class QueryApi:
                         bucket["sum"] += row.runtime_s
                         bucket["n"] += 1
         for g in groups.values():
-            ra = g["aggregates"].get("runtime_avg")
-            if isinstance(ra, dict):
-                g["aggregates"]["runtime_avg"] = (
-                    ra["sum"] / ra["n"] if ra["n"] else 0.0
-                )
-        return sorted(groups.values(), key=lambda g: -g["count"])
+            for name, v in list(g["aggregates"].items()):
+                if isinstance(v, dict) and set(v) == {"sum", "n"}:
+                    g["aggregates"][name] = v["sum"] / v["n"] if v["n"] else 0.0
+        out = list(groups.values())
+        if order_by == "count":
+            key = lambda g: g["count"]
+        elif order_by == "name":
+            key = lambda g: g["name"]
+        else:
+            key = lambda g: g["aggregates"].get(order_by, 0)
+        out.sort(key=key, reverse=(direction == "desc"))
+        if skip:
+            out = out[skip:]
+        if take:
+            out = out[:take]
+        return out
 
     def get_job_errors(
         self, filters: list[JobFilter] = (), take: int = 100
@@ -252,6 +345,8 @@ class QueryApi:
                         "started": r.started,
                         "finished": r.finished,
                         "error": r.error,
+                        "debug": r.debug,
+                        "termination_reason": r.termination_reason,
                     }
                     for r in row.runs
                 ],
@@ -292,6 +387,33 @@ class QueryApi:
     def get_job_runs(self, job_id: str):
         job = self.jobdb.get(job_id)
         return list(job.runs) if job else []
+
+    def get_job_run_error(self, run_id: str) -> str:
+        """Error text for one run (getjobrunerror.go)."""
+        run = self._find_run(run_id)
+        return getattr(run, "error", "") if run else ""
+
+    def get_job_run_debug_message(self, run_id: str) -> str:
+        """Executor-side diagnostic dump for one run
+        (getjobrundebugmessage.go — job_run.debug)."""
+        run = self._find_run(run_id)
+        return getattr(run, "debug", "") if run else ""
+
+    def get_job_run_termination_reason(self, run_id: str) -> str:
+        """Why the scheduler ended the run (preemption reason;
+        getjobrunschedulerterminationreason.go)."""
+        run = self._find_run(run_id)
+        return getattr(run, "termination_reason", "") if run else ""
+
+    def _find_run(self, run_id: str):
+        if self.lookout is not None:
+            return self.lookout.get_run(run_id)
+        txn = self.jobdb.read_txn()
+        for job in txn.all_jobs():
+            for r in job.runs:
+                if r.id == run_id:
+                    return r
+        return None
 
     def active_job_sets(self) -> list[tuple[str, str]]:
         seen = {}

@@ -135,3 +135,191 @@ def test_lookout_pruner():
     _drive(sched, submit, executor, lookout)
     assert lookout.prune(older_than=100.0) == 6
     assert lookout.all_rows() == []
+
+
+def test_query_match_types_and_annotations():
+    """The reference's full filter-operator set (lookout/model/model.go:8-16,
+    querybuilder.go:616-650): contains, gt/lt/gte/lte, exists, plus
+    annotation-keyed filters."""
+    config, log, sched, submit, executor, lookout = _stack()
+    submit.create_queue(QueueSpec("team"))
+    submit.submit(
+        "team", "set1",
+        [
+            JobSpec(
+                id=f"job-{i}", queue="",
+                requests={"cpu": "1", "memory": "1Gi"},
+                priority=i,
+                annotations={"owner": f"user-{i % 2}"} if i < 4 else {},
+            )
+            for i in range(6)
+        ],
+        now=float(0),
+    )
+    lookout.sync()
+    q = QueryApi(lookout=lookout)
+
+    _, n = q.get_jobs([JobFilter("job_id", "ob-3", match="contains")])
+    assert n == 1
+    _, n = q.get_jobs([JobFilter("priority", 3, match="greaterThan")])
+    assert n == 2
+    _, n = q.get_jobs([JobFilter("priority", 3, match="lessThanOrEqualTo")])
+    assert n == 4
+    _, n = q.get_jobs([JobFilter("priority", 5, match="greaterThanOrEqualTo")])
+    assert n == 1
+    _, n = q.get_jobs([JobFilter("owner", match="exists", is_annotation=True)])
+    assert n == 4
+    _, n = q.get_jobs(
+        [JobFilter("owner", "user-1", match="exact", is_annotation=True)]
+    )
+    assert n == 2
+    _, n = q.get_jobs([JobFilter("priority", [1, 2, 9], match="anyOf")])
+    assert n == 2
+
+    # Annotation grouping: rows missing the key are excluded (the
+    # implicit exists-filter, querybuilder.go:273).
+    groups = q.group_jobs("owner", group_by_annotation=True)
+    assert sorted(g["name"] for g in groups) == ["user-0", "user-1"]
+    assert all(g["count"] == 2 for g in groups)
+
+    # Reference-style aggregate specs (aggregates.go) + ordering by name.
+    groups = q.group_jobs(
+        "owner", group_by_annotation=True,
+        aggregates=[
+            {"field": "priority", "type": "max"},
+            {"field": "priority", "type": "average"},
+            "state_counts",
+        ],
+        order_by="name", direction="asc",
+    )
+    assert groups[0]["name"] == "user-0"
+    assert groups[0]["aggregates"]["priority_max"] == 2
+    assert groups[0]["aggregates"]["priority_average"] == 1.0
+    assert groups[0]["aggregates"]["state_counts"] == {"queued": 2}
+
+    # Group pagination.
+    page = q.group_jobs(
+        "job_id", order_by="name", direction="asc", skip=2, take=2
+    )
+    assert [g["name"] for g in page] == ["job-2", "job-3"]
+
+
+def test_run_drilldowns_error_debug_termination():
+    """Run-level drilldown surface (getjobrunerror.go,
+    getjobrundebugmessage.go, getjobrunschedulerterminationreason.go)."""
+    from armada_tpu.events import (
+        EventSequence,
+        JobRunErrors,
+        JobRunLeased,
+        JobRunPreempted,
+    )
+
+    config, log, sched, submit, executor, lookout = _stack()
+    submit.create_queue(QueueSpec("team"))
+    submit.submit(
+        "team", "set1",
+        [JobSpec(id=f"j{i}", queue="", requests={"cpu": "1", "memory": "1Gi"})
+         for i in range(2)],
+        now=0.0,
+    )
+    log.publish(
+        EventSequence.of(
+            "team", "set1",
+            JobRunLeased(created=1.0, job_id="j0", run_id="r0",
+                         executor="c", node_id="n0"),
+            JobRunErrors(created=2.0, job_id="j0", run_id="r0",
+                         error="oom killed", retryable=False,
+                         debug='{"phase": "running", "exit_code": 137}'),
+        )
+    )
+    log.publish(
+        EventSequence.of(
+            "team", "set1",
+            JobRunLeased(created=1.0, job_id="j1", run_id="r1",
+                         executor="c", node_id="n1"),
+            JobRunPreempted(created=3.0, job_id="j1", run_id="r1",
+                            reason="preempted by queue weights"),
+        )
+    )
+    lookout.sync()
+    q = QueryApi(lookout=lookout)
+    assert q.get_job_run_error("r0") == "oom killed"
+    assert "exit_code" in q.get_job_run_debug_message("r0")
+    assert q.get_job_run_termination_reason("r1") == "preempted by queue weights"
+    assert q.get_job_run_error("missing") == ""
+    # The details drawer carries the same per-run fields.
+    runs = q.job_details("j0")["runs"]
+    assert runs[0]["debug"] and runs[0]["error"] == "oom killed"
+
+
+def test_lookout_http_rich_query_surface():
+    """HTTP-level getJobs/groupJobs semantics: JSON filter param,
+    order/direction/skip/take, annotation group-by with aggregates,
+    run drilldown routes, fair-share view."""
+    from armada_tpu.events import EventSequence, JobRunErrors, JobRunLeased
+
+    config, log, sched, submit, executor, lookout = _stack()
+    _drive(sched, submit, executor, lookout)
+    # One failed run with a debug dump for the drilldown route.
+    submit.submit(
+        "team", "set2",
+        [JobSpec(id="jx", queue="", requests={"cpu": "1", "memory": "1Gi"},
+                 annotations={"team": "alpha"})],
+        now=20.0,
+    )
+    log.publish(
+        EventSequence.of(
+            "team", "set2",
+            JobRunLeased(created=21.0, job_id="jx", run_id="rx",
+                         executor="c", node_id="n0"),
+            JobRunErrors(created=22.0, job_id="jx", run_id="rx",
+                         error="disk pressure", retryable=True,
+                         debug='{"phase": "pending"}'),
+        )
+    )
+    lookout.sync()
+    q = QueryApi(lookout=lookout)
+    server = LookoutHttpServer(q, sched, submit, port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+
+        def jget(path):
+            with urllib.request.urlopen(base + path) as r:
+                return json.loads(r.read())
+
+        # JSON filters: contains + annotation exact.
+        filters = json.dumps(
+            [{"field": "job_id", "value": "j", "match": "contains"}]
+        )
+        data = jget(f"/api/jobs?filters={urllib.parse.quote(filters)}"
+                    "&order=submitted&direction=asc&skip=2&take=3")
+        assert data["total"] == 7
+        assert len(data["jobs"]) == 3
+        assert data["jobs"][0]["job_id"] == "j2"  # asc from skip=2
+
+        ann = json.dumps([{"field": "team", "value": "alpha",
+                           "match": "exact", "isAnnotation": True}])
+        data = jget(f"/api/jobs?filters={urllib.parse.quote(ann)}")
+        assert data["total"] == 1 and data["jobs"][0]["job_id"] == "jx"
+
+        # groupJobs over annotation with reference-style aggregates.
+        aggs = json.dumps([{"field": "submitted", "type": "min"},
+                           "state_counts"])
+        data = jget("/api/groups?by=team&byAnnotation=1"
+                    f"&aggregates={urllib.parse.quote(aggs)}")
+        assert data["groups"][0]["name"] == "alpha"
+        assert data["groups"][0]["aggregates"]["submitted_min"] == 20.0
+        # retryable run error without a terminal JobErrors: still leased.
+        assert data["groups"][0]["aggregates"]["state_counts"] == {"leased": 1}
+
+        # Run drilldowns.
+        assert jget("/api/runs/rx/error")["message"] == "disk pressure"
+        assert "phase" in jget("/api/runs/rx/debug")["message"]
+        assert jget("/api/runs/rx/termination")["message"] == ""
+
+        # Fair-share view exists and covers the pool's queues.
+        pools = jget("/api/fairshare")["pools"]
+        assert "default" in pools
+        assert any(r["queue"] == "team" for r in pools["default"])
+    finally:
+        server.stop()
