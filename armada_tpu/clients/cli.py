@@ -59,6 +59,9 @@ def _jobs_from_yaml(path: str) -> tuple[str, str, list[dict]]:
             "node_selector": item.get("nodeSelector", {}),
             "annotations": item.get("annotations", {}),
             "tolerations": item.get("tolerations", []),
+            # podSpec containers[0].command+args equivalent: a real argv
+            # for subprocess-backed executors.
+            "command": item.get("command", []),
         }
         count = int(item.get("count", 1))
         gang = item.get("gang")

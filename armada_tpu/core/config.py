@@ -192,6 +192,11 @@ class SchedulingConfig:
     # in metrics and the round report.
     gangs_to_price: dict = field(default_factory=dict)  # {name: GangDefinition}
     gang_pricing_timeout_s: float = 1.0
+    # Unit for value metrics (idealised/realised, idealised_value.go):
+    # value of a job = bid x max_r(request_r / unit_r). The bid snapshot's
+    # per-pool resource_units take precedence (scheduling_algo.go:801-808);
+    # this is the fallback when the provider supplies none.
+    market_resource_unit: dict = field(default_factory=lambda: {"cpu": "1"})
     # Assert jobdb invariants at the end of each cycle (the reference's
     # enableAssertions, scheduler.go:143; config.yaml:84).
     enable_assertions: bool = False

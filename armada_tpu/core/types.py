@@ -148,6 +148,10 @@ class JobSpec:
     annotations: dict = field(default_factory=dict)
     # Market mode: bid price per pool (pkg/bidstore; job.GetBidPrice).
     bid_prices: dict = field(default_factory=dict)
+    # Container command argv (podspec containers[0].command+args in the
+    # reference). Empty = simulated runtime; a subprocess-backed executor
+    # runs it as a real OS process.
+    command: tuple = ()
 
     def bid_price(self, pool: str, *, running: bool = False) -> float:
         """Bid for this pool's given phase (see bid_price_pair)."""

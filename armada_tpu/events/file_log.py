@@ -46,6 +46,13 @@ class CorruptLogError(RuntimeError):
     """Mid-log corruption: refuse to start rather than drop records."""
 
 
+class CompactedLogError(RuntimeError):
+    """Read below the compaction point: the caller must bootstrap from a
+    view checkpoint instead of replaying from offset 0 (the reference's
+    equivalent: scheduler state lives in Postgres views with serials, and
+    Pulsar retention drops acknowledged history; scheduler.go:441)."""
+
+
 def _encode_event(event) -> dict:
     d = asdict(event)
     d["_t"] = type(event).__name__
@@ -88,6 +95,8 @@ def _decode_event(d: dict):
             gang=Gang(**gang) if gang else None,
             submitted_ts=j.get("submitted_ts", 0.0),
             annotations=j.get("annotations", {}),
+            bid_prices=j.get("bid_prices", {}),
+            command=tuple(j.get("command", ())),
         )
     return cls(**d)
 
@@ -107,7 +116,11 @@ class FileEventLog(EventLog):
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         self._watchers: list[threading.Condition] = []
-        self._entries: list[LogEntry] = []  # in-memory index (replayable)
+        self._entries: list[LogEntry] = []  # in-memory suffix [base..end)
+        self._base = 0  # offset of _entries[0]: advanced by compact()
+        # (filename, first offset) per live segment, recovery order.
+        self._seg_starts: list[tuple[str, int]] = []
+        self._seg_count = 0  # records in the open segment (rollover)
         self._fh = None
         self._unsynced = 0
         self._recover()
@@ -115,19 +128,56 @@ class FileEventLog(EventLog):
     # ---- recovery ----
 
     def _segments(self) -> list[str]:
+        # Numeric sort: offset-named segments (12-digit) and legacy
+        # index-named ones (8-digit) interleave correctly only by value —
+        # lexicographic order breaks across the width change.
         return sorted(
-            f for f in os.listdir(self.dir) if f.startswith("seg-") and f.endswith(".log")
+            (
+                f
+                for f in os.listdir(self.dir)
+                if f.startswith("seg-") and f.endswith(".log")
+            ),
+            key=lambda f: int(f[4:-4]),
         )
 
+    def _marker_path(self) -> str:
+        return os.path.join(self.dir, "compacted")
+
     def _recover(self):
+        # The compaction marker records where surviving history starts;
+        # segments whose names (first offsets) sort below it were deleted
+        # by compact(). A gap between the marker and the first record is
+        # real corruption (manually deleted segments), not compaction.
+        try:
+            with open(self._marker_path()) as f:
+                self._base = int(f.read().strip() or 0)
+        except FileNotFoundError:
+            pass
         segments = self._segments()
         for seg_idx, seg in enumerate(segments):
             path = os.path.join(self.dir, seg)
             with open(path, "rb") as f:
                 lines = f.readlines()
+            # A segment whose records lie below the marker is leftover from
+            # a compact() killed between writing the marker and deleting
+            # files: finish the deletion. (Segments never straddle the
+            # marker — it is always some segment's first offset.)
+            if lines and not self._entries:
+                first_off = None
+                try:
+                    first_off = json.loads(lines[0])["o"]
+                except (json.JSONDecodeError, KeyError, TypeError):
+                    pass
+                if first_off is not None and first_off < self._base:
+                    os.remove(path)
+                    continue
             good_bytes = 0
+            self._seg_count = 0
+            seg_start = self._base + len(self._entries)
+            self._seg_starts.append((seg, seg_start))
             for line_idx, line in enumerate(lines):
                 bad = None
+                next_off = self._base + len(self._entries)
                 if not line.endswith(b"\n"):
                     # Crash lost the newline: even if the record parses, the
                     # next append would concatenate onto this line.
@@ -138,8 +188,8 @@ class FileEventLog(EventLog):
                         payload = rec["s"]
                         if zlib.crc32(json.dumps(payload).encode()) != rec["c"]:
                             bad = "crc mismatch"
-                        elif rec["o"] != len(self._entries):
-                            bad = f"offset gap: {rec['o']} != {len(self._entries)}"
+                        elif rec["o"] != next_off:
+                            bad = f"offset gap: {rec['o']} != {next_off}"
                         else:
                             seq = EventSequence(
                                 queue=payload["q"],
@@ -152,10 +202,9 @@ class FileEventLog(EventLog):
                     except (json.JSONDecodeError, KeyError, TypeError) as e:
                         bad = f"undecodable record: {e!r}"
                 if bad is None:
-                    self._entries.append(
-                        LogEntry(offset=len(self._entries), sequence=seq)
-                    )
+                    self._entries.append(LogEntry(offset=next_off, sequence=seq))
                     good_bytes += len(line)
+                    self._seg_count += 1
                     continue
                 # A bad record is only a recoverable torn tail when it is
                 # the final line of the final segment; anywhere else it is
@@ -172,8 +221,11 @@ class FileEventLog(EventLog):
     # ---- appends ----
 
     def _open_segment(self):
-        seg_index = len(self._entries) // self.segment_size
-        path = os.path.join(self.dir, f"seg-{seg_index:08d}.log")
+        # Segments are named by their first offset (not an index times a
+        # size): recovery and compaction then never depend on segment_size
+        # staying constant across restarts.
+        first = self._base + len(self._entries)
+        name = f"seg-{first:012d}.log"
         if self._fh is not None:
             # fsync before rollover: a later-fsynced successor segment must
             # never survive a tail loss in its predecessor (that would be a
@@ -182,12 +234,19 @@ class FileEventLog(EventLog):
             os.fsync(self._fh.fileno())
             self._unsynced = 0
             self._fh.close()
-        self._fh = open(path, "ab")
+            self._seg_starts.append((name, first))
+            self._seg_count = 0
+        elif not self._seg_starts:
+            self._seg_starts.append((name, first))
+        else:
+            # Re-opening after recovery: append to the last live segment.
+            name = self._seg_starts[-1][0]
+        self._fh = open(os.path.join(self.dir, name), "ab")
 
     def publish(self, sequence: EventSequence) -> int:
         with self._lock:
-            offset = len(self._entries)
-            if self._fh is None or (offset % self.segment_size == 0 and offset):
+            offset = self._base + len(self._entries)
+            if self._fh is None or self._seg_count >= self.segment_size:
                 self._open_segment()
             payload = {
                 "q": sequence.queue,
@@ -217,6 +276,7 @@ class FileEventLog(EventLog):
             else:
                 self._fh.flush()
             self._entries.append(LogEntry(offset=offset, sequence=sequence))
+            self._seg_count += 1
         for cond in list(self._watchers):
             with cond:
                 cond.notify_all()
@@ -233,20 +293,73 @@ class FileEventLog(EventLog):
 
     def read(self, cursor: int, limit: int = 1000) -> list[LogEntry]:
         with self._lock:
-            return self._entries[cursor : cursor + limit]
+            if cursor < self._base:
+                raise CompactedLogError(
+                    f"offset {cursor} is below the compaction point "
+                    f"{self._base}; bootstrap this view from a checkpoint"
+                )
+            i = cursor - self._base
+            return self._entries[i : i + limit]
 
     def read_jobset(self, queue: str, jobset: str, cursor: int = 0) -> list[LogEntry]:
         with self._lock:
+            # History below the compaction point is gone; jobset watchers
+            # see the surviving suffix (compaction trails view checkpoints
+            # AND the terminal-retention window, so what is missing is
+            # pruned-jobset history).
+            i = max(cursor, self._base) - self._base
             return [
                 e
-                for e in self._entries[cursor:]
+                for e in self._entries[i:]
                 if e.sequence.queue == queue and e.sequence.jobset == jobset
             ]
 
     @property
     def end_offset(self) -> int:
         with self._lock:
-            return len(self._entries)
+            return self._base + len(self._entries)
+
+    @property
+    def start_offset(self) -> int:
+        """First readable offset (> 0 once compacted)."""
+        with self._lock:
+            return self._base
+
+    def compact(self, up_to: int) -> int:
+        """Delete whole segments that lie entirely below `up_to` (callers
+        pass the min checkpointed cursor across all views — the analogue of
+        the reference relying on Postgres views + Pulsar retention, and of
+        the lookout pruner, internal/lookout/pruner/pruner.go). The active
+        segment is never removed. Returns the number of segments deleted."""
+        removed = 0
+        with self._lock:
+            # A segment is removable when its successor starts at or below
+            # up_to (so every record in it is below up_to) and it is not
+            # the active (last) segment.
+            keep = 0
+            while (
+                keep + 1 < len(self._seg_starts)
+                and self._seg_starts[keep + 1][1] <= up_to
+            ):
+                keep += 1
+            if keep == 0:
+                return 0
+            new_base = self._seg_starts[keep][1]
+            # Durable marker BEFORE deleting: recovery distinguishes
+            # compaction from manually-deleted segments by it.
+            tmp = self._marker_path() + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(new_base))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._marker_path())
+            for name, _ in self._seg_starts[:keep]:
+                os.remove(os.path.join(self.dir, name))
+                removed += 1
+            self._seg_starts = self._seg_starts[keep:]
+            self._entries = self._entries[new_base - self._base :]
+            self._base = new_base
+        return removed
 
     def watcher(self) -> threading.Condition:
         cond = threading.Condition()

@@ -39,6 +39,16 @@ class EventLog:
     def end_offset(self) -> int:
         raise NotImplementedError
 
+    @property
+    def start_offset(self) -> int:
+        """First readable offset (> 0 once a durable log is compacted)."""
+        return 0
+
+    def compact(self, up_to: int) -> int:
+        """Drop history below `up_to` if the implementation supports it.
+        Returns the number of storage units removed (0 = no-op)."""
+        return 0
+
 
 class InMemoryEventLog(EventLog):
     """Append-only in-process log, thread-safe; offsets are contiguous."""

@@ -428,6 +428,22 @@ class JobDb:
         with self._state_lock:
             return self._jobs.get(job_id)
 
+    # ---- checkpointing (services/checkpoint.py) ----
+
+    def dump(self) -> dict:
+        """Snapshot for a view checkpoint: jobs + the serial watermark."""
+        with self._state_lock:
+            return {"jobs": list(self._jobs.values()), "serial": self.serial}
+
+    def load(self, state: dict) -> None:
+        """Restore a dump into a fresh db (indexes rebuilt, serials kept)."""
+        with self._state_lock:
+            assert not self._jobs, "load() requires a fresh JobDb"
+            self.serial = state["serial"]
+            for job in state["jobs"]:
+                self._jobs[job.id] = job
+                self._index_add(job)
+
     def prune_terminal(self, older_than: float) -> int:
         """Delete terminal jobs whose last activity predates `older_than`
         (the lookout/scheduler DB pruners of the reference). Returns count.

@@ -1,0 +1,107 @@
+"""View checkpoints + log compaction: bounded restart.
+
+The reference restarts from materialized views — scheduler state lives in
+Postgres tables with monotone serials (database/migrations/
+001_initialize_schema.up.sql:1-91) that the scheduler delta-polls
+(scheduler.go:441 syncState), lookout rows are pruned on retention
+(internal/lookout/pruner/pruner.go), and Pulsar retention drops
+acknowledged history. Without these, a log-is-the-checkpoint design pays
+O(history) on every restart and the log grows forever.
+
+Here the same bound comes from periodic view checkpoints: each registered
+view serializes (cursor, state) atomically to disk; a restarted process
+loads the checkpoint and replays only the log suffix past its cursor
+(recover = checkpoint + delta). Once every view has a checkpoint at or
+past an offset, the log segments below it are fully materialized
+everywhere and can be deleted (FileEventLog.compact), which also bounds
+disk and the in-memory log index.
+
+Checkpoint files are pickles (same trust domain as the log on local disk),
+crc-guarded and written via tmp+fsync+rename so a crash mid-write leaves
+the previous good checkpoint in place.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import zlib
+
+FORMAT_VERSION = 1
+
+
+class CheckpointStore:
+    """One atomic (cursor, state) file per view name."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.dir, f"{name}.ckpt")
+
+    def save(self, name: str, cursor: int, state) -> None:
+        payload = pickle.dumps(
+            (FORMAT_VERSION, cursor, state), protocol=pickle.HIGHEST_PROTOCOL
+        )
+        tmp = self._path(name) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(zlib.crc32(payload).to_bytes(4, "big") + payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path(name))
+
+    def load(self, name: str):
+        """Returns (cursor, state) or None (absent/corrupt — corrupt means
+        the tmp+rename contract was bypassed; the caller falls back to
+        whatever log replay is still possible)."""
+        try:
+            with open(self._path(name), "rb") as f:
+                rec = f.read()
+        except FileNotFoundError:
+            return None
+        if len(rec) < 4:
+            return None
+        payload = rec[4:]
+        if zlib.crc32(payload) != int.from_bytes(rec[:4], "big"):
+            return None
+        try:
+            version, cursor, state = pickle.loads(payload)
+        except Exception:
+            return None
+        if version != FORMAT_VERSION:
+            return None
+        return cursor, state
+
+
+class CheckpointManager:
+    """Checkpoints registered views and compacts the log behind them.
+
+    Views implement `checkpoint_state() -> (cursor, state)`. Compaction
+    uses the min cursor across the views saved in THIS pass, so a segment
+    is only deleted once every registered view has durably materialized
+    it. Callers must register every log consumer that replays on restart —
+    an unregistered consumer would lose its history to compaction.
+    """
+
+    def __init__(self, store: CheckpointStore, log):
+        self.store = store
+        self.log = log
+        self._views: dict[str, object] = {}
+
+    def register(self, name: str, view) -> None:
+        self._views[name] = view
+
+    def save_all(self) -> int:
+        """Checkpoint every view; returns the min checkpointed cursor."""
+        cursors = []
+        for name, view in self._views.items():
+            cursor, state = view.checkpoint_state()
+            self.store.save(name, cursor, state)
+            cursors.append(cursor)
+        return min(cursors) if cursors else 0
+
+    def checkpoint_and_compact(self) -> int:
+        """One maintenance pass: save all views, drop fully-covered log
+        segments. Returns the number of segments removed."""
+        return self.log.compact(self.save_all())

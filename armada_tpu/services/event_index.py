@@ -22,7 +22,7 @@ from ..events.pipeline import IngestPipeline
 
 
 class EventStreamIndex:
-    def __init__(self, log, *, batch_size: int = 1000):
+    def __init__(self, log, *, batch_size: int = 1000, checkpoint=None):
         self.log = log
         self._lock = threading.Lock()
         # (queue, jobset) -> sorted list of log offsets holding its events.
@@ -30,18 +30,50 @@ class EventStreamIndex:
         # (queue, jobset) -> created ts of the jobset's last event, for
         # retention (eventstore retention policy).
         self._last_activity: dict[tuple, float] = {}
-        self._pipeline = IngestPipeline(
-            log, self._convert, self._sink, batch_size=batch_size
-        )
-        # Serializes concurrent sync() callers (every watcher thread pumps
-        # the view); the sink stays idempotent regardless.
-        self._sync_lock = threading.Lock()
         # Log offset below which the index cannot prove completeness for
         # keys it (re-)created after a retention prune: set by prune(),
         # consulted by offsets_from. A key holding offsets from BEFORE the
         # watermark provably survived every prune, so it stays
         # authoritative from zero.
         self._pruned_through = 0
+        start_cursor = 0
+        if checkpoint is not None:
+            # Bounded restart (services/checkpoint.py): seed the index,
+            # replay only the suffix.
+            start_cursor, state = checkpoint
+            start_cursor = state.get("ingest_cursor", start_cursor)
+            self._streams.update(state["streams"])
+            self._last_activity.update(state["last_activity"])
+            self._pruned_through = state["pruned_through"]
+        self._pipeline = IngestPipeline(
+            log,
+            self._convert,
+            self._sink,
+            batch_size=batch_size,
+            start_cursor=max(start_cursor, log.start_offset),
+        )
+        # Serializes concurrent sync() callers (every watcher thread pumps
+        # the view); the sink stays idempotent regardless.
+        self._sync_lock = threading.Lock()
+
+    def checkpoint_state(self):
+        with self._lock:
+            # The index stores OFFSETS into the log; the bodies live in the
+            # log itself. The checkpoint cursor must therefore pin
+            # compaction at the oldest offset any live stream still
+            # references (not the ingest cursor) — prune() drops quiet
+            # jobsets after retention, releasing the pin, so compaction
+            # trails retention for watched history.
+            referenced = [b[0] for b in self._streams.values() if b]
+            pin = min([self._pipeline.cursor] + referenced)
+            return pin, {
+                "streams": {k: list(v) for k, v in self._streams.items()},
+                "last_activity": dict(self._last_activity),
+                "pruned_through": self._pruned_through,
+                # Restore resumes ingest here (the pin above only gates
+                # compaction; re-ingesting from it would be wasted work).
+                "ingest_cursor": self._pipeline.cursor,
+            }
 
     # ---- pipeline stages ----
 
@@ -115,8 +147,14 @@ class EventStreamIndex:
         offsets = self.offsets_from(queue, jobset, cursor, limit)
         if offsets is None:
             return None
+        # Offsets below the log's compaction point can linger when an
+        # external compact() outran this index's checkpoint pin (a
+        # mis-wired deployment): skip them instead of crashing the stream.
+        start = getattr(self.log, "start_offset", 0)
         out = []
         for offset in offsets:
+            if offset < start:
+                continue
             entries = self.log.read(offset, 1)
             if entries and entries[0].offset == offset:
                 out.append((offset, entries[0].sequence))
@@ -126,10 +164,13 @@ class EventStreamIndex:
         """Drop jobsets whose last event predates `older_than` (the
         reference's per-jobset retention)."""
         with self._lock:
+            # Keys with no recorded activity (events without created
+            # timestamps, e.g. control-plane settings) age out too — they
+            # would otherwise pin log compaction forever.
             stale = [
                 key
-                for key, ts in self._last_activity.items()
-                if ts < older_than
+                for key in self._streams
+                if self._last_activity.get(key, 0.0) < older_than
             ]
             for key in stale:
                 self._streams.pop(key, None)

@@ -68,6 +68,168 @@ class _PodRuntime:
         return events
 
 
+class SubprocessPodRuntime:
+    """REAL pods: each lease becomes an OS process (the cluster-context
+    seam proven end-to-end without Kubernetes — submit.go creates pods,
+    here Popen creates processes). The job spec's `command` argv runs with
+    an address-space rlimit derived from its memory request (resource
+    accounting enforced by the kernel, not simulated); empty commands fall
+    back to a sleep of `default_runtime_s`. Phases map as
+    created -> pending (spawn) -> running -> succeeded/failed(rc), with rc
+    and rusage in the failure debug dump."""
+
+    def __init__(self, default_runtime_s: float = 30.0, enforce_rlimits: bool = True):
+        self.default_runtime_s = default_runtime_s
+        self.enforce_rlimits = enforce_rlimits
+        self.pods: dict[str, dict] = {}  # run_id -> pod record
+
+    def create(self, lease: dict, now: float):
+        self.pods[lease["run_id"]] = {
+            **lease,
+            "created": now,
+            "last_change": now,
+            "node": lease.get("node_id", ""),
+            "phase": "created",
+            "proc": None,
+            "stderr": None,
+        }
+
+    def _spawn(self, pod: dict):
+        import subprocess
+
+        spec = pod.get("spec") or {}
+        argv = list(spec.get("command") or ())
+        if not argv:
+            argv = ["/bin/sh", "-c", f"sleep {self.default_runtime_s}"]
+        limit_bytes = None
+        if self.enforce_rlimits:
+            mem = (spec.get("requests") or {}).get("memory")
+            if mem:
+                from ..core.resources import parse_quantity
+
+                limit_bytes = int(parse_quantity(mem))
+
+        def preexec():
+            import resource
+
+            if limit_bytes:
+                resource.setrlimit(
+                    resource.RLIMIT_AS, (limit_bytes, limit_bytes)
+                )
+
+        # stderr spools to an unnamed temp file, not a PIPE: a chatty job
+        # writing past the pipe buffer would block in write(2) forever with
+        # nobody draining it. The file is unbounded, kernel-backed, and
+        # read only at failure time.
+        import tempfile
+
+        stderr = tempfile.TemporaryFile()
+        try:
+            return subprocess.Popen(
+                argv,
+                stdout=subprocess.DEVNULL,
+                stderr=stderr,
+                preexec_fn=preexec if limit_bytes else None,
+                start_new_session=True,  # kill() takes the process group
+            ), stderr
+        except OSError:
+            stderr.close()
+            raise
+
+    def kill(self, run_id: str):
+        pod = self.pods.pop(run_id, None)
+        if pod and pod.get("proc") is not None:
+            import os as _os
+            import signal
+
+            try:
+                _os.killpg(pod["proc"].pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            pod["proc"].wait()
+        if pod and pod.get("stderr") is not None:
+            pod["stderr"].close()
+
+    def poll(self, now: float) -> list[dict]:
+        events = []
+        for pod in list(self.pods.values()):
+            base = {
+                "job_id": pod["job_id"],
+                "run_id": pod["run_id"],
+                "queue": pod["queue"],
+                "jobset": pod["jobset"],
+                "created": now,
+            }
+            if pod["phase"] == "created":
+                try:
+                    pod["proc"], pod["stderr"] = self._spawn(pod)
+                except OSError as e:
+                    events.append(
+                        {
+                            "type": "failed",
+                            **base,
+                            "error": f"pod create failed: {e}",
+                            "retryable": True,
+                            "debug": _pod_debug(pod, now),
+                        }
+                    )
+                    self.pods.pop(pod["run_id"], None)
+                    continue
+                pod["phase"] = "pending"
+                events.append({"type": "pending", **base})
+            elif pod["phase"] == "pending":
+                pod["phase"] = "running"
+                pod["started"] = now
+                events.append({"type": "running", **base})
+            elif pod["phase"] == "running":
+                rc = pod["proc"].poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    events.append({"type": "succeeded", **base})
+                else:
+                    stderr = b""
+                    f = pod.get("stderr")
+                    if f is not None:
+                        size = f.seek(0, 2)
+                        f.seek(max(0, size - 500))
+                        stderr = f.read() or b""
+                    events.append(
+                        {
+                            "type": "failed",
+                            **base,
+                            "error": (
+                                f"process exited rc={rc}: "
+                                f"{stderr.decode(errors='replace')}"
+                            ),
+                            "retryable": True,
+                            "debug": _pod_debug({**pod, "rc": rc}, now),
+                        }
+                    )
+                if pod.get("stderr") is not None:
+                    pod["stderr"].close()
+                self.pods.pop(pod["run_id"], None)
+        return events
+
+
+def _pod_debug(pod: dict, now: float) -> str:
+    """Human-readable pod state at failure time — the executor-side dump
+    the reference compresses into lookout's job_run.debug column."""
+    import json as _json
+
+    dump = {
+        "phase": pod.get("phase", ""),
+        "node": pod.get("node", ""),
+        "created": pod.get("created"),
+        "started": pod.get("started"),
+        "age_s": round(now - pod.get("created", now), 3),
+        "last_change_age_s": round(now - pod.get("last_change", now), 3),
+    }
+    if "rc" in pod:
+        dump["rc"] = pod["rc"]
+    return _json.dumps(dump, sort_keys=True)
+
+
 class ExecutorAgent:
     def __init__(
         self,
@@ -76,11 +238,18 @@ class ExecutorAgent:
         nodes: list[dict],
         pool: str = "default",
         runtime: _PodRuntime | None = None,
+        node_info=None,
     ):
         self.client = client
         self.name = name
         self.pool = pool
-        self.nodes = nodes
+        # Node classification (executor/node/node_group.go): derive each
+        # node's pool (label + reserved suffix) and node type up front so
+        # heartbeats carry them.
+        from .node_info import NodeInfoService
+
+        self.node_info = node_info or NodeInfoService(cluster_pool=pool)
+        self.nodes = self.node_info.decorate(nodes)
         self.runtime = runtime or _PodRuntime()
         self.acked: set[str] = set()
         # Pod-issue machinery + utilisation reporting (executor/podchecks,
@@ -135,15 +304,25 @@ class ExecutorAgent:
                     "created": now,
                     "error": f"pod issue: {issue['message']}",
                     "retryable": issue["retryable"],
+                    # Pod-state dump for the lookout debug drilldown
+                    # (job_run.debug, getjobrundebugmessage.go).
+                    "debug": _pod_debug(pod, now),
                 }
             )
             self.runtime.kill(issue["run_id"])
         # Reconciliation: runs the server believes are live here but the
         # runtime doesn't know (agent restart, lost pod) are reported
         # failed so the scheduler retries them elsewhere (the reference
-        # executor's missing-pod reconciliation).
+        # executor's missing-pod reconciliation). A run whose pod finished
+        # THIS tick was just popped from the runtime — it already has its
+        # real terminal event in this batch and must not be re-reported as
+        # missing (that would overwrite the real failure reason).
+        reported = {e["run_id"] for e in events}
         for run in reply.get("active_runs", []):
-            if run["run_id"] not in self.runtime.pods:
+            if (
+                run["run_id"] not in self.runtime.pods
+                and run["run_id"] not in reported
+            ):
                 events.append(
                     {
                         "type": "failed",
@@ -183,6 +362,13 @@ def main(argv=None):
     ap.add_argument("--memory", default="128Gi")
     ap.add_argument("--runtime", type=float, default=30.0)
     ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument(
+        "--backend",
+        choices=["simulated", "subprocess"],
+        default="simulated",
+        help="pod runtime: timed sleeps, or real OS processes running "
+        "each job's command with rlimit enforcement",
+    )
     args = ap.parse_args(argv)
     nodes = [
         {
@@ -191,12 +377,17 @@ def main(argv=None):
         }
         for i in range(args.nodes)
     ]
+    runtime = (
+        SubprocessPodRuntime(default_runtime_s=args.runtime)
+        if args.backend == "subprocess"
+        else _PodRuntime(runtime_s=args.runtime)
+    )
     agent = ExecutorAgent(
         ApiClient(args.server),
         args.name,
         nodes,
         pool=args.pool,
-        runtime=_PodRuntime(runtime_s=args.runtime),
+        runtime=runtime,
     )
     agent.run(args.interval)
 

@@ -96,6 +96,8 @@ def job_spec_from_dict(d: dict) -> JobSpec:
         affinity=affinity,
         gang=gang,
         annotations=dict(d.get("annotations", {})),
+        bid_prices=dict(d.get("bid_prices", {})),
+        command=tuple(d.get("command", ())),
     )
 
 
@@ -258,13 +260,49 @@ class ApiServer:
             )
         }
 
+    def _proxy_to_leader(self, method: str, req: dict):
+        """Reports describe the LEADER's rounds: a follower in file-lease
+        HA mode forwards report RPCs to the leader's advertised address
+        (the reference proxies via the Lease-holder connection,
+        internal/scheduler/reports client). Returns None when this
+        instance should answer locally (it is the leader, the address is
+        unknown, or it would dial itself)."""
+        elector = getattr(self.scheduler, "is_leader", None)
+        is_holder = getattr(elector, "is_holder", None)
+        if elector is None or is_holder is None or is_holder():
+            return None
+        addr = getattr(elector, "leader_address", lambda: "")()
+        if not addr or addr == getattr(elector, "advertise", ""):
+            return None
+        # One cached channel per leader address (a new channel per polled
+        # report RPC would leak fds on followers).
+        cached = getattr(self, "_leader_client", None)
+        if cached is None or cached[0] != addr:
+            if cached is not None:
+                cached[1].channel.close()
+            cached = (addr, ApiClient(addr))
+            self._leader_client = cached
+        try:
+            return cached[1]._call(method, req)
+        except Exception:
+            return None  # leader unreachable: serve the local (stale) view
+
     def _scheduling_report(self, req):
+        proxied = self._proxy_to_leader("SchedulingReport", req)
+        if proxied is not None:
+            return proxied
         return {"report": self.scheduler.reports.scheduling_report()}
 
     def _queue_report(self, req):
+        proxied = self._proxy_to_leader("QueueReport", req)
+        if proxied is not None:
+            return proxied
         return {"report": self.scheduler.reports.queue_report(req["queue"])}
 
     def _job_report(self, req):
+        proxied = self._proxy_to_leader("JobReport", req)
+        if proxied is not None:
+            return proxied
         return {"report": self.scheduler.reports.job_report(req["job_id"])}
 
     def _set_priority_override(self, req):
@@ -299,7 +337,9 @@ class ApiServer:
                 id=n["id"],
                 name=n.get("name", n["id"]),
                 executor=name,
-                pool=pool,
+                # Per-node pool override (node_group.go GetPool: pool label
+                # + reserved suffix): one cluster can span pools.
+                pool=n.get("pool", pool),
                 labels=dict(n.get("labels", {})),
                 taints=tuple(
                     Taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
@@ -351,6 +391,7 @@ class ApiServer:
                                 "id": job.spec.id,
                                 "requests": job.spec.requests,
                                 "annotations": job.spec.annotations,
+                                "command": list(job.spec.command),
                             }
                         ),
                     }
@@ -415,7 +456,8 @@ class ApiServer:
             "failed": lambda e: [
                 JobRunErrors(created=e["created"], job_id=e["job_id"],
                              run_id=e["run_id"], error=e.get("error", ""),
-                             retryable=bool(e.get("retryable", True))),
+                             retryable=bool(e.get("retryable", True)),
+                             debug=e.get("debug", "")),
             ],
         }
         items = req.get("events", [])

@@ -53,6 +53,19 @@ class SchedulerMetrics:
             ["pool", "queue"],
             registry=r,
         )
+        self.idealised_value = Gauge(
+            "scheduler_queue_idealised_value",
+            "Theoretical max value on a single mega node "
+            "(idealised_value.go; market pools)",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.realised_value = Gauge(
+            "scheduler_queue_realised_value",
+            "Value actually placed this round (market pools)",
+            ["pool", "queue"],
+            registry=r,
+        )
         self.skipped_executors = Gauge(
             "scheduler_skipped_executors",
             "Executors excluded from the current round (cordoned or lagging)",

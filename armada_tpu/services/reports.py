@@ -21,6 +21,10 @@ class QueueReport:
     actual_share: float = 0.0
     scheduled_jobs: int = 0
     preempted_jobs: int = 0
+    # Market pools: value placed this round vs the single-mega-node
+    # theoretical maximum (idealised_value.go:23 — the expectation gap).
+    idealised_value: float = 0.0
+    realised_value: float = 0.0
     # Unschedulable-reason histogram for this queue's jobs in the round
     # (the reference's queue report surfaces per-job context samples;
     # an aggregated view scales to 1M-job rounds).
@@ -65,11 +69,18 @@ class RoundReport:
             lines.append(f"  indicative gang {name}: {detail}")
         for q in sorted(self.queues):
             r = self.queues[q]
+            value = (
+                f" idealisedValue={r.idealised_value:.4f}"
+                f" realisedValue={r.realised_value:.4f}"
+                if r.idealised_value or r.realised_value
+                else ""
+            )
             lines.append(
                 f"  queue {q}: fairShare={r.fair_share:.4f} "
                 f"adjustedFairShare={r.adjusted_fair_share:.4f} "
                 f"actualShare={r.actual_share:.4f} "
                 f"scheduled={r.scheduled_jobs} preempted={r.preempted_jobs}"
+                + value
             )
         return "\n".join(lines)
 

@@ -57,6 +57,7 @@ class SchedulerService:
         is_leader=lambda: True,
         runner=None,
         bid_price_provider=None,
+        checkpoint=None,
     ):
         self.config = config
         self.log = log
@@ -106,6 +107,16 @@ class SchedulerService:
         # Jobs submitted since the last bid refresh: priced from the
         # current snapshot even when no (queue, band) key changed.
         self._unpriced_jobs: set[str] = set()
+        if checkpoint is not None:
+            # Bounded restart (services/checkpoint.py): seed the jobdb and
+            # event-sourced settings from the checkpoint, then the sync
+            # below replays only the log suffix past its cursor.
+            cursor, state = checkpoint
+            self.jobdb.load(state["jobdb"])
+            self.priority_overrides.update(state["priority_overrides"])
+            self.cordoned_queues.update(state["cordoned_queues"])
+            self.cordoned_executors.update(state["cordoned_executors"])
+            self.ingester.cursor = cursor
         self.ingester.sync()  # restore jobdb + event-sourced settings
         from ..utils.logging import get_logger
 
@@ -114,6 +125,17 @@ class SchedulerService:
 
         # Sync or async scheduling runner (runner/types.go seam).
         self.runner = runner if runner is not None else SyncRunner()
+
+    def checkpoint_state(self):
+        """(cursor, state) for CheckpointManager: the jobdb plus every
+        event-sourced setting materialized by _apply_settings_event, all
+        reflecting exactly the log prefix below the ingester cursor."""
+        return self.ingester.cursor, {
+            "jobdb": self.jobdb.dump(),
+            "priority_overrides": dict(self.priority_overrides),
+            "cordoned_queues": set(self.cordoned_queues),
+            "cordoned_executors": set(self.cordoned_executors),
+        }
 
     def attach_metrics(self, metrics):
         self.metrics = metrics
@@ -381,9 +403,12 @@ class SchedulerService:
         skipped = self._skipped_executors(executors)
         if self.metrics is not None and self.metrics.registry is not None:
             self.metrics.skipped_executors.set(len(skipped))
-        pools = {hb.pool for hb in executors.values()} or {
-            p.name for p in self.config.pools
-        }
+        pools = {
+            (n.pool or hb.pool)
+            for hb in executors.values()
+            for n in hb.nodes
+        } | {hb.pool for hb in executors.values()}
+        pools = pools or {p.name for p in self.config.pools}
         sequences: list[EventSequence] = []
         leased_this_cycle: set[str] = set()
         for pool in sorted(pools):
@@ -639,9 +664,13 @@ class SchedulerService:
         nodes: list[NodeSpec] = []
         node_executor: dict[str, str] = {}
         for hb in executors.values():
-            if hb.pool != pool or hb.name in skipped:
+            if hb.name in skipped:
                 continue
             for node in hb.nodes:
+                # Per-node pools (node_group.go GetPool): an executor's
+                # nodes may span pools; match each node, not the cluster.
+                if (node.pool or hb.pool) != pool:
+                    continue
                 nodes.append(node)
                 node_executor[node.id] = hb.name
 
@@ -811,6 +840,38 @@ class SchedulerService:
                 self.log_.with_fields(cycle=self.cycle_count, pool=pool).error(
                     "indicative pricing failed: %r", e
                 )
+        idealised: dict = {}
+        realised: dict = {}
+        if self.config.market_driven:
+            # Idealised vs realised value (idealised_value.go:23): the
+            # expectation-gap metric. Advisory — a failure must not fail
+            # the round.
+            from ..solver.idealised import (
+                calculate_idealised_value,
+                value_by_queue,
+            )
+
+            unit = {}
+            if self._bid_snapshot is not None:
+                unit = getattr(self._bid_snapshot, "resource_units", {}).get(
+                    pool, {}
+                )
+            if not unit:
+                unit = self.config.market_resource_unit
+            try:
+                placed = np_.asarray(result["scheduled_mask"], bool) | (
+                    np_.asarray(snap.job_is_running)
+                    & ~np_.asarray(result["preempted_mask"], bool)
+                )
+                realised = value_by_queue(snap, placed, unit)
+                idealised = calculate_idealised_value(
+                    self.config, pool, nodes, queues, running, queued,
+                    self._solve, unit,
+                )
+            except Exception as e:
+                self.log_.with_fields(cycle=self.cycle_count, pool=pool).error(
+                    "idealised value failed: %r", e
+                )
         self.last_cycle_stats = {
             "pool": pool,
             "jobs": snap.num_jobs,
@@ -825,7 +886,10 @@ class SchedulerService:
             preempted=self.last_cycle_stats["preempted"],
             solve_s=round(_time.time() - solve_started, 4),
         ).info("scheduling round complete")
-        self._record_round(pool, snap, result, solve_started, indicative)
+        self._record_round(
+            pool, snap, result, solve_started, indicative,
+            idealised=idealised, realised=realised,
+        )
 
         by_jobset: dict[tuple, list] = {}
         import numpy as np
@@ -899,7 +963,8 @@ class SchedulerService:
             "termination_reason": res.termination_reason,
         }
 
-    def _record_round(self, pool, snap, result, started, indicative=None):
+    def _record_round(self, pool, snap, result, started, indicative=None,
+                      idealised=None, realised=None):
         import numpy as np
 
         from ..solver.drf import unweighted_cost
@@ -940,6 +1005,8 @@ class SchedulerService:
                 actual_share=float(actual[q]),
                 scheduled_jobs=sched_by_q.get(q, 0),
                 preempted_jobs=preempt_by_q.get(q, 0),
+                idealised_value=float((idealised or {}).get(name, 0.0)),
+                realised_value=float((realised or {}).get(name, 0.0)),
             )
         reasons = result.get("unschedulable_reason")
         if reasons is not None:
@@ -981,6 +1048,13 @@ class SchedulerService:
                 self.metrics.actual_share.labels(pool=pool, queue=name).set(
                     float(actual[q])
                 )
+                if idealised or realised:
+                    self.metrics.idealised_value.labels(
+                        pool=pool, queue=name
+                    ).set(float((idealised or {}).get(name, 0.0)))
+                    self.metrics.realised_value.labels(
+                        pool=pool, queue=name
+                    ).set(float((realised or {}).get(name, 0.0)))
                 if sched_by_q.get(q):
                     self.metrics.scheduled_jobs.labels(pool=pool, queue=name).inc(
                         sched_by_q[q]

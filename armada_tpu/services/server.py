@@ -9,6 +9,7 @@ distributed deployment uses.
 
 from __future__ import annotations
 
+import os
 import threading
 import time as _time
 
@@ -40,17 +41,31 @@ class ControlPlane:
         data_dir: str | None = None,
     ):
         self.config = config or SchedulingConfig()
+        self.checkpoints = None
         if data_dir:
             from ..events.file_log import FileEventLog
+            from .checkpoint import CheckpointManager, CheckpointStore
 
             self.log = FileEventLog(data_dir)
+            store = CheckpointStore(os.path.join(data_dir, "checkpoints"))
+            self.checkpoints = CheckpointManager(store, self.log)
         else:
             self.log = InMemoryEventLog()
+
+        def _ckpt(name):
+            return (
+                self.checkpoints.store.load(name) if self.checkpoints else None
+            )
+
         self.leader = StandaloneLeader()
         self.scheduler = SchedulerService(
-            self.config, self.log, backend=backend, is_leader=self.leader
+            self.config, self.log, backend=backend, is_leader=self.leader,
+            checkpoint=_ckpt("scheduler"),
         )
-        self.submit = SubmitService(self.config, self.log, scheduler=self.scheduler)
+        self.submit = SubmitService(
+            self.config, self.log, scheduler=self.scheduler,
+            checkpoint=_ckpt("submit"),
+        )
         self.query = QueryApi(self.scheduler.jobdb)
         self.metrics = SchedulerMetrics()
         self.scheduler.attach_metrics(self.metrics)
@@ -88,7 +103,9 @@ class ControlPlane:
         # streams instead of scanning the shared log.
         from .event_index import EventStreamIndex
 
-        self.event_index = EventStreamIndex(self.log)
+        self.event_index = EventStreamIndex(
+            self.log, checkpoint=_ckpt("event_index")
+        )
         self.api = ApiServer(
             self.submit,
             self.scheduler,
@@ -108,8 +125,16 @@ class ControlPlane:
         from .lookout_ingester import LookoutStore
 
         self.lookout_store = LookoutStore(
-            self.log, error_rules=self.config.error_categories
+            self.log, error_rules=self.config.error_categories,
+            checkpoint=_ckpt("lookout"),
         )
+        if self.checkpoints is not None:
+            # Every log consumer that replays on restart must be
+            # registered: compaction trails the min checkpointed cursor.
+            self.checkpoints.register("scheduler", self.scheduler)
+            self.checkpoints.register("submit", self.submit)
+            self.checkpoints.register("event_index", self.event_index)
+            self.checkpoints.register("lookout", self.lookout_store)
         self.lookout = None
         if lookout_port is not None:
             from .lookout_http import LookoutHttpServer
@@ -177,6 +202,13 @@ class ControlPlane:
                 self.event_index.prune(
                     _time.time() - self.config.terminal_job_retention_s
                 )
+                if self.checkpoints is not None:
+                    # Bounded restart + bounded disk: checkpoint all views,
+                    # drop log segments they have all materialized
+                    # (services/checkpoint.py).
+                    self.submit.sync()
+                    self.event_index.sync()
+                    self.checkpoints.checkpoint_and_compact()
             if self.metrics.registry is not None:
                 self.metrics.cycle_time.observe(_time.time() - started)
             self._stop.wait(self.cycle_period)
@@ -191,6 +223,16 @@ class ControlPlane:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if self.checkpoints is not None:
+            # Clean shutdown writes a final checkpoint so the next start
+            # replays (near-)nothing; a kill-9 still recovers from the
+            # last periodic checkpoint + suffix replay.
+            try:
+                self.submit.sync()
+                self.event_index.sync()
+                self.checkpoints.save_all()
+            except Exception as e:
+                print(f"final checkpoint failed: {e!r}")
         self.grpc_server.stop(grace=0.5)
         if self.metrics_server:
             self.metrics_server.shutdown()

@@ -49,19 +49,47 @@ class Queue:
 
 
 class SubmitService:
-    def __init__(self, config: SchedulingConfig, log, scheduler=None):
+    def __init__(self, config: SchedulingConfig, log, scheduler=None,
+                 checkpoint=None):
         self.config = config
         self.log = log
         self.scheduler = scheduler  # optional: queue updates pushed through
         self.queues: dict[str, Queue] = {}
         self._dedup: dict[tuple, str] = {}  # (queue, dedup_id) -> job_id
+        self._cursor = 0  # log offset the view reflects
+        if checkpoint is not None:
+            # Bounded restart (services/checkpoint.py): seed the registry
+            # and dedup index, replay only the suffix.
+            self._cursor, state = checkpoint
+            self._dedup.update(state["dedup"])
+            for queue in state["queues"].values():
+                self.queues[queue.spec.name] = queue
+                if self.scheduler is not None:
+                    self.scheduler.upsert_queue(
+                        queue.spec, cordoned=queue.cordoned
+                    )
         self._replay()
+
+    def checkpoint_state(self):
+        return self._cursor, {
+            "queues": dict(self.queues),
+            "dedup": dict(self._dedup),
+        }
 
     def _replay(self):
         """Rebuild queue registry and dedup index from the (durable) log —
         the control-plane materialized view (queues in Postgres + dedup
-        table in the reference)."""
-        for entry in self.log.read(0, 10**9):
+        table in the reference). Starts at the checkpoint cursor (or the
+        log's compaction point) and remembers where it stopped; calling it
+        again consumes the new suffix (idempotent re-application: local
+        mutations were already applied at publish time), which advances
+        the checkpoint cursor and, in file-lease HA, picks up queue events
+        published by the other replica."""
+        self._cursor = max(self._cursor, self.log.start_offset)
+        entries = self.log.read(self._cursor, 10**9)
+        if entries:
+            self._cursor = entries[-1].offset + 1
+        for entry in entries:
             for event in entry.sequence.events:
                 if isinstance(event, QueueUpsert):
                     from .auth import QueuePermission
@@ -87,6 +115,10 @@ class SubmitService:
                     self._dedup[
                         (entry.sequence.queue, event.deduplication_id)
                     ] = event.job.id
+
+    def sync(self):
+        """Consume the log suffix (see _replay)."""
+        self._replay()
 
     def _publish_queue_event(self, event):
         self.log.publish(EventSequence.of("", CONTROL_PLANE_JOBSET, event))

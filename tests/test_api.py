@@ -350,3 +350,67 @@ def test_cordon_executor_over_grpc(client, plane):
     assert "fake-a" in plane.scheduler.cordoned_executors
     client.cordon_executor("fake-a", uncordon=True)
     assert "fake-a" not in plane.scheduler.cordoned_executors
+
+
+def test_follower_proxies_reports_to_leader(tmp_path):
+    """File-lease HA: a follower answers report RPCs by proxying to the
+    leader's advertised address (the reference proxies reports over the
+    Lease-holder connection); with the leader gone it falls back to its
+    local view instead of failing."""
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.core.types import JobSpec, QueueSpec
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.grpc_api import ApiClient, ApiServer
+    from armada_tpu.services.queryapi import QueryApi
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    path = str(tmp_path / "lease")
+    config = SchedulingConfig()
+
+    def build(identity):
+        log = InMemoryEventLog()
+        elector = FileLeaseLeader(path, lease_duration=30.0, identity=identity)
+        sched = SchedulerService(config, log, backend="oracle",
+                                 is_leader=elector)
+        submit = SubmitService(config, log, scheduler=sched)
+        api = ApiServer(submit, sched, QueryApi(sched.jobdb), log)
+        server, port = api.serve(0)
+        elector.advertise = f"127.0.0.1:{port}"
+        return log, elector, sched, submit, server, port
+
+    log_a, el_a, sched_a, submit_a, srv_a, port_a = build("a")
+    assert el_a()  # a acquires (and writes its advertise on next renew)
+    assert el_a()  # renew persists the advertise line
+    log_b, el_b, sched_b, submit_b, srv_b, port_b = build("b")
+    assert not el_b()  # b is a follower
+
+    try:
+        # Only the LEADER runs a round (the follower's reports are empty).
+        submit_a.create_queue(QueueSpec("team"))
+        FakeExecutor("c", log_a, sched_a,
+                     nodes=make_nodes("c", count=2, cpu="8", memory="32Gi"),
+                     runtime_for=lambda j: 100.0).tick(0.0)
+        submit_a.submit(
+            "team", "s1",
+            [JobSpec(id="j0", queue="",
+                     requests={"cpu": "1", "memory": "1Gi"})],
+            now=0.0,
+        )
+        sched_a.cycle(now=1.0)
+        assert "team" in sched_a.reports.scheduling_report()
+        assert "team" not in sched_b.reports.scheduling_report()
+
+        # The follower's RPC answer carries the leader's report.
+        client_b = ApiClient(f"127.0.0.1:{port_b}")
+        rep = client_b._call("SchedulingReport", {})["report"]
+        assert "team" in rep
+
+        # Leader gone: the follower serves its own (empty) view rather
+        # than erroring.
+        srv_a.stop(grace=0)
+        rep = client_b._call("SchedulingReport", {})["report"]
+        assert "team" not in rep
+    finally:
+        srv_b.stop(grace=0)

@@ -1,0 +1,219 @@
+"""Real pod lifecycle behind the executor seam: SubprocessPodRuntime runs
+leases as actual OS processes (executor/job/submit.go creates pods; the
+seam is ClusterContext), and NodeInfoService derives per-node pools/types
+(executor/node/node_group.go)."""
+
+import time
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.services.executor_agent import (
+    ExecutorAgent,
+    SubprocessPodRuntime,
+)
+from armada_tpu.services.grpc_api import ApiClient
+from armada_tpu.services.node_info import NodeInfoService
+from armada_tpu.services.server import ControlPlane
+
+
+def _wait(pred, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ---- node classification (node_group.go) ----
+
+
+def test_node_info_pool_label_and_reserved_suffix():
+    svc = NodeInfoService(cluster_pool="cluster-a")
+    assert svc.get_pool({"id": "n0"}) == "cluster-a"
+    assert (
+        svc.get_pool({"id": "n1", "labels": {"armadaproject.io/pool": "gpu"}})
+        == "gpu"
+    )
+    # Reservation taint appends the reserved suffix (node_group.go:91-93).
+    reserved = {
+        "id": "n2",
+        "labels": {"armadaproject.io/pool": "gpu"},
+        "taints": [
+            {"key": "armadaproject.io/reservation", "value": "team-x"}
+        ],
+    }
+    assert svc.get_pool(reserved) == "gpu-reserved"
+    assert NodeInfoService(
+        cluster_pool="c", reserved_node_pool_suffix=""
+    ).get_pool(reserved) == "gpu"
+
+
+def test_node_info_type_from_label_or_taints():
+    svc = NodeInfoService(tolerated_taints=("gpu", "special"))
+    assert svc.get_type({"id": "n0"}) == "none"
+    assert (
+        svc.get_type(
+            {"id": "n1", "labels": {"armadaproject.io/node-type": "a100"}}
+        )
+        == "a100"
+    )
+    # Tolerated taints identify the type; untolerated ones do not.
+    assert (
+        svc.get_type(
+            {
+                "id": "n2",
+                "taints": [
+                    {"key": "special", "value": "true"},
+                    {"key": "gpu", "value": "true"},
+                    {"key": "unrelated", "value": "x"},
+                ],
+            }
+        )
+        == "gpu,special"
+    )
+    groups = svc.group_nodes_by_type(
+        [
+            {"id": "a", "taints": [{"key": "gpu", "value": "1"}]},
+            {"id": "b", "taints": [{"key": "gpu", "value": "1"}]},
+            {"id": "c"},
+        ]
+    )
+    assert sorted(groups) == ["gpu", "none"]
+    assert [n["id"] for n in groups["gpu"]] == ["a", "b"]
+
+
+def test_per_node_pools_reach_the_scheduler():
+    """A single cluster spanning two pools: each node schedules only in
+    its own pool (scheduling_algo union semantics with per-node pools)."""
+    p = ControlPlane(SchedulingConfig(), cycle_period=0.05).start()
+    try:
+        client = ApiClient(p.address)
+        client.create_queue("mix")
+        agent = ExecutorAgent(
+            ApiClient(p.address),
+            "mixed-exec",
+            nodes=[
+                {"id": "cpu-0", "total_resources": {"cpu": "8", "memory": "32Gi"}},
+                {
+                    "id": "gpu-0",
+                    "total_resources": {"cpu": "8", "memory": "32Gi"},
+                    "labels": {"armadaproject.io/pool": "gpu"},
+                },
+            ],
+            pool="default",
+        )
+        agent.tick()
+        assert _wait(
+            lambda: "mixed-exec" in p.scheduler.executors
+            and {n.pool for n in p.scheduler.executors["mixed-exec"].nodes}
+            == {"default", "gpu"}
+        )
+    finally:
+        p.stop()
+
+
+# ---- real processes (submit.go / cluster context seam) ----
+
+
+def _submit(client, queue, command, memory="32Mi"):
+    return client.submit_jobs(
+        queue,
+        "set1",
+        [
+            {
+                "priority": 0,
+                "requests": {"cpu": "1", "memory": memory},
+                "command": command,
+            }
+        ],
+    )[0]
+
+
+def test_subprocess_pod_runs_real_process(tmp_path):
+    marker = tmp_path / "ran.txt"
+    p = ControlPlane(SchedulingConfig(), cycle_period=0.05).start()
+    try:
+        client = ApiClient(p.address)
+        client.create_queue("real")
+        agent = ExecutorAgent(
+            ApiClient(p.address),
+            "real-exec",
+            nodes=[{"id": "rn-0", "total_resources": {"cpu": "8", "memory": "32Gi"}}],
+            runtime=SubprocessPodRuntime(),
+        )
+        jid = _submit(
+            client, "real",
+            ["/bin/sh", "-c", f"echo done > {marker}"],
+        )
+        assert _wait(lambda: (agent.tick(), marker.exists())[1])
+        assert _wait(
+            lambda: (
+                agent.tick(),
+                p.scheduler.jobdb.get(jid).state.value == "succeeded",
+            )[1]
+        )
+        assert marker.read_text().strip() == "done"
+    finally:
+        p.stop()
+
+
+def test_subprocess_pod_failure_reports_rc_and_debug():
+    p = ControlPlane(SchedulingConfig(), cycle_period=0.05).start()
+    try:
+        client = ApiClient(p.address)
+        client.create_queue("fail")
+        agent = ExecutorAgent(
+            ApiClient(p.address),
+            "fail-exec",
+            nodes=[{"id": "fn-0", "total_resources": {"cpu": "8", "memory": "32Gi"}}],
+            runtime=SubprocessPodRuntime(),
+        )
+        jid = _submit(
+            client, "fail",
+            ["/bin/sh", "-c", "echo boom >&2; exit 3"],
+        )
+
+        def failed():
+            agent.tick()
+            job = p.scheduler.jobdb.get(jid)
+            return job is not None and job.error
+        assert _wait(failed)
+        job = p.scheduler.jobdb.get(jid)
+        assert "rc=3" in job.error and "boom" in job.error
+        # The lookout view carries the run's debug dump.
+        p.lookout_store.sync()
+        row = p.lookout_store.get(jid)
+        assert row.runs and '"rc": 3' in row.runs[-1].debug
+    finally:
+        p.stop()
+
+
+def test_subprocess_rlimit_enforces_memory_request():
+    """The kernel, not a simulation, enforces the memory request: a job
+    allocating far beyond its request dies on RLIMIT_AS."""
+    import sys
+
+    p = ControlPlane(SchedulingConfig(), cycle_period=0.05).start()
+    try:
+        client = ApiClient(p.address)
+        client.create_queue("oom")
+        agent = ExecutorAgent(
+            ApiClient(p.address),
+            "oom-exec",
+            nodes=[{"id": "on-0", "total_resources": {"cpu": "8", "memory": "32Gi"}}],
+            runtime=SubprocessPodRuntime(),
+        )
+        jid = _submit(
+            client, "oom",
+            [sys.executable, "-c", "x = bytearray(256 * 1024 * 1024)"],
+            memory="64Mi",
+        )
+
+        def failed():
+            agent.tick()
+            job = p.scheduler.jobdb.get(jid)
+            return job is not None and job.error
+        assert _wait(failed)
+        assert "rc=" in p.scheduler.jobdb.get(jid).error
+    finally:
+        p.stop()

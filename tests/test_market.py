@@ -127,3 +127,97 @@ def test_two_queues_price_order_interleaves():
     scheduled = {snap.job_ids[j] for j in np.flatnonzero(res.scheduled_mask)}
     # capacity 6 cpu = 3 jobs: bids 9, 6, 3 win across queues
     assert scheduled == {"j1", "j2", "j0"}
+
+
+def test_idealised_vs_realised_value():
+    """idealised_value.go:23: on a market pool, the idealised value prices
+    the round as if the pool were one mega node with static requirements
+    ignored. A high-bid job too big for any single node contributes to the
+    idealised value but not the realised one (the expectation gap)."""
+    from armada_tpu.solver.idealised import (
+        calculate_idealised_value,
+        value_by_queue,
+    )
+
+    nodes = [
+        NodeSpec(id=f"n{i}", pool="default",
+                 total_resources={"cpu": "8", "memory": "32Gi"})
+        for i in range(2)
+    ]
+    queues = [QueueSpec("q", 1.0)]
+    # j0 needs 12 cpu: fits no node, fits the 16-cpu mega node. j1/j2 fit.
+    queued = [
+        bid_job(0, 10.0, cpu="12"),
+        bid_job(1, 2.0, cpu="4"),
+        bid_job(2, 1.0, cpu="4"),
+    ]
+    snap = build_round_snapshot(MKT, "default", nodes, queues, [], queued)
+    result = ReferenceSolver(snap).solve()
+    unit = {"cpu": "1"}
+
+    def solve_fn(s):
+        res = ReferenceSolver(s).solve()
+        return {"scheduled_mask": res.scheduled_mask}
+
+    realised = value_by_queue(snap, result.scheduled_mask, unit)
+    idealised = calculate_idealised_value(
+        MKT, "default", nodes, queues, [], queued, solve_fn, unit
+    )
+    # Realised: j1 (2.0 x 4) + j2 (1.0 x 4) = 12; j0 doesn't fit anywhere.
+    assert realised["q"] == 12.0
+    # Idealised: j0 (10 x 12) + j1 (2 x 4) = 128 on the 16-cpu mega node
+    # (j2 no longer fits behind the higher-value j0).
+    assert idealised["q"] == 128.0
+    assert idealised["q"] > realised["q"]
+
+
+def test_idealised_value_ignores_static_requirements():
+    """Selectors that match no node are ignored on the mega node
+    (StaticRequirementsIgnoringIterator)."""
+    from armada_tpu.solver.idealised import calculate_idealised_value
+
+    nodes = [node()]
+    queues = [QueueSpec("q", 1.0)]
+    queued = [
+        bid_job(0, 5.0, cpu="2",
+                node_selector={"zone": "nowhere"}),
+    ]
+
+    def solve_fn(s):
+        res = ReferenceSolver(s).solve()
+        return {"scheduled_mask": res.scheduled_mask}
+
+    idealised = calculate_idealised_value(
+        MKT, "default", nodes, queues, [], queued, solve_fn, {"cpu": "1"}
+    )
+    assert idealised["q"] == 10.0  # 5.0 bid x 2 cpu units
+
+
+def test_scheduler_service_reports_values():
+    """The service wires idealised/realised value into reports + the
+    report string on market pools."""
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    log = InMemoryEventLog()
+    sched = SchedulerService(MKT, log, backend="oracle")
+    submit = SubmitService(MKT, log, scheduler=sched)
+    FakeExecutor("c", log, sched,
+                 nodes=make_nodes("c", count=2, cpu="8", memory="32Gi"),
+                 runtime_for=lambda j: 100.0).tick(0.0)
+    submit.create_queue(QueueSpec("q"))
+    submit.submit(
+        "q", "s1",
+        [JobSpec(id=f"j{i}", queue="", requests={"cpu": "4", "memory": "1Gi"},
+                 bid_prices={"default": 2.0})
+         for i in range(3)],
+        now=0.0,
+    )
+    sched.cycle(now=1.0)
+    rep = sched.reports.latest_reports()["default"]
+    qr = rep.queues["q"]
+    assert qr.realised_value == 3 * 2.0 * 4  # three 4-cpu jobs at bid 2.0
+    assert qr.idealised_value >= qr.realised_value
+    assert "idealisedValue" in rep.report_string()
