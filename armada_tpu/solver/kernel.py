@@ -1010,6 +1010,96 @@ def _schedule_pass(
         )
         return c2, applied_n
 
+    def ev_batchable(s):
+        """Slots the evicted-rebind window may batch: singleton running
+        gangs with no uniformity search (the pinned path consults only the
+        home node). One predicate shared by head eligibility and window
+        membership so the two can never drift apart. (Callers must also
+        require all-evicted: lazy_valid enforces it for entries,
+        all_ev_flags for heads.)"""
+        return (
+            (dev.slot_count[s] == 1)
+            & dev.slot_is_running[s]
+            & (dev.slot_uni_end[s] <= dev.slot_uni_start[s])
+        )
+
+    def ev_fill_apply(c, q, widx_q, j_q, kq, pc):
+        """Place the accepted window prefix of EVICTED singleton slots for
+        one queue. Pinned semantics (_select_node: evicted jobs only ever
+        return to their node): entry i fits iff its home node still holds
+        its request at its priority row net of earlier window entries on
+        the same node (or the over-allocated-unschedulable special case).
+        Binding mirrors _bind for was_evicted: rows <= prio lose the
+        request, row 0 nets zero. Queue accounting mirrors the serial
+        all-evicted path: qalloc/qpc/floating grow, tokens and round caps
+        are NOT consumed. Returns (carry, placed)."""
+        W = dev.batch_window
+        ln = c.alloc.shape[1]
+        P = dev.priorities.shape[0]
+        ivec = jnp.arange(W, dtype=jnp.int32)
+        ent = ivec < kq
+        safe_j = jnp.clip(j_q, 0, dev.job_req.shape[0] - 1)
+        j0 = j_q[0]
+        prio = c.job_prio[j0]
+        preemptible = dev.job_preemptible[j0]
+        row_p = jnp.searchsorted(dev.priorities, prio).astype(jnp.int32)
+        nmax = dist.num_nodes(c.alloc)  # global id space (sharded-aware)
+        home = jnp.clip(c.job_node[safe_j], 0, nmax - 1)  # [W] global ids
+        req_fit = dev.job_req_fit[safe_j]  # [W, R]
+
+        # Requirement earlier window entries already placed on MY node.
+        same_before = (
+            (home[:, None] == home[None, :])
+            & (ivec[None, :] < ivec[:, None])
+            & ent[None, :]
+        )
+        prior = jnp.einsum(
+            "we,er->wr", same_before.astype(req_fit.dtype), req_fit
+        )
+        home_col = jax.vmap(lambda n: dist.take_col(c.alloc, n))(
+            home
+        )  # [W, P, R]
+        rows_le = jnp.where(
+            preemptible,
+            dev.priorities <= prio,
+            jnp.ones_like(dev.priorities, bool),
+        )
+        # Earlier entries' effect per row: rows<=prio except row 0 (the
+        # evicted add-back keeps row 0 flat).
+        rows_eff = rows_le & (jnp.arange(P) > 0)
+        col_after = home_col - jnp.where(
+            rows_eff[None, :, None], prior[:, None, :], 0
+        ).astype(home_col.dtype)
+        fit = jnp.all(req_fit <= col_after[:, row_p, :], axis=-1)
+        unsched = jax.vmap(lambda n: dist.take(dev.node_unschedulable, n))(
+            home
+        )
+        over_alloc = jnp.any(col_after < 0, axis=(1, 2))
+        ok_e = ent & (fit | (unsched & over_alloc))
+        fail_pos = jnp.min(jnp.where(ent & ~ok_e, ivec, W))
+        applied_n = jnp.minimum(kq, fail_pos).astype(jnp.int32)
+        app = ivec < applied_n
+
+        req_fit_e = jnp.where(app[:, None], req_fit, 0)
+        req_full_e = jnp.where(app[:, None], _f(dev.job_req[safe_j]), 0.0)
+        delta = dist.segment_to_nodes(
+            req_fit_e.astype(c.alloc.dtype), jnp.where(app, home, -1), ln
+        )
+        alloc = c.alloc - jnp.where(rows_eff[:, None, None], delta[None], 0)
+        sum_full = jnp.sum(req_full_e, axis=0)
+        jdrop = jnp.where(app, j_q, dev.job_req.shape[0])
+        sdrop = jnp.where(app, widx_q, S)
+        c2 = c._replace(
+            alloc=alloc,
+            qalloc=c.qalloc.at[q].add(sum_full),
+            qpc_alloc=c.qpc_alloc.at[q, pc].add(sum_full),
+            job_evicted=c.job_evicted.at[jdrop].set(False, mode="drop"),
+            evict_rank=c.evict_rank.at[jdrop].set(-2, mode="drop"),
+            slot_state=c.slot_state.at[sdrop].set(jnp.int8(DONE), mode="drop"),
+            floating=c.floating + jnp.where(dev.floating_mask, sum_full, 0.0),
+        )
+        return c2, applied_n
+
     def merged_fill_step(c, ptr, heads, has_head, qkeys, all_ev_h, eligible):
         """Fast-mode multi-queue HETEROGENEOUS fill: ONE iteration batches
         the whole multi-queue sweep over windows of consecutive batchable
@@ -1034,17 +1124,31 @@ def _schedule_pass(
         i_f = ivec.astype(fdt)
 
         # Per-queue windows: maximal prefix of consecutive in-range,
-        # batchable, valid slots sharing the head's priority class.
+        # batchable, valid slots sharing the head's priority class. Two
+        # window KINDS, chosen by the head: queued windows batch
+        # slot_batchable slots through the grouped best-fit fill; EVICTED
+        # windows (head is an all-evicted running singleton) batch pinned
+        # rebinds — every singleton evicted slot qualifies (the pinned
+        # path consults only the home node, _select_node), uniform
+        # priority so the bind rows agree.
         raw = heads[:, None] + ivec[None, :]
         widx = jnp.clip(raw, 0, S - 1)  # [Q, W]
         in_range = raw < dev.queue_slot_end[:, None]
         j_w = jnp.clip(dev.slot_members[widx, 0], 0, J - 1)
         pc_h = dev.job_pc[j_w[:, 0]]
         vv = jax.vmap(lambda s: lazy_valid(c, s))(widx.reshape(-1)).reshape(Q, W)
+        kind_ev = dev.slot_is_running[jnp.clip(heads, 0, S - 1)]  # [Q]
+        ev_ok = ev_batchable(widx)
+        prio_w = c.job_prio[j_w]
+        kind_ok = jnp.where(
+            kind_ev[:, None],
+            ev_ok & (prio_w == prio_w[:, :1]),
+            dev.slot_batchable[widx] & ~dev.slot_is_running[widx],
+        )
         base = (
             eligible[:, None]
             & in_range
-            & dev.slot_batchable[widx]
+            & kind_ok
             & vv
             & (dev.job_pc[j_w] == pc_h[:, None])
         )
@@ -1054,6 +1158,7 @@ def _schedule_pass(
         # sentinels so they only self-match. gid = first-appearance rank of
         # the entry's key within the window; rank_in_g = how many earlier
         # window entries share its key. Windows are cut at key number G+1.
+        # (Evicted windows skip grouping entirely — placement is pinned.)
         grp = jnp.where(base, dev.slot_key_group[widx], -2 - ivec[None, :])
         eqm = (grp[:, :, None] == grp[:, None, :]) & (
             ivec[None, None, :] <= ivec[None, :, None]
@@ -1063,7 +1168,7 @@ def _schedule_pass(
         gnum = jnp.cumsum(first_occ.astype(jnp.int32), axis=1)
         gid = jnp.take_along_axis(gnum, first_j, axis=1) - 1
         rank_in_g = jnp.sum(eqm, axis=2).astype(jnp.int32) - 1
-        base = base & (gid < G)
+        base = base & ((gid < G) | kind_ev[:, None])
         base = jnp.cumprod(base.astype(jnp.int8), axis=1).astype(bool)
 
         # Entry costs from cumulative window requests (exact serial
@@ -1121,14 +1226,16 @@ def _schedule_pass(
         bk = [k[qb] for k in qkeys]
 
         # Entry validity: per-queue prefix gates (qtokens, per-PC caps)
-        # and the barrier.
-        qtok_ok = (c.qtokens[:, None] - i_f[None, :]) >= 1
+        # and the barrier. Evicted windows bypass both — the serial path's
+        # _constraint_code forces OK for all-evicted gangs (tokens and
+        # caps are not consumed by rebinds).
+        qtok_ok = ((c.qtokens[:, None] - i_f[None, :]) >= 1) | kind_ev[:, None]
         aq = jnp.arange(Q)
         qpc = c.qpc_alloc[aq, pc_h]  # [Q, R]
         pc_lim = dev.queue_pc_limit[aq, pc_h]  # [Q, R]
         pc_ok = ~jnp.any(
             qpc[:, None, :] + csum_incl > pc_lim[:, None, :], axis=-1
-        )
+        ) | kind_ev[:, None]
         below = jnp.zeros((Q, W), bool)
         gt = jnp.zeros((Q, W), bool)
         for a, b in zip(ekeys, bk):
@@ -1148,13 +1255,25 @@ def _schedule_pass(
         qidx = (jnp.arange(Q * W, dtype=jnp.int32) // W)[order]
         req_s = req_e.reshape(Q * W, -1)[order]  # [QW, R]
         req_taken = jnp.where(take[:, None], req_s, 0.0)
-        cum_cnt_b = jnp.cumsum(take.astype(jnp.int32)) - take.astype(jnp.int32)
+        # Evicted entries consume neither tokens nor round caps (the
+        # serial all-evicted exemptions); they DO count toward floating.
+        ev_flat = kind_ev[qidx]
+        consuming = take & ~ev_flat
+        req_consumed = jnp.where(consuming[:, None], req_s, 0.0)
+        cum_cnt_b = jnp.cumsum(consuming.astype(jnp.int32)) - consuming.astype(
+            jnp.int32
+        )
         cum_req = jnp.cumsum(req_taken, axis=0)
-        cum_req_b = cum_req - req_taken
-        tok_ok_g = (c.tokens - cum_cnt_b.astype(fdt)) >= 1
-        round_ok_g = ~jnp.any(
-            c.scheduled_new[None, :] + cum_req_b > dev.max_round_resources[None, :],
-            axis=-1,
+        cum_req_c = jnp.cumsum(req_consumed, axis=0)
+        cum_req_cb = cum_req_c - req_consumed
+        tok_ok_g = ((c.tokens - cum_cnt_b.astype(fdt)) >= 1) | ev_flat
+        round_ok_g = (
+            ~jnp.any(
+                c.scheduled_new[None, :] + cum_req_cb
+                > dev.max_round_resources[None, :],
+                axis=-1,
+            )
+            | ev_flat
         )
         float_ok_g = ~jnp.any(
             dev.floating_mask[None, :]
@@ -1173,12 +1292,20 @@ def _schedule_pass(
         # Sequential per-queue placement (deterministic queue order); each
         # queue's fill sees the capacity the previous queues consumed.
         def apply_q(q, state):
-            c, ptr, progressed = state
+            c, ptr, progressed, shortfall = state
 
             def do(args):
-                c, ptr, progressed = args
-                c2, placed = window_fill_apply(
-                    c, q, widx[q], j_w[q], gid[q], rank_in_g[q], k_q[q], pc_h[q]
+                c, ptr, progressed, shortfall = args
+                c2, placed = jax.lax.cond(
+                    kind_ev[q],
+                    lambda c: ev_fill_apply(
+                        c, q, widx[q], j_w[q], k_q[q], pc_h[q]
+                    ),
+                    lambda c: window_fill_apply(
+                        c, q, widx[q], j_w[q], gid[q], rank_in_g[q], k_q[q],
+                        pc_h[q],
+                    ),
+                    c,
                 )
                 ptr2 = jnp.where(
                     placed > 0, ptr.at[q].set(heads[q] + placed), ptr
@@ -1186,15 +1313,35 @@ def _schedule_pass(
                 ptr2 = jax.lax.cond(
                     placed > 0, lambda: advance(c2, ptr2, q), lambda: ptr2
                 )
-                return c2, ptr2, progressed | (placed > 0)
+                return (
+                    c2,
+                    ptr2,
+                    progressed | (placed > 0),
+                    shortfall | (placed < k_q[q]),
+                )
 
             return jax.lax.cond(
-                k_q[q] > 0, do, lambda a: a, (c, ptr, progressed)
+                k_q[q] > 0, do, lambda a: a, (c, ptr, progressed, shortfall)
             )
 
-        c, ptr, progressed = jax.lax.fori_loop(
-            0, Q, apply_q, (c, ptr, jnp.zeros((), bool))
+        c2, ptr2, progressed, shortfall = jax.lax.fori_loop(
+            0, Q, apply_q,
+            (c, ptr, jnp.zeros((), bool), jnp.zeros((), bool)),
         )
+        # Capacity shortfall with >1 active queue: some taken entries did
+        # not fit, yet entries merged-sorted AFTER them (other queues)
+        # were applied — a capacity-contested interleave the batch cannot
+        # express. Roll the whole iteration back (functional txn, like the
+        # serial gang attempt) and let the serial path resolve it exactly.
+        # Single-queue iterations keep the prefix commit: that IS the
+        # serial order.
+        multi = jnp.sum((k_q > 0).astype(jnp.int32)) > 1
+        keep = ~(shortfall & multi)
+        c = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(keep, b, a), c, c2
+        )
+        ptr = jnp.where(keep, ptr2, ptr)
+        progressed = progressed & keep
         return c, ptr, progressed
 
     def body(state):
@@ -1291,14 +1438,20 @@ def _schedule_pass(
 
         if fast_fill_enabled:
             all_ev_h = all_ev_flags[heads]
+            # Evicted heads (all-evicted running singletons) batch through
+            # the pinned-rebind window; queued heads through the grouped
+            # best-fit window.
+            ev_head = all_ev_h & ev_batchable(heads)
+            # Constraint codes with the serial path's all-evicted
+            # exemptions applied to evicted heads (tokens/caps bypassed,
+            # floating still gates).
             code_h = jax.vmap(
-                lambda s: _constraint_code(dev, c, s, jnp.zeros((), bool))
-            )(heads)
+                lambda s, ae: _constraint_code(dev, c, s, ae)
+            )(heads, ev_head)
             eligible = (
                 has_head
-                & dev.slot_batchable[heads]
-                & ~all_ev_h
                 & (code_h == OK)
+                & ((dev.slot_batchable[heads] & ~all_ev_h) | ev_head)
             )
             do_merge = jnp.any(eligible) & ~force_serial
 

@@ -251,3 +251,113 @@ def test_fast_fill_heterogeneous_queues():
     assert_set_parity(snap, serial, fast, "hetero")
     assert_no_overcommit(snap, fast)
     assert int(fast["num_loops"]) < int(serial["num_loops"]) // 4
+
+
+def test_fast_fill_batches_evicted_rebinds():
+    """Preemption-heavy round: a hog queue's running jobs are evicted for
+    balance and mostly rebind to their nodes. The evicted-window fast path
+    must batch those pinned rebinds — set parity (including preemptions),
+    invariants, and a loop count far below the evictee count."""
+    from armada_tpu.core.types import RunningJob
+
+    n_nodes, n_running, n_queued = 50, 400, 200
+    nodes = [
+        NodeSpec(
+            id=f"n{i:03d}",
+            pool="default",
+            total_resources={"cpu": "32", "memory": "256Gi"},
+        )
+        for i in range(n_nodes)
+    ]
+    queues = [QueueSpec(f"q{i}", 1.0) for i in range(4)]
+    running = [
+        RunningJob(
+            job=JobSpec(
+                id=f"run-{i:05d}",
+                queue="q0",  # hog queue: over fair share -> evicted
+                requests={"cpu": "2", "memory": "4Gi"},
+                submitted_ts=float(-n_running + i),
+            ),
+            node_id=f"n{i % n_nodes:03d}",
+            scheduled_at_priority=1000,
+        )
+        for i in range(n_running)
+    ]
+    queued = [
+        JobSpec(
+            id=f"j{i:05d}",
+            queue=f"q{1 + i % 3}",
+            requests={"cpu": str(1 + i % 3), "memory": "2Gi"},
+            submitted_ts=float(i),
+        )
+        for i in range(n_queued)
+    ]
+    cfg = dataclasses.replace(
+        PREEMPT_CFG, protected_fraction_of_fair_share=0.5
+    )
+    snap, serial, fast = solve_both(cfg, nodes, queues, running, queued)
+    assert_set_parity(snap, serial, fast, "evicted-rebind")
+    assert_no_overcommit(snap, fast)
+    assert (
+        np.asarray(serial["preempted_mask"])
+        == np.asarray(fast["preempted_mask"])
+    ).all(), "preemption outcomes diverge"
+    # Rebinds for pinned jobs land on the SAME node in both modes.
+    J = snap.num_jobs
+    rb = snap.job_is_running & ~np.asarray(fast["preempted_mask"])[:J]
+    assert (
+        np.asarray(serial["assigned_node"])[:J][rb]
+        == np.asarray(fast["assigned_node"])[:J][rb]
+    ).all()
+    # 400 evictees + 200 queued mixed keys: serial needs 600+ loops; the
+    # window path needs tens.
+    assert int(fast["num_loops"]) < int(serial["num_loops"]) / 5, (
+        f"fast {fast['num_loops']} vs serial {serial['num_loops']}"
+    )
+
+
+def test_fast_fill_evicted_rebind_capacity_cut():
+    """An evicted window where later rebinds no longer fit (queued work
+    from another queue got the capacity first in merged order): the window
+    cuts at the first failure and outcomes still match the serial loop."""
+    from armada_tpu.core.types import RunningJob
+
+    # One small node fully occupied by evictees; a competing queue's big
+    # queued jobs contend for the same capacity.
+    nodes = [
+        NodeSpec(id="n0", pool="default",
+                 total_resources={"cpu": "8", "memory": "32Gi"}),
+        NodeSpec(id="n1", pool="default",
+                 total_resources={"cpu": "8", "memory": "32Gi"}),
+    ]
+    queues = [QueueSpec("hog", 1.0), QueueSpec("fresh", 1.0)]
+    running = [
+        RunningJob(
+            job=JobSpec(
+                id=f"run-{i}", queue="hog",
+                requests={"cpu": "2", "memory": "4Gi"},
+                submitted_ts=float(-8 + i),
+            ),
+            node_id=f"n{i % 2}",
+            scheduled_at_priority=1000,
+        )
+        for i in range(8)
+    ]
+    queued = [
+        JobSpec(
+            id=f"j{i}", queue="fresh",
+            requests={"cpu": "4", "memory": "8Gi"},
+            submitted_ts=float(i),
+        )
+        for i in range(4)
+    ]
+    cfg = dataclasses.replace(
+        PREEMPT_CFG, protected_fraction_of_fair_share=0.0
+    )
+    snap, serial, fast = solve_both(cfg, nodes, queues, running, queued)
+    assert_set_parity(snap, serial, fast, "evicted-cut")
+    assert_no_overcommit(snap, fast)
+    assert (
+        np.asarray(serial["preempted_mask"])
+        == np.asarray(fast["preempted_mask"])
+    ).all()
