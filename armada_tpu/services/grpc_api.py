@@ -30,6 +30,9 @@ from ..jobdb import JobState
 from .queryapi import JobFilter, Order
 
 SERVICE = "armada_tpu.Api"
+# Binary-protobuf twin of the method table (proto/armada.proto): codegen
+# clients in any protobuf language hit the same handlers through it.
+PROTO_SERVICE = "armada_tpu.ProtoApi"
 
 
 def _encode(obj) -> bytes:
@@ -69,6 +72,14 @@ def job_spec_from_dict(d: dict) -> JobSpec:
     )
     affinity = None
     if d.get("affinity"):
+        raw = d["affinity"]
+        # Two accepted shapes: the legacy JSON list-of-term-lists, and the
+        # proto json_format mapping {"terms": [{"expressions": [...]}]}.
+        terms = (
+            [t.get("expressions", ()) for t in raw.get("terms", ())]
+            if isinstance(raw, dict)
+            else raw
+        )
         affinity = Affinity(
             terms=tuple(
                 NodeSelectorTerm(
@@ -81,7 +92,7 @@ def job_spec_from_dict(d: dict) -> JobSpec:
                         for e in term
                     )
                 )
-                for term in d["affinity"]
+                for term in terms
             )
         )
     return JobSpec(
@@ -115,6 +126,7 @@ class ApiServer:
         auth=None,
         authorizer=None,
         event_index=None,
+        store_health=None,
     ):
         self.submit = submit
         self.scheduler = scheduler
@@ -122,6 +134,9 @@ class ApiServer:
         self.log = log
         self.submit_checker = submit_checker
         self.binoculars = binoculars
+        # Optional backpressure monitor (services/backpressure.py):
+        # surfaced to executors in lease replies.
+        self.store_health = store_health
         # Optional per-jobset event-stream index (services/event_index.py,
         # the event-ingester view): watchers read only their jobset's
         # offsets instead of scanning the whole log.
@@ -364,6 +379,15 @@ class ApiServer:
 
         acked = set(req.get("acked_run_ids", []))
         leases, cancels, active = [], [], []
+        # Store backpressure (services/backpressure.py — the reference's
+        # executor pauses pod creation on etcd pressure,
+        # executor/application.go:63-101): checked up front so an
+        # unhealthy reply skips building (and compressing) lease payloads
+        # the agent would discard anyway. Cancels and reconciliation still
+        # flow — they relieve pressure.
+        store_healthy = True
+        if self.store_health is not None:
+            store_healthy, _ = self.store_health.check()
         txn = self.scheduler.jobdb.read_txn()
         # Live runs on this executor come from the by-executor index; the
         # cancel sweep below resolves acked run ids directly (no full-store
@@ -372,7 +396,11 @@ class ApiServer:
             run = job.latest_run
             if run is None or run.executor != name:
                 continue
-            if job.state == JobState.LEASED and run.id not in acked:
+            if (
+                store_healthy
+                and job.state == JobState.LEASED
+                and run.id not in acked
+            ):
                 from ..utils.compress import compress_obj
 
                 leases.append(
@@ -425,7 +453,14 @@ class ApiServer:
                 and job.latest_run.executor == name
             ):
                 cancels.append({"run_id": rid, "job_id": job.id})
-        return {"leases": leases, "cancel_runs": cancels, "active_runs": active}
+        return {
+            "leases": leases,
+            "cancel_runs": cancels,
+            "active_runs": active,
+            # Agents defer creating pods for NEW leases while false;
+            # unacked leases are simply re-sent after recovery.
+            "store_healthy": store_healthy,
+        }
 
     def _report_events(self, req):
         """Executor-side state transitions republished to the log
@@ -497,11 +532,10 @@ class ApiServer:
 
     # ---- streaming ----
 
-    def _watch_jobset(self, req, context):
-        """Server-streaming jobset events (event.proto:279 GetJobSetEvents)."""
-        queue, jobset = req["queue"], req["jobset"]
-        cursor = int(req.get("from_offset", 0))
-        watch = bool(req.get("watch", True))
+    def _watch_entries(self, queue, jobset, cursor, watch, context):
+        """Shared watch core: (offset, EventSequence) pairs for one jobset,
+        following the log when `watch`. Both wire encodings stream through
+        this, so cursor/index semantics cannot diverge."""
         cond = self.log.watcher() if watch else None
         try:
             while context.is_active():
@@ -526,25 +560,13 @@ class ApiServer:
                     # The cursor advances past every scanned entry,
                     # matching or not — never rewound to the last match.
                     batch = []
+                    cursor = max(cursor, self.log.start_offset)
                     for entry in self.log.read(cursor, 1000):
                         cursor = entry.offset + 1
                         seq = entry.sequence
                         if seq.queue == queue and seq.jobset == jobset:
                             batch.append((entry.offset, seq))
-                for offset, seq in batch:
-                    for event in seq.events:
-                        payload = {
-                            "type": type(event).__name__,
-                            "offset": offset,
-                            **{
-                                k: v
-                                for k, v in dataclasses.asdict(event).items()
-                                if k != "job" and not isinstance(v, dict)
-                            },
-                        }
-                        if hasattr(event, "job") and event.job is not None:
-                            payload["job_id"] = event.job.id
-                        yield _encode(payload)
+                yield from batch
                 if not watch:
                     return
                 with cond:
@@ -553,7 +575,119 @@ class ApiServer:
             if cond is not None:
                 self.log.remove_watcher(cond)
 
+    def _watch_jobset(self, req, context):
+        """Server-streaming jobset events (event.proto:279 GetJobSetEvents)."""
+        for offset, seq in self._watch_entries(
+            req["queue"],
+            req["jobset"],
+            int(req.get("from_offset", 0)),
+            bool(req.get("watch", True)),
+            context,
+        ):
+            for event in seq.events:
+                payload = {
+                    "type": type(event).__name__,
+                    "offset": offset,
+                    **{
+                        k: v
+                        for k, v in dataclasses.asdict(event).items()
+                        if k != "job" and not isinstance(v, dict)
+                    },
+                }
+                if hasattr(event, "job") and event.job is not None:
+                    payload["job_id"] = event.job.id
+                yield _encode(payload)
+
     # ---- wiring ----
+
+    def _proto_handler(self, method: str, table, gate, watchers):
+        """RPC handler for the binary-protobuf service: proto request ->
+        json_format dict -> the SAME method handler -> proto response.
+        Field names in proto/armada.proto match the JSON wire, so the two
+        encodings cannot drift. WatchJobSet streams full EventSequenceEntry
+        messages (the armadaevents EventSequence shape) straight from the
+        log entries."""
+        from google.protobuf import json_format
+
+        from ..proto import armada_pb2 as pb
+
+        unary_types = {
+            "SubmitJobs": (pb.JobSubmitRequest, pb.JobSubmitResponse),
+            "CancelJobs": (pb.JobCancelRequest, pb.JobCancelResponse),
+            "ReprioritizeJobs": (
+                pb.JobReprioritizeRequest,
+                pb.JobReprioritizeResponse,
+            ),
+        }
+        if method == "WatchJobSet":
+            def stream(request, context):
+                msg = pb.WatchRequest.FromString(request)
+                req = {
+                    "queue": msg.queue,
+                    "jobset": msg.jobset,
+                    "from_offset": int(msg.from_offset),
+                    "watch": bool(msg.follow),
+                }
+                gate(method, req, context)
+                # Same watcher bound as the JSON stream: parked watch
+                # threads must not starve unary RPCs of the pool.
+                if not watchers.acquire(blocking=False):
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        "too many concurrent watchers",
+                    )
+                try:
+                    yield from self._watch_jobset_proto(msg, context)
+                finally:
+                    watchers.release()
+
+            return grpc.unary_stream_rpc_method_handler(
+                stream,
+                request_deserializer=bytes,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+        if method not in unary_types:
+            return None
+        req_type, resp_type = unary_types[method]
+        fn = table.get(method)
+
+        def unary(request, context):
+            msg = req_type.FromString(request)
+            # Defaults included: proto3 omits zero-valued fields from
+            # MessageToDict otherwise, and e.g. ReprioritizeJobs to
+            # priority 0 must look identical to the JSON encoding.
+            req = json_format.MessageToDict(
+                msg,
+                preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True,
+            )
+            gate(method, req, context)
+            try:
+                out = fn(req) or {}
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            resp = resp_type()
+            json_format.ParseDict(out, resp, ignore_unknown_fields=True)
+            return resp
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary,
+            request_deserializer=bytes,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+
+    def _watch_jobset_proto(self, msg, context):
+        """Proto watch: one EventSequenceEntry per matching log entry
+        (the armadaevents EventSequence shape), over the shared core."""
+        from ..proto import sequence_to_proto
+
+        for offset, seq in self._watch_entries(
+            msg.queue, msg.jobset, int(msg.from_offset), bool(msg.follow),
+            context,
+        ):
+            yield sequence_to_proto(offset, seq)
 
     def method_table(self):
         return {
@@ -618,7 +752,13 @@ class ApiServer:
             def service(self, handler_call_details):
                 name = handler_call_details.method  # /Service/Method
                 parts = name.strip("/").split("/")
-                if len(parts) != 2 or parts[0] != SERVICE:
+                if len(parts) != 2:
+                    return None
+                if parts[0] == PROTO_SERVICE:
+                    # Binary protobuf encoding of the same methods
+                    # (proto/armada.proto; the reference's pkg/api protos).
+                    return outer._proto_handler(parts[1], table, gate, watchers)
+                if parts[0] != SERVICE:
                     return None
                 method = parts[1]
                 if method == "WatchJobSet":
@@ -807,3 +947,86 @@ class ApiClient:
         )
         for msg in stream:
             yield _decode(msg)
+
+
+class ProtoApiClient:
+    """Binary-protobuf client over proto/armada.proto — what a codegen
+    client in any protobuf language looks like against this server (the
+    reference's generated pkg/api clients). Python builds it from the
+    same generated armada_pb2 the server uses."""
+
+    def __init__(self, target: str, token: str | None = None, basic=None):
+        self.channel = grpc.insecure_channel(target)
+        # Same credential surface as ApiClient: Bearer or Basic metadata
+        # for the server's auth chain.
+        self._metadata: list = []
+        if token:
+            self._metadata = [("authorization", f"Bearer {token}")]
+        elif basic:
+            import base64
+
+            user, password = basic
+            cred = base64.b64encode(f"{user}:{password}".encode()).decode()
+            self._metadata = [("authorization", f"Basic {cred}")]
+
+    def _unary(self, method: str, request, resp_type):
+        fn = self.channel.unary_unary(
+            f"/{PROTO_SERVICE}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_type.FromString,
+        )
+        return fn(request, metadata=self._metadata or None)
+
+    def submit_jobs(self, queue: str, jobset: str, items) -> list[str]:
+        from ..proto import armada_pb2 as pb
+
+        req = pb.JobSubmitRequest(queue=queue, jobset=jobset)
+        for item in items:
+            req.jobs.append(item)
+        return list(
+            self._unary("SubmitJobs", req, pb.JobSubmitResponse).job_ids
+        )
+
+    def cancel_jobs(self, queue, jobset, job_ids=(), cancel_jobset=False,
+                    reason=""):
+        from ..proto import armada_pb2 as pb
+
+        self._unary(
+            "CancelJobs",
+            pb.JobCancelRequest(
+                queue=queue, jobset=jobset, job_ids=list(job_ids),
+                cancel_jobset=cancel_jobset, reason=reason,
+            ),
+            pb.JobCancelResponse,
+        )
+
+    def reprioritize_jobs(self, queue, jobset, job_ids, priority):
+        from ..proto import armada_pb2 as pb
+
+        self._unary(
+            "ReprioritizeJobs",
+            pb.JobReprioritizeRequest(
+                queue=queue, jobset=jobset, job_ids=list(job_ids),
+                priority=priority,
+            ),
+            pb.JobReprioritizeResponse,
+        )
+
+    def watch_jobset(self, queue, jobset, from_offset=0, follow=True):
+        """Yields (offset, events.model.EventSequence)."""
+        from ..proto import armada_pb2 as pb, sequence_from_proto
+
+        fn = self.channel.unary_stream(
+            f"/{PROTO_SERVICE}/WatchJobSet",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.EventSequenceEntry.FromString,
+        )
+        stream = fn(
+            pb.WatchRequest(
+                queue=queue, jobset=jobset, from_offset=from_offset,
+                follow=follow,
+            ),
+            metadata=self._metadata or None,
+        )
+        for entry in stream:
+            yield sequence_from_proto(entry)

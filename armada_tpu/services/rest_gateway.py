@@ -188,7 +188,26 @@ class RestGateway:
                     self.submit.delete_queue(name)
                     return h._json({})
         if rest == ["job", "submit"] and verb == "POST":
-            body = h._body()
+            # Binary protobuf on the same route (proto/armada.proto
+            # JobSubmitRequest) — the transcoding the reference's
+            # grpc-gateway does for pkg/api/submit.proto. Codegen clients
+            # (e.g. the C++ client) POST application/x-protobuf; the
+            # json_format mapping lands in the identical body dict.
+            ctype = h.headers.get("Content-Type", "")
+            if ctype.startswith("application/x-protobuf"):
+                from google.protobuf import json_format
+
+                from ..proto import armada_pb2 as pb
+
+                length = int(h.headers.get("Content-Length", 0))
+                raw = h.rfile.read(length) if length else b""
+                body = json_format.MessageToDict(
+                    pb.JobSubmitRequest.FromString(raw),
+                    preserving_proto_field_name=True,
+                    always_print_fields_with_no_presence=True,
+                )
+            else:
+                body = h._body()
             if not h._gate("SubmitJobs", body):
                 return
             jobs = [
@@ -198,6 +217,18 @@ class RestGateway:
                 for j in body.get("jobs", [])
             ]
             ids = self.submit.submit(body["queue"], body["jobset"], jobs)
+            if "application/x-protobuf" in h.headers.get("Accept", ""):
+                from ..proto import armada_pb2 as pb
+
+                payload = pb.JobSubmitResponse(
+                    job_ids=ids
+                ).SerializeToString()
+                h.send_response(200)
+                h.send_header("Content-Type", "application/x-protobuf")
+                h.send_header("Content-Length", str(len(payload)))
+                h.end_headers()
+                h.wfile.write(payload)
+                return
             return h._json({"job_ids": ids})
         if rest == ["job", "cancel"] and verb == "POST":
             body = h._body()

@@ -270,13 +270,16 @@ Client ClientBuilder::build() const {
 // ---- transport ----
 
 HttpResponse Client::request(const std::string& method, const std::string& path,
-                             const std::string& body) {
+                             const std::string& body,
+                             const std::string& content_type,
+                             const std::string& accept) {
   int fd = dial(host_, port_, timeout_ms_);
   std::ostringstream req;
   req << method << " " << path << " HTTP/1.1\r\n"
       << "Host: " << host_ << ":" << port_ << "\r\n"
       << "Connection: close\r\n"
-      << "Content-Type: application/json\r\n";
+      << "Content-Type: " << content_type << "\r\n";
+  if (!accept.empty()) req << "Accept: " << accept << "\r\n";
   if (!auth_header_.empty()) req << auth_header_ << "\r\n";
   req << "Content-Length: " << body.size() << "\r\n\r\n" << body;
   try {

@@ -90,9 +90,13 @@ class Client {
   // Raw query passthrough: /api/v1/jobs?... (returns the JSON body).
   std::string get_jobs_raw(const std::string& query_string);
 
-  // Low-level request (exposed for tests and extensions).
+  // Low-level request (exposed for tests and extensions — e.g. the
+  // protobuf add-on in armada_client_proto.cpp sends
+  // application/x-protobuf bodies through it).
   HttpResponse request(const std::string& method, const std::string& path,
-                       const std::string& body);
+                       const std::string& body,
+                       const std::string& content_type = "application/json",
+                       const std::string& accept = "");
 
  private:
   friend class ClientBuilder;

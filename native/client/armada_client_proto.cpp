@@ -1,0 +1,46 @@
+// See armada_client_proto.hpp. Builds against the protoc-generated
+// armada.pb.{h,cc} (make proto) and full libprotobuf.
+
+#include "armada_client_proto.hpp"
+
+#include "armada.pb.h"
+
+namespace armada {
+
+std::vector<std::string> submit_jobs_proto(
+    Client& client, const std::string& queue, const std::string& jobset,
+    const std::vector<JobSubmitItem>& jobs) {
+  armada_tpu::api::JobSubmitRequest req;
+  req.set_queue(queue);
+  req.set_jobset(jobset);
+  for (const auto& item : jobs) {
+    auto* j = req.add_jobs();
+    j->set_priority(static_cast<int32_t>(item.priority));
+    j->set_priority_class(item.priority_class);
+    for (const auto& [name, qty] : item.requests) {
+      (*j->mutable_requests())[name] = qty;
+    }
+    for (const auto& [key, value] : item.annotations) {
+      (*j->mutable_annotations())[key] = value;
+    }
+    for (const auto& [key, value] : item.node_selector) {
+      (*j->mutable_node_selector())[key] = value;
+    }
+    if (!item.gang_id.empty()) {
+      j->mutable_gang()->set_id(item.gang_id);
+      j->mutable_gang()->set_cardinality(
+          static_cast<uint32_t>(item.gang_cardinality));
+    }
+  }
+  auto resp = client.request("POST", "/api/v1/job/submit",
+                             req.SerializeAsString(),
+                             "application/x-protobuf",
+                             "application/x-protobuf");
+  armada_tpu::api::JobSubmitResponse out;
+  if (!out.ParseFromString(resp.body)) {
+    throw ClientError(resp.status, "cannot parse JobSubmitResponse");
+  }
+  return {out.job_ids().begin(), out.job_ids().end()};
+}
+
+}  // namespace armada

@@ -1,0 +1,26 @@
+// Protobuf add-on for the armada-tpu C++ client.
+//
+// The base library (armada_client.hpp) is dependency-free JSON; this
+// translation unit links libprotobuf and speaks the binary wire format
+// generated from proto/armada.proto — the same schema every codegen
+// client builds against (the reference's generated pkg/api clients,
+// client/DotNet, client/java). Submission posts application/x-protobuf
+// to the gateway's submit route and parses a JobSubmitResponse.
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "armada_client.hpp"
+
+namespace armada {
+
+// Submit via the binary protobuf encoding. Items reuse the JSON client's
+// JobSubmitItem struct; they are re-encoded as
+// armada_tpu.api.JobSubmitRequest on the wire.
+std::vector<std::string> submit_jobs_proto(
+    Client& client, const std::string& queue, const std::string& jobset,
+    const std::vector<JobSubmitItem>& jobs);
+
+}  // namespace armada

@@ -1,0 +1,65 @@
+// Protobuf wire-format demo: submit over application/x-protobuf and
+// verify through the JSON query surface. Driven by
+// tests/test_cpp_client.py against a live control plane.
+//
+// Usage: proto_demo HOST PORT
+
+#include <chrono>
+#include <cstdlib>
+#include <thread>
+#include <iostream>
+
+#include "armada_client.hpp"
+#include "armada_client_proto.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::cerr << "usage: proto_demo HOST PORT\n";
+    return 2;
+  }
+  const std::string host = argv[1];
+  const int port = std::atoi(argv[2]);
+  try {
+    armada::Client client =
+        armada::ClientBuilder().target(host, port).build();
+    client.create_queue("cpp-proto", 1.0);
+
+    std::vector<armada::JobSubmitItem> jobs(2);
+    jobs[0].requests = {{"cpu", "1"}, {"memory", "1Gi"}};
+    jobs[0].priority = 1;
+    jobs[0].annotations = {{"encoding", "protobuf"}};
+    jobs[1].requests = {{"cpu", "2"}, {"memory", "2Gi"}};
+    jobs[1].priority = 2;
+
+    auto ids = armada::submit_jobs_proto(client, "cpp-proto", "pset", jobs);
+    if (ids.size() != 2) {
+      std::cerr << "expected 2 job ids, got " << ids.size() << "\n";
+      return 1;
+    }
+    for (const auto& id : ids) std::cout << "submitted " << id << "\n";
+
+    // Cross-encoding check: the JSON query surface sees proto
+    // submissions (ingestion lands on the next scheduler cycle; retry).
+    bool visible = false;
+    for (int attempt = 0; attempt < 40 && !visible; attempt++) {
+      auto body = client.get_jobs_raw("queue=cpp-proto&take=10");
+      visible = true;
+      for (const auto& id : ids) {
+        if (body.find(id) == std::string::npos) visible = false;
+      }
+      if (!visible) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      }
+    }
+    if (!visible) {
+      std::cerr << "proto-submitted jobs missing from JSON query\n";
+      return 1;
+    }
+    std::cout << "proto-submitted jobs visible over JSON query\n";
+    std::cout << "OK\n";
+    return 0;
+  } catch (const armada::ClientError& e) {
+    std::cerr << "client error " << e.status << ": " << e.what() << "\n";
+    return 1;
+  }
+}

@@ -1,0 +1,175 @@
+"""Binary protobuf wire format (proto/armada.proto): the codegen-client
+surface mirroring pkg/api/submit.proto:356-401 and
+pkg/armadaevents/events.proto:66-97, hosted on the same method table as
+the JSON encoding (services/grpc_api.py PROTO_SERVICE)."""
+
+import time
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import (
+    Affinity,
+    Gang,
+    JobSpec,
+    MatchExpression,
+    NodeSelectorTerm,
+    Toleration,
+)
+from armada_tpu.events import EventSequence, JobRunErrors, SubmitJob
+from armada_tpu.proto import (
+    armada_pb2 as pb,
+    job_spec_from_proto,
+    job_spec_to_proto,
+    sequence_from_proto,
+    sequence_to_proto,
+)
+from armada_tpu.services.grpc_api import ProtoApiClient
+from armada_tpu.services.server import ControlPlane
+
+CFG = SchedulingConfig(
+    priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+    default_priority_class="d",
+)
+
+
+def test_job_spec_proto_roundtrip():
+    spec = JobSpec(
+        id="j0",
+        queue="q",
+        jobset="s",
+        priority=4,
+        priority_class="d",
+        requests={"cpu": "2", "memory": "4Gi"},
+        node_selector={"zone": "a"},
+        tolerations=(Toleration(key="gpu", operator="Equal", value="true",
+                                effect="NoSchedule"),),
+        affinity=Affinity(
+            terms=(
+                NodeSelectorTerm(
+                    expressions=(
+                        MatchExpression(key="rack", operator="In",
+                                        values=("r1", "r2")),
+                    )
+                ),
+            )
+        ),
+        gang=Gang(id="g0", cardinality=2, node_uniformity_label="rack"),
+        submitted_ts=12.5,
+        annotations={"owner": "x"},
+        command=("/bin/true",),
+    )
+    back = job_spec_from_proto(job_spec_to_proto(spec))
+    assert back == spec
+
+
+def test_event_sequence_proto_roundtrip():
+    seq = EventSequence.of(
+        "q", "s",
+        SubmitJob(
+            created=1.0,
+            job=JobSpec(id="j0", queue="q",
+                        requests={"cpu": "1", "memory": "1Gi"}),
+            deduplication_id="dd1",
+        ),
+        JobRunErrors(created=2.0, job_id="j0", run_id="r0",
+                     error="boom", retryable=False, debug='{"rc": 1}'),
+    )
+    offset, back = sequence_from_proto(sequence_to_proto(17, seq))
+    assert offset == 17
+    assert back.queue == "q" and back.jobset == "s"
+    assert back.events == seq.events
+
+    # Wire-level: serialize + reparse.
+    data = sequence_to_proto(17, seq).SerializeToString()
+    offset2, back2 = sequence_from_proto(
+        pb.EventSequenceEntry.FromString(data)
+    )
+    assert (offset2, back2.events) == (17, seq.events)
+
+
+def test_proto_service_shares_the_method_table():
+    """Submit/cancel/reprioritize over binary proto; effects visible to
+    the JSON surface (one method table, two encodings)."""
+    plane = ControlPlane(CFG, cycle_period=3600).start()
+    try:
+        client = ProtoApiClient(plane.address)
+        from armada_tpu.core.types import QueueSpec
+
+        plane.submit.create_queue(QueueSpec("pq"))
+        item = pb.JobSubmitRequestItem(priority=1)
+        item.requests["cpu"] = "1"
+        item.requests["memory"] = "1Gi"
+        item.annotations["via"] = "proto"
+        item.command.extend(["/bin/true"])
+        ids = client.submit_jobs("pq", "ps", [item, item])
+        assert len(ids) == 2
+        plane.scheduler.ingester.sync()
+        job = plane.scheduler.jobdb.get(ids[0])
+        assert job is not None
+        assert job.spec.annotations["via"] == "proto"
+        assert job.spec.command == ("/bin/true",)
+
+        client.reprioritize_jobs("pq", "ps", [ids[0]], 9)
+        plane.scheduler.ingester.sync()
+        assert plane.scheduler.jobdb.get(ids[0]).priority == 9
+
+        client.cancel_jobs("pq", "ps", job_ids=[ids[1]])
+        plane.scheduler.ingester.sync()
+        assert plane.scheduler.jobdb.get(ids[1]).state.value == "cancelled"
+    finally:
+        plane.stop()
+
+
+def test_proto_watch_stream():
+    """WatchJobSet over proto: EventSequenceEntry messages decode back to
+    the exact model events the log holds."""
+    plane = ControlPlane(CFG, cycle_period=3600).start()
+    try:
+        from armada_tpu.core.types import QueueSpec
+
+        client = ProtoApiClient(plane.address)
+        plane.submit.create_queue(QueueSpec("wq"))
+        item = pb.JobSubmitRequestItem()
+        item.requests["cpu"] = "1"
+        item.requests["memory"] = "1Gi"
+        ids = client.submit_jobs("wq", "ws", [item])
+
+        got = []
+        for offset, seq in client.watch_jobset("wq", "ws", follow=False):
+            got.extend(seq.events)
+        assert any(
+            isinstance(e, SubmitJob) and e.job.id == ids[0] for e in got
+        )
+        # The decoded spec survives the oneof round trip.
+        submit = next(e for e in got if isinstance(e, SubmitJob))
+        assert submit.job.requests == {"cpu": "1", "memory": "1Gi"}
+    finally:
+        plane.stop()
+
+
+def test_proto_submit_affinity_and_zero_priority():
+    """Regressions: proto affinity maps through json_format's
+    {"terms": [...]} shape, and default-valued fields (priority 0) behave
+    identically to the JSON encoding."""
+    plane = ControlPlane(CFG, cycle_period=3600).start()
+    try:
+        from armada_tpu.core.types import QueueSpec
+
+        client = ProtoApiClient(plane.address)
+        plane.submit.create_queue(QueueSpec("aq"))
+        item = pb.JobSubmitRequestItem(priority=5)
+        item.requests["cpu"] = "1"
+        item.requests["memory"] = "1Gi"
+        term = item.affinity.terms.add()
+        term.expressions.add(key="zone", operator="In", values=["a", "b"])
+        ids = client.submit_jobs("aq", "as", [item])
+        plane.scheduler.ingester.sync()
+        job = plane.scheduler.jobdb.get(ids[0])
+        expr = job.spec.affinity.terms[0].expressions[0]
+        assert (expr.key, expr.operator, expr.values) == ("zone", "In",
+                                                          ("a", "b"))
+        # Reprioritize to 0 (a proto3 default value) must work.
+        client.reprioritize_jobs("aq", "as", ids, 0)
+        plane.scheduler.ingester.sync()
+        assert plane.scheduler.jobdb.get(ids[0]).priority == 0
+    finally:
+        plane.stop()
