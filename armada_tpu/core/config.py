@@ -173,6 +173,14 @@ class SchedulingConfig:
     fill_group_max: int = 8
     executor_timeout_s: float = 600.0
     max_unacknowledged_jobs_per_executor: int = 2500
+    # Store backpressure (common/etcdhealth re-targeted at the event log;
+    # services/backpressure.py): reject submissions and pause executor pod
+    # creation when the log's disk footprint exceeds this fraction of the
+    # capacity quota, or a materialized view lags too far. 0 disables the
+    # respective signal.
+    store_capacity_bytes: int = 0
+    store_fraction_of_capacity_limit: float = 0.8
+    max_ingest_lag_events: int = 0
     # Short-job penalty (scheduling/short_job_penalty.go): jobs that finish
     # faster than this still count against their queue's cost until the
     # window passes, discouraging churn. 0 disables.
@@ -370,6 +378,13 @@ class SchedulingConfig:
             }
         for yaml_key, attr, conv in [
             ("enableAssertions", "enable_assertions", bool),
+            ("storeCapacityBytes", "store_capacity_bytes", int),
+            (
+                "storeFractionOfCapacityLimit",
+                "store_fraction_of_capacity_limit",
+                float,
+            ),
+            ("maxIngestLagEvents", "max_ingest_lag_events", int),
             ("marketDriven", "market_driven", bool),
             ("gangIndicativePricingTimeout", "gang_pricing_timeout_s", float),
             ("spotPriceCutoff", "spot_price_cutoff", float),

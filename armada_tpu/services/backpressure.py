@@ -1,0 +1,97 @@
+"""Store backpressure: pause intake when the event store backs up.
+
+Port of the reference's etcd health monitoring re-targeted at this repo's
+store: the reference scrapes etcd's db-size-vs-quota fractions and marks
+the cluster unhealthy past a configured fraction
+(internal/common/etcdhealth/etcdhealth.go:36-44), and the executor wires
+the monitor so pod creation pauses while unhealthy
+(internal/executor/application.go:63-101). Here the store is the event
+log plus its materialized views, so the signals are:
+
+  - log disk footprint vs a capacity quota (storeCapacityBytes x
+    storeFractionOfCapacityLimit — the db-size fraction analogue);
+  - ingest lag of registered views (a store nobody can drain is backed
+    up even if small).
+
+When unhealthy: the submit service rejects new work (the reference's
+submit-side shedding), and lease replies carry store_healthy=false so
+executor agents pause creating pods for NEW leases until the store
+recovers (unacked leases are simply re-sent — at-least-once).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class StoreHealthMonitor:
+    def __init__(
+        self,
+        log,
+        capacity_bytes: int = 0,
+        fraction_of_capacity_limit: float = 0.8,
+        max_ingest_lag_events: int = 0,
+        check_interval_s: float = 5.0,
+    ):
+        """capacity_bytes=0 disables the size signal;
+        max_ingest_lag_events=0 disables the lag signal."""
+        self.log = log
+        self.capacity_bytes = capacity_bytes
+        self.fraction_of_capacity_limit = fraction_of_capacity_limit
+        self.max_ingest_lag_events = max_ingest_lag_events
+        self.check_interval_s = check_interval_s
+        self._lag_sources: list = []  # (name, () -> int)
+        self._last_check = 0.0
+        self._healthy = True
+        self._reason = ""
+
+    def add_lag_source(self, name: str, fn) -> None:
+        self._lag_sources.append((name, fn))
+
+    def _disk_bytes(self) -> int:
+        directory = getattr(self.log, "dir", None)
+        if directory is None:
+            return 0  # in-memory log: no disk signal
+        total = 0
+        try:
+            for entry in os.scandir(directory):
+                if entry.is_file():
+                    total += entry.stat().st_size
+        except OSError:
+            return 0
+        return total
+
+    def check(self, now: float | None = None) -> tuple[bool, str]:
+        """(healthy, reason); recomputed at most every check_interval_s
+        (the reference's scrapeInterval)."""
+        now = time.time() if now is None else now
+        if now - self._last_check < self.check_interval_s:
+            return self._healthy, self._reason
+        self._last_check = now
+        if self.capacity_bytes > 0:
+            used = self._disk_bytes()
+            fraction = used / self.capacity_bytes
+            if fraction > self.fraction_of_capacity_limit:
+                self._healthy = False
+                self._reason = (
+                    f"storeSizeExceeded: log uses {used} bytes "
+                    f"({fraction:.2f} of capacity {self.capacity_bytes}, "
+                    f"limit {self.fraction_of_capacity_limit})"
+                )
+                return self._healthy, self._reason
+        if self.max_ingest_lag_events > 0:
+            for name, fn in self._lag_sources:
+                lag = int(fn())
+                if lag > self.max_ingest_lag_events:
+                    self._healthy = False
+                    self._reason = (
+                        f"ingestLagExceeded: {name} is {lag} events behind "
+                        f"(limit {self.max_ingest_lag_events})"
+                    )
+                    return self._healthy, self._reason
+        self._healthy, self._reason = True, ""
+        return True, ""
+
+    def __call__(self) -> bool:
+        return self.check()[0]

@@ -275,14 +275,19 @@ class ExecutorAgent:
                 "acked_run_ids": sorted(self.acked),
             },
         )
-        for lease in reply.get("leases", []):
-            if lease["run_id"] not in self.acked:
-                from ..utils.compress import decompress_obj
+        # Store backpressure (the reference pauses pod creation while etcd
+        # is over capacity, executor/application.go:63-101): defer NEW
+        # leases while the server reports the store unhealthy — they stay
+        # unacked and are re-sent once it recovers. Running pods continue.
+        if reply.get("store_healthy", True):
+            for lease in reply.get("leases", []):
+                if lease["run_id"] not in self.acked:
+                    from ..utils.compress import decompress_obj
 
-                lease = {**lease, "spec": decompress_obj(lease.get("spec"))}
-                # create before ack: a failed create must be re-leased
-                self.runtime.create(lease, now)
-                self.acked.add(lease["run_id"])
+                    lease = {**lease, "spec": decompress_obj(lease.get("spec"))}
+                    # create before ack: a failed create must be re-leased
+                    self.runtime.create(lease, now)
+                    self.acked.add(lease["run_id"])
         for cancel in reply.get("cancel_runs", []):
             self.issue_handler.note_kill(cancel["run_id"], now)
             self.runtime.kill(cancel["run_id"])

@@ -58,14 +58,36 @@ class ControlPlane:
             )
 
         self.leader = StandaloneLeader()
+        # Store backpressure (services/backpressure.py; the reference's
+        # etcd health monitoring): gates submissions and executor pod
+        # creation when the log backs up. Signals are config-gated.
+        self.store_health = None
+        if self.config.store_capacity_bytes or self.config.max_ingest_lag_events:
+            from .backpressure import StoreHealthMonitor
+
+            self.store_health = StoreHealthMonitor(
+                self.log,
+                capacity_bytes=self.config.store_capacity_bytes,
+                fraction_of_capacity_limit=(
+                    self.config.store_fraction_of_capacity_limit
+                ),
+                max_ingest_lag_events=self.config.max_ingest_lag_events,
+            )
         self.scheduler = SchedulerService(
             self.config, self.log, backend=backend, is_leader=self.leader,
             checkpoint=_ckpt("scheduler"),
         )
         self.submit = SubmitService(
             self.config, self.log, scheduler=self.scheduler,
-            checkpoint=_ckpt("submit"),
+            checkpoint=_ckpt("submit"), store_health=self.store_health,
         )
+        if self.store_health is not None:
+            self.store_health.add_lag_source(
+                "scheduler-ingester",
+                lambda: max(
+                    0, self.log.end_offset - self.scheduler.ingester.cursor
+                ),
+            )
         self.query = QueryApi(self.scheduler.jobdb)
         self.metrics = SchedulerMetrics()
         self.scheduler.attach_metrics(self.metrics)
@@ -114,6 +136,7 @@ class ControlPlane:
             self.submit_checker,
             binoculars=self.binoculars,
             event_index=self.event_index,
+            store_health=self.store_health,
         )
         self.grpc_server, self.grpc_port = self.api.serve(grpc_port)
         self.metrics_server = (
@@ -158,7 +181,7 @@ class ControlPlane:
         self.cycle_checker = HeartbeatChecker(
             "cycle", timeout_s=max(30.0, 20 * cycle_period)
         )
-        self.health = MultiChecker(
+        checkers = [
             self.startup_checker,
             self.cycle_checker,
             FuncChecker(
@@ -168,7 +191,13 @@ class ControlPlane:
                     f"lag {self.lookout_store.lag_events} events",
                 ),
             ),
-        )
+        ]
+        if self.store_health is not None:
+            self.store_health.add_lag_source(
+                "lookout", lambda: self.lookout_store.lag_events
+            )
+            checkers.append(FuncChecker("store", self.store_health.check))
+        self.health = MultiChecker(*checkers)
         self.health_server = None
         if health_port is not None:
             self.health_server, self.health_port = serve_health(

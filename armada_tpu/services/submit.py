@@ -50,10 +50,14 @@ class Queue:
 
 class SubmitService:
     def __init__(self, config: SchedulingConfig, log, scheduler=None,
-                 checkpoint=None):
+                 checkpoint=None, store_health=None):
         self.config = config
         self.log = log
         self.scheduler = scheduler  # optional: queue updates pushed through
+        # Optional backpressure gate (services/backpressure.py): callable
+        # -> (healthy, reason); submissions are shed while the store is
+        # backed up (the reference rejects work on etcd capacity).
+        self.store_health = store_health
         self.queues: dict[str, Queue] = {}
         self._dedup: dict[tuple, str] = {}  # (queue, dedup_id) -> job_id
         self._cursor = 0  # log offset the view reflects
@@ -200,6 +204,10 @@ class SubmitService:
         self, queue: str, jobset: str, jobs: list[JobSpec], now: float | None = None
     ) -> list[str]:
         """Validate + publish; returns job ids (existing ids for dedup hits)."""
+        if self.store_health is not None:
+            healthy, reason = self.store_health.check()
+            if not healthy:
+                raise SubmissionError(f"store backpressure: {reason}")
         if queue not in self.queues:
             raise SubmissionError(f"queue {queue!r} does not exist")
         now = _time.time() if now is None else now

@@ -1,0 +1,132 @@
+"""Store backpressure (services/backpressure.py — the reference's etcd
+health monitoring, common/etcdhealth + executor/application.go:63-101):
+submissions shed and executors pause pod creation while the event store
+is over capacity or its views lag too far."""
+
+import time
+
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import JobSpec, QueueSpec
+from armada_tpu.events.file_log import FileEventLog
+from armada_tpu.services.backpressure import StoreHealthMonitor
+from armada_tpu.services.executor_agent import ExecutorAgent, _PodRuntime
+from armada_tpu.services.grpc_api import ApiClient
+from armada_tpu.services.server import ControlPlane
+from armada_tpu.services.submit import SubmissionError
+
+CFG = SchedulingConfig(
+    priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+    default_priority_class="d",
+)
+
+
+def test_monitor_size_fraction(tmp_path):
+    from armada_tpu.events import EventSequence, SubmitJob
+
+    log = FileEventLog(str(tmp_path / "log"))
+    mon = StoreHealthMonitor(
+        log, capacity_bytes=4000, fraction_of_capacity_limit=0.5,
+        check_interval_s=0.0,
+    )
+    assert mon.check() == (True, "")
+    for i in range(20):
+        log.publish(
+            EventSequence.of(
+                "q", "s",
+                SubmitJob(
+                    created=float(i),
+                    job=JobSpec(id=f"j{i}", queue="q",
+                                requests={"cpu": "1", "memory": "1Gi"}),
+                ),
+            )
+        )
+    healthy, reason = mon.check()
+    assert not healthy and "storeSizeExceeded" in reason
+
+
+def test_monitor_ingest_lag():
+    from armada_tpu.events import InMemoryEventLog
+
+    log = InMemoryEventLog()
+    mon = StoreHealthMonitor(
+        log, max_ingest_lag_events=10, check_interval_s=0.0
+    )
+    lag = {"n": 0}
+    mon.add_lag_source("view", lambda: lag["n"])
+    assert mon.check()[0]
+    lag["n"] = 50
+    healthy, reason = mon.check()
+    assert not healthy and "ingestLagExceeded" in reason and "view" in reason
+    lag["n"] = 0
+    assert mon.check()[0]
+
+
+def test_submission_shed_and_executor_pause(tmp_path):
+    """Over-capacity store: submissions are rejected and agents stop
+    creating pods for new leases; both recover when pressure clears."""
+    import dataclasses
+
+    config = dataclasses.replace(
+        CFG, store_capacity_bytes=100_000_000,
+        store_fraction_of_capacity_limit=0.9,
+    )
+    plane = ControlPlane(
+        config, cycle_period=3600, data_dir=str(tmp_path / "data")
+    ).start()
+    try:
+        plane.store_health.check_interval_s = 0.0
+        client = ApiClient(plane.address)
+        client.create_queue("bq")
+        jid = client.submit_jobs(
+            "bq", "bs",
+            [{"requests": {"cpu": "1", "memory": "1Gi"}}],
+        )[0]
+        plane.scheduler.ingester.sync()
+
+        agent = ExecutorAgent(
+            ApiClient(plane.address), "bp-exec",
+            nodes=[{"id": "b0", "total_resources": {"cpu": "8", "memory": "32Gi"}}],
+            runtime=_PodRuntime(runtime_s=60.0),
+        )
+        agent.tick(0.0)
+        plane.scheduler.cycle(now=1.0)
+
+        # Pressure on: shrink the quota so the existing log exceeds it.
+        plane.store_health.capacity_bytes = 10
+
+        with pytest.raises(SubmissionError, match="store backpressure"):
+            plane.submit.submit(
+                "bq", "bs",
+                [JobSpec(id="shed", queue="",
+                         requests={"cpu": "1", "memory": "1Gi"})],
+                now=2.0,
+            )
+        # gRPC surface translates the rejection.
+        with pytest.raises(Exception, match="store backpressure"):
+            client.submit_jobs(
+                "bq", "bs", [{"requests": {"cpu": "1", "memory": "1Gi"}}]
+            )
+
+        # The agent receives the lease but defers pod creation.
+        agent.tick(2.0)
+        assert jid not in {
+            p["job_id"] for p in agent.runtime.pods.values()
+        }
+        assert not agent.acked
+
+        # Pressure off: the re-sent lease is created on the next tick.
+        plane.store_health.capacity_bytes = 100_000_000
+        agent.tick(3.0)
+        assert jid in {p["job_id"] for p in agent.runtime.pods.values()}
+
+        # Submissions flow again.
+        plane.submit.submit(
+            "bq", "bs",
+            [JobSpec(id="after", queue="",
+                     requests={"cpu": "1", "memory": "1Gi"})],
+            now=4.0,
+        )
+    finally:
+        plane.stop()
