@@ -48,6 +48,9 @@ class RoundReport:
     # Market mode: indicative gang prices by configured shape name
     # (solver.pricer.GangPricingResult per shape).
     indicative_prices: dict = field(default_factory=dict)
+    # Per-gang outcomes (the reference's GangSchedulingContext detail:
+    # context/gang.go): (queue, gang_id) -> context string. Bounded.
+    gang_contexts: dict = field(default_factory=dict)
 
     def report_string(self) -> str:
         lines = [
@@ -67,6 +70,8 @@ class RoundReport:
             else:
                 detail = f"unschedulable: {r.unschedulable_reason}"
             lines.append(f"  indicative gang {name}: {detail}")
+        for (queue, gang_id), ctx in sorted(self.gang_contexts.items())[:20]:
+            lines.append(f"  gang {gang_id} (queue {queue}): {ctx}")
         for q in sorted(self.queues):
             r = self.queues[q]
             value = (
@@ -133,6 +138,11 @@ class SchedulingReportsRepository:
                     r.top_reasons.items(), key=lambda kv: -kv[1]
                 )[:5]:
                     parts.append(f"  {count} jobs: {reason}")
+                for (gq, gang_id), ctx in sorted(
+                    report.gang_contexts.items()
+                ):
+                    if gq == queue:
+                        parts.append(f"  gang {gang_id}: {ctx}")
         return "\n".join(parts) or f"no reports for queue {queue}"
 
     def job_report(self, job_id: str) -> str:

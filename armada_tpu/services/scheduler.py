@@ -1027,6 +1027,40 @@ class SchedulerService:
                     qr.top_reasons[reasons[j]] = (
                         qr.top_reasons.get(reasons[j], 0) + 1
                     )
+        # Per-gang contexts (GangSchedulingContext detail, context/gang.go):
+        # multi-member gangs get an all-or-nothing outcome line. Singletons
+        # occupy the leading gang indices (snapshot/round.py), so select
+        # multi-member gangs by size, bounded to 1000 — report strings,
+        # not a query surface.
+        offsets = snap.gang_member_offsets
+        sizes = np.diff(offsets)
+        for g in np.flatnonzero(sizes >= 2)[:1000]:
+            members = snap.gang_members[offsets[g] : offsets[g + 1]]
+            j0 = int(members[0])
+            gang_id = snap.job_gang_id[j0]
+            q0 = int(snap.job_queue[j0])
+            if q0 < 0:
+                continue
+            queue = snap.queue_names[q0]
+            placed = int(result["scheduled_mask"][members].sum())
+            if placed == len(members):
+                nodes = {
+                    snap.node_ids[int(result["assigned_node"][int(m)])]
+                    for m in members
+                }
+                ctx = (
+                    f"scheduled {placed}/{len(members)} "
+                    f"across {len(nodes)} nodes"
+                )
+            elif placed == 0:
+                reason = ""
+                reasons = result.get("unschedulable_reason")
+                if reasons is not None:
+                    reason = reasons[j0] or ""
+                ctx = "not scheduled" + (f": {reason}" if reason else "")
+            else:  # pragma: no cover - atomicity violation surfaced loudly
+                ctx = f"PARTIAL {placed}/{len(members)} (gang atomicity bug)"
+            report.gang_contexts[(queue, gang_id)] = ctx
         # Per-job success contexts: bounded by the burst cap, so this stays
         # cheap even in 1M-job rounds (the reference's jctx detail,
         # reports/repository.go job reports).

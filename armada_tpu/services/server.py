@@ -205,7 +205,8 @@ class ControlPlane:
             )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._cycle_n = 0
+        self._maintenance_lock = threading.Lock()
+        self.tasks = None  # BackgroundTaskManager, created by start()
 
     def _loop(self):
         while not self._stop.is_set():
@@ -214,37 +215,51 @@ class ControlPlane:
             for ex in self.executors:
                 ex.tick(now)
             try:
-                self.scheduler.cycle(now=now)
+                # The maintenance lock serializes checkpointing with the
+                # cycle (checkpoint_state must not observe a cursor from
+                # before a sync whose effects it dumps — the two ran
+                # inline in this loop before the task manager existed).
+                with self._maintenance_lock:
+                    self.scheduler.cycle(now=now)
                 self.cycle_checker.beat()
             except Exception as e:  # keep the loop alive; next cycle retries
                 print(f"cycle error: {e!r}")
             self.lookout_store.sync()
-            self._cycle_n += 1
-            if self._cycle_n % 600 == 0:
-                # The lookout pruner (internal/lookout/pruner): bound the
-                # materialization like the scheduler bounds its jobdb.
-                self.lookout_store.prune(
-                    _time.time() - self.config.terminal_job_retention_s
-                )
-                # Per-jobset stream retention (the event ingester's Redis
-                # stream expiry): quiet jobsets drop out of the index.
-                self.event_index.prune(
-                    _time.time() - self.config.terminal_job_retention_s
-                )
-                if self.checkpoints is not None:
-                    # Bounded restart + bounded disk: checkpoint all views,
-                    # drop log segments they have all materialized
-                    # (services/checkpoint.py).
-                    self.submit.sync()
-                    self.event_index.sync()
-                    self.checkpoints.checkpoint_and_compact()
             if self.metrics.registry is not None:
                 self.metrics.cycle_time.observe(_time.time() - started)
             self._stop.wait(self.cycle_period)
 
+    def _prune_views(self):
+        """Retention: the lookout pruner (internal/lookout/pruner) + the
+        event ingester's per-jobset stream expiry."""
+        cutoff = _time.time() - self.config.terminal_job_retention_s
+        self.lookout_store.prune(cutoff)
+        self.event_index.prune(cutoff)
+
+    def _checkpoint_views(self):
+        """Bounded restart + bounded disk: checkpoint all views, drop log
+        segments they have all materialized (services/checkpoint.py).
+        Serialized against the scheduler cycle (see _loop)."""
+        with self._maintenance_lock:
+            self.submit.sync()
+            self.event_index.sync()
+            self.checkpoints.checkpoint_and_compact()
+
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        # Maintenance loops run under the background task manager
+        # (common/task BackgroundTaskManager): named, panic-contained,
+        # duration-observed, joined on stop.
+        from ..utils.tasks import BackgroundTaskManager
+
+        maintenance_interval = max(30.0, 600 * self.cycle_period)
+        self.tasks = BackgroundTaskManager()
+        self.tasks.register(self._prune_views, maintenance_interval, "prune")
+        if self.checkpoints is not None:
+            self.tasks.register(
+                self._checkpoint_views, maintenance_interval, "checkpoint"
+            )
         self.startup_checker.mark_complete()
         return self
 
@@ -252,14 +267,22 @@ class ControlPlane:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
-        if self.checkpoints is not None:
+        stragglers: list = []
+        if self.tasks is not None:
+            stragglers = self.tasks.stop_all(timeout=5.0)
+            if stragglers:
+                print(f"background tasks still running: {stragglers}")
+        if self.checkpoints is not None and "checkpoint" not in stragglers:
             # Clean shutdown writes a final checkpoint so the next start
             # replays (near-)nothing; a kill-9 still recovers from the
-            # last periodic checkpoint + suffix replay.
+            # last periodic checkpoint + suffix replay. Skipped if the
+            # periodic checkpoint task straggled past its join timeout —
+            # two writers on the same .tmp files would tear both.
             try:
-                self.submit.sync()
-                self.event_index.sync()
-                self.checkpoints.save_all()
+                with self._maintenance_lock:
+                    self.submit.sync()
+                    self.event_index.sync()
+                    self.checkpoints.save_all()
             except Exception as e:
                 print(f"final checkpoint failed: {e!r}")
         self.grpc_server.stop(grace=0.5)

@@ -23,22 +23,105 @@ class Span:
     attrs: dict = field(default_factory=dict)
     end: float | None = None
     parent: str = ""
+    # Wall-clock epoch ns at start (exporters need absolute time; the
+    # monotonic pair above is for durations).
+    start_unix_ns: int = 0
+    span_id: str = ""
+    parent_id: str = ""
+    trace_id: str = ""
 
     @property
     def duration_s(self) -> float:
         return (self.end or time.monotonic()) - self.start
 
 
+class OtlpJsonFileExporter:
+    """Span exporter writing the OTLP/JSON `resourceSpans` shape, one
+    export batch per line — the drop-in the in-proc tracer was missing
+    (the reference initializes a real OTel exporter at
+    common/observability; no collector runs in this environment, so the
+    sink is a file any OTLP file-receiver or post-processor ingests)."""
+
+    def __init__(self, path: str, service_name: str = "armada-tpu"):
+        self.path = path
+        self.service_name = service_name
+        self._lock = threading.Lock()
+
+    def export(self, spans: list[Span]) -> None:
+        if not spans:
+            return
+        import json
+
+        payload = {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {"stringValue": self.service_name},
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "armada_tpu.utils.tracing"},
+                            "spans": [
+                                {
+                                    "traceId": s.trace_id,
+                                    "spanId": s.span_id,
+                                    "parentSpanId": s.parent_id,
+                                    "name": s.name,
+                                    "kind": 1,  # SPAN_KIND_INTERNAL
+                                    "startTimeUnixNano": str(s.start_unix_ns),
+                                    "endTimeUnixNano": str(
+                                        s.start_unix_ns
+                                        + int(s.duration_s * 1e9)
+                                    ),
+                                    "attributes": [
+                                        {
+                                            "key": k,
+                                            "value": {"stringValue": str(v)},
+                                        }
+                                        for k, v in s.attrs.items()
+                                    ],
+                                }
+                                for s in spans
+                            ],
+                        }
+                    ],
+                }
+            ]
+        }
+        line = json.dumps(payload) + "\n"
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)
+
+
 class Tracer:
     """Per-process tracer: span stack per thread, ring buffer of finished
-    spans, optional logger export."""
+    spans, optional logger export, optional OTLP exporter (batched;
+    flushed every `export_every` finished spans or on flush())."""
 
-    def __init__(self, logger=None, keep: int = 1024):
+    def __init__(self, logger=None, keep: int = 1024, exporter=None,
+                 export_every: int = 64, export_interval_s: float = 10.0):
         self.logger = logger
         self.keep = keep
+        self.exporter = exporter
+        self.export_every = export_every
+        # Time-based flush: low-traffic processes must not hold spans
+        # hostage to the batch size (and atexit drains the final batch).
+        self.export_interval_s = export_interval_s
+        self._last_flush = time.monotonic()
         self.finished: list[Span] = []
+        self._pending: list[Span] = []
         self._local = threading.local()
         self._lock = threading.Lock()
+        if exporter is not None:
+            import atexit
+
+            atexit.register(self.flush)
 
     def _stack(self):
         if not hasattr(self._local, "stack"):
@@ -47,9 +130,21 @@ class Tracer:
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs):
+        import secrets
+
         stack = self._stack()
-        parent = stack[-1].name if stack else ""
-        s = Span(name=name, start=time.monotonic(), attrs=attrs, parent=parent)
+        parent = stack[-1] if stack else None
+        s = Span(
+            name=name,
+            start=time.monotonic(),
+            attrs=attrs,
+            parent=parent.name if parent else "",
+            start_unix_ns=time.time_ns(),
+            span_id=secrets.token_hex(8),
+            parent_id=parent.span_id if parent else "",
+            # Root spans open a new trace; children inherit it.
+            trace_id=parent.trace_id if parent else secrets.token_hex(16),
+        )
         stack.append(s)
         try:
             yield s
@@ -60,11 +155,30 @@ class Tracer:
                 self.finished.append(s)
                 if len(self.finished) > self.keep:
                     del self.finished[: len(self.finished) - self.keep]
+                if self.exporter is not None:
+                    self._pending.append(s)
+                    flush_now = (
+                        len(self._pending) >= self.export_every
+                        or time.monotonic() - self._last_flush
+                        >= self.export_interval_s
+                    )
             if self.logger is not None:
                 self.logger.with_fields(
-                    span=name, parent=parent, duration_ms=round(s.duration_s * 1e3, 2),
+                    span=name, parent=s.parent,
+                    duration_ms=round(s.duration_s * 1e3, 2),
                     **attrs,
                 ).debug("span finished")
+            if self.exporter is not None and flush_now:
+                self.flush()
+
+    def flush(self) -> None:
+        """Export pending spans (batch-size/interval triggers, atexit)."""
+        if self.exporter is None:
+            return
+        with self._lock:
+            batch, self._pending = self._pending, []
+            self._last_flush = time.monotonic()
+        self.exporter.export(batch)
 
     def summary(self) -> dict:
         """Aggregate durations by span name (count, total, max)."""

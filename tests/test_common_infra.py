@@ -93,3 +93,81 @@ def test_load_config_env_override_and_validation(tmp_path):
         assert False, "expected validation failure"
     except ValueError as e:
         assert "priority class" in str(e)
+
+
+def test_otlp_json_file_exporter(tmp_path):
+    """Spans export in the OTLP/JSON resourceSpans shape with trace/span
+    id propagation — the exporter the in-proc tracer plugs into
+    (common/observability's OTel init analogue)."""
+    import json
+
+    from armada_tpu.utils.tracing import OtlpJsonFileExporter, Tracer
+
+    path = str(tmp_path / "spans.otlp.jsonl")
+    tracer = Tracer(exporter=OtlpJsonFileExporter(path), export_every=100)
+    with tracer.span("cycle", pool="default") as outer:
+        with tracer.span("solve") as inner:
+            pass
+    tracer.flush()
+
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 1
+    batch = json.loads(lines[0])
+    resource = batch["resourceSpans"][0]
+    svc = resource["resource"]["attributes"][0]
+    assert svc["key"] == "service.name"
+    spans = resource["scopeSpans"][0]["spans"]
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"cycle", "solve"}
+    # Child joins the parent's trace and points at its span id.
+    assert by_name["solve"]["traceId"] == by_name["cycle"]["traceId"]
+    assert by_name["solve"]["parentSpanId"] == by_name["cycle"]["spanId"]
+    assert by_name["cycle"]["parentSpanId"] == ""
+    assert int(by_name["cycle"]["endTimeUnixNano"]) >= int(
+        by_name["cycle"]["startTimeUnixNano"]
+    )
+    assert by_name["cycle"]["attributes"][0] == {
+        "key": "pool", "value": {"stringValue": "default"},
+    }
+
+
+def test_background_task_manager():
+    """common/task BackgroundTaskManager semantics: interval between
+    RETURNS, panic containment per task, join-on-stop with straggler
+    reporting."""
+    import threading
+    import time as _t
+
+    from armada_tpu.utils.tasks import BackgroundTaskManager
+
+    mgr = BackgroundTaskManager()
+    runs = {"ok": 0}
+
+    def ok():
+        runs["ok"] += 1
+
+    def bad():
+        raise RuntimeError("boom")
+
+    mgr.register(ok, 0.01, "ok")
+    mgr.register(bad, 0.01, "bad")
+    deadline = _t.time() + 5
+    while _t.time() < deadline and (
+        runs["ok"] < 3 or mgr.stats()["bad"]["failures"] < 3
+    ):
+        _t.sleep(0.01)
+    stats = mgr.stats()
+    assert stats["ok"]["runs"] >= 3
+    assert stats["bad"]["failures"] >= 3  # contained, siblings unaffected
+    assert mgr.stop_all(timeout=2.0) == []
+    n = stats["ok"]["runs"]
+    _t.sleep(0.05)
+    assert mgr.stats()["ok"]["runs"] <= n + 1  # actually stopped
+
+    # A straggler (blocked task) is reported, not hung on forever.
+    mgr2 = BackgroundTaskManager()
+    release = threading.Event()
+    mgr2.register(lambda: release.wait(30), 0.01, "stuck")
+    _t.sleep(0.05)
+    assert mgr2.stop_all(timeout=0.2) == ["stuck"]
+    release.set()

@@ -309,3 +309,45 @@ def test_metrics_rendered():
     assert 'scheduler_queue_fair_share{pool="default",queue="q"}' in text
     assert 'scheduler_jobs_scheduled_total{pool="default",queue="q"} 3.0' in text
     assert 'scheduler_solve_seconds_count{pool="default"}' in text
+
+
+def test_gang_contexts_in_reports():
+    """Gang-level scheduling context (context/gang.go detail): the round
+    report carries per-gang all-or-nothing outcomes, surfaced in the
+    scheduling and queue report strings."""
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.core.types import Gang, JobSpec, QueueSpec
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    submit = SubmitService(config, log, scheduler=sched)
+    FakeExecutor("c", log, sched,
+                 nodes=make_nodes("c", count=2, cpu="8", memory="32Gi"),
+                 runtime_for=lambda j: 100.0).tick(0.0)
+    submit.create_queue(QueueSpec("gq"))
+    fits = Gang(id="fits", cardinality=2)
+    too_big = Gang(id="too-big", cardinality=2)
+    submit.submit(
+        "gq", "s1",
+        [JobSpec(id=f"a{i}", queue="", gang=fits,
+                 requests={"cpu": "2", "memory": "2Gi"}) for i in range(2)]
+        + [JobSpec(id=f"b{i}", queue="", gang=too_big,
+                   requests={"cpu": "7", "memory": "2Gi"}) for i in range(2)],
+        now=0.0,
+    )
+    sched.cycle(now=1.0)
+    rep = sched.reports.latest_reports()["default"]
+    assert rep.gang_contexts[("gq", "fits")].startswith("scheduled 2/2")
+    # 7-cpu x2 on two 8-cpu nodes with the 2-cpu gang placed: second
+    # member can't fit -> all-or-nothing failure.
+    assert rep.gang_contexts[("gq", "too-big")].startswith("not scheduled")
+    assert "gang fits" in sched.reports.queue_report("gq")
+    assert "gang too-big" in sched.reports.scheduling_report()

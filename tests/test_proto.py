@@ -173,3 +173,32 @@ def test_proto_submit_affinity_and_zero_priority():
         assert plane.scheduler.jobdb.get(ids[0]).priority == 0
     finally:
         plane.stop()
+
+
+def test_codegen_bindings_current(tmp_path):
+    """client/{java,csharp} are protoc output of proto/armada.proto; this
+    guards against schema drift (regenerate per client/README.md)."""
+    import pathlib
+    import shutil
+    import subprocess
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    if shutil.which("protoc") is None:
+        import pytest
+
+        pytest.skip("protoc not available")
+    out = tmp_path / "gen"
+    (out / "java").mkdir(parents=True)
+    (out / "csharp").mkdir(parents=True)
+    subprocess.run(
+        [
+            "protoc", f"--java_out={out}/java", f"--csharp_out={out}/csharp",
+            "--proto_path", str(root / "proto"),
+            str(root / "proto" / "armada.proto"),
+        ],
+        check=True,
+    )
+    for rel in ("java/armada_tpu/api/Armada.java", "csharp/Armada.cs"):
+        fresh = (out / rel).read_text()
+        committed = (root / "client" / rel).read_text()
+        assert fresh == committed, f"client/{rel} is stale vs proto/armada.proto"
