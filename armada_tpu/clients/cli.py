@@ -62,6 +62,20 @@ def _jobs_from_yaml(path: str) -> tuple[str, str, list[dict]]:
             # podSpec containers[0].command+args equivalent: a real argv
             # for subprocess-backed executors.
             "command": item.get("command", []),
+            # armadactl job yaml services/ingress sections.
+            "services": [
+                {"type": s.get("type", "NodePort"),
+                 "ports": s.get("ports") or []}
+                for s in item.get("services") or []
+            ],
+            "ingresses": [
+                {"ports": i.get("ports") or [],
+                 "annotations": sorted(
+                     (i.get("annotations") or {}).items()
+                 ),
+                 "tls_enabled": bool(i.get("tls", False))}
+                for i in item.get("ingress") or item.get("ingresses") or []
+            ],
         }
         count = int(item.get("count", 1))
         gang = item.get("gang")

@@ -39,6 +39,50 @@ class Taint:
 
 
 @dataclass(frozen=True)
+class ServiceConfig:
+    """A service exposed for the job's pod (pkg/api job.Services;
+    executor/job/submit.go creates the k8s Service owned by the pod).
+    type: NodePort | Headless (the reference's ServiceType values)."""
+
+    type: str = "NodePort"
+    ports: tuple = ()  # of int
+
+    @staticmethod
+    def from_obj(s: dict) -> "ServiceConfig":
+        """Canonical decode shared by every wire codec (JSON dict, event
+        log, proto json_format, CLI YAML): int ports, so equal jobs
+        decode identically across encodings."""
+        return ServiceConfig(
+            type=s.get("type", "NodePort"),
+            ports=tuple(int(p) for p in s.get("ports") or ()),
+        )
+
+
+@dataclass(frozen=True)
+class IngressConfig:
+    """An ingress for the job's pod (pkg/api job.Ingress; created by the
+    executor alongside the pod and garbage-collected with it)."""
+
+    ports: tuple = ()  # of int
+    annotations: tuple = ()  # of (key, value) pairs (hashable)
+    tls_enabled: bool = False
+
+    @staticmethod
+    def from_obj(i: dict) -> "IngressConfig":
+        """Canonical decode (see ServiceConfig.from_obj): annotations
+        arrive as pairs or a map; stored sorted either way."""
+        ann = i.get("annotations") or ()
+        pairs = ann.items() if isinstance(ann, dict) else (
+            tuple(kv) for kv in ann
+        )
+        return IngressConfig(
+            ports=tuple(int(p) for p in i.get("ports") or ()),
+            annotations=tuple(sorted(pairs)),
+            tls_enabled=bool(i.get("tls_enabled", False)),
+        )
+
+
+@dataclass(frozen=True)
 class Toleration:
     key: str = ""
     operator: str = "Equal"  # "Equal" | "Exists"
@@ -152,6 +196,10 @@ class JobSpec:
     # reference). Empty = simulated runtime; a subprocess-backed executor
     # runs it as a real OS process.
     command: tuple = ()
+    # Services/ingresses the executor creates alongside the pod
+    # (pkg/api submit job.Services/job.Ingress; executor/job/submit.go).
+    services: tuple = ()  # of ServiceConfig
+    ingresses: tuple = ()  # of IngressConfig
 
     def bid_price(self, pool: str, *, running: bool = False) -> float:
         """Bid for this pool's given phase (see bid_price_pair)."""

@@ -24,9 +24,11 @@ from dataclasses import asdict
 from ..core.types import (
     Affinity,
     Gang,
+    IngressConfig,
     JobSpec,
     MatchExpression,
     NodeSelectorTerm,
+    ServiceConfig,
     Toleration,
 )
 from . import model
@@ -97,6 +99,12 @@ def _decode_event(d: dict):
             annotations=j.get("annotations", {}),
             bid_prices=j.get("bid_prices", {}),
             command=tuple(j.get("command", ())),
+            services=tuple(
+                ServiceConfig.from_obj(s) for s in j.get("services", ())
+            ),
+            ingresses=tuple(
+                IngressConfig.from_obj(i) for i in j.get("ingresses", ())
+            ),
         )
     return cls(**d)
 

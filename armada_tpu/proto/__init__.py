@@ -73,6 +73,13 @@ def job_spec_to_proto(spec) -> pb.JobSpecMsg:
         msg.gang.id = spec.gang.id
         msg.gang.cardinality = int(spec.gang.cardinality)
         msg.gang.node_uniformity_label = spec.gang.node_uniformity_label
+    for svc in spec.services:
+        msg.services.add(type=svc.type, ports=[int(p) for p in svc.ports])
+    for ing in spec.ingresses:
+        ping = msg.ingresses.add(
+            ports=[int(p) for p in ing.ports], tls_enabled=ing.tls_enabled
+        )
+        ping.annotations.update(dict(ing.annotations))
     return msg
 
 
@@ -80,9 +87,11 @@ def job_spec_from_proto(msg: pb.JobSpecMsg):
     from ..core.types import (
         Affinity,
         Gang,
+        IngressConfig,
         JobSpec,
         MatchExpression,
         NodeSelectorTerm,
+        ServiceConfig,
         Toleration,
     )
 
@@ -129,6 +138,18 @@ def job_spec_from_proto(msg: pb.JobSpecMsg):
         submitted_ts=float(msg.submitted_ts),
         annotations=dict(msg.annotations),
         command=tuple(msg.command),
+        services=tuple(
+            ServiceConfig(type=s.type, ports=tuple(s.ports))
+            for s in msg.services
+        ),
+        ingresses=tuple(
+            IngressConfig(
+                ports=tuple(i.ports),
+                annotations=tuple(sorted(i.annotations.items())),
+                tls_enabled=i.tls_enabled,
+            )
+            for i in msg.ingresses
+        ),
     )
 
 

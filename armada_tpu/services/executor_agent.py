@@ -21,6 +21,49 @@ from .podchecks import PodIssueHandler
 from .utilisation import UtilisationReporter, node_reports
 
 
+class ServiceRegistry:
+    """Services/ingresses the executor creates alongside pods
+    (executor/job/submit.go:110-140: SubmitService/SubmitIngress with an
+    owner reference to the pod, so the objects share its lifecycle).
+    Both pod runtimes attach one; records die with their owning pod —
+    the owner-reference garbage collection analogue."""
+
+    def __init__(self):
+        self.services: dict[str, list[dict]] = {}  # run_id -> records
+        self.ingresses: dict[str, list[dict]] = {}
+
+    def create_for(self, lease: dict) -> None:
+        spec = lease.get("spec") or {}
+        run_id = lease["run_id"]
+        job_id = lease.get("job_id", "")
+        for n, svc in enumerate(spec.get("services") or ()):
+            self.services.setdefault(run_id, []).append(
+                {
+                    "name": f"armada-{job_id}-{n}-{svc.get('type', 'NodePort').lower()}",
+                    "owner_run": run_id,
+                    "type": svc.get("type", "NodePort"),
+                    "ports": list(svc.get("ports", ())),
+                }
+            )
+        for n, ing in enumerate(spec.get("ingresses") or ()):
+            self.ingresses.setdefault(run_id, []).append(
+                {
+                    "name": f"armada-{job_id}-{n}-ingress",
+                    "owner_run": run_id,
+                    "ports": list(ing.get("ports", ())),
+                    "annotations": dict(
+                        tuple(kv) for kv in ing.get("annotations", ())
+                    ),
+                    "tls_enabled": bool(ing.get("tls_enabled", False)),
+                }
+            )
+
+    def collect(self, run_id: str) -> None:
+        """Owner pod gone: its objects are garbage-collected."""
+        self.services.pop(run_id, None)
+        self.ingresses.pop(run_id, None)
+
+
 class _PodRuntime:
     """Simulated pods: timed sleeps, like the reference fake executor."""
 
@@ -28,6 +71,7 @@ class _PodRuntime:
         self.runtime_s = runtime_s
         self.startup_s = startup_s
         self.pods: dict[str, dict] = {}  # run_id -> pod record
+        self.objects = ServiceRegistry()
 
     def create(self, lease: dict, now: float):
         self.pods[lease["run_id"]] = {
@@ -37,9 +81,17 @@ class _PodRuntime:
             "node": lease.get("node_id", ""),
             "phase": "created",
         }
+        self.objects.create_for(lease)
+
+    def _remove(self, run_id: str):
+        """The ONLY way a pod record leaves the runtime: owner-referenced
+        objects are garbage-collected with it, structurally."""
+        pod = self.pods.pop(run_id, None)
+        self.objects.collect(run_id)
+        return pod
 
     def kill(self, run_id: str):
-        self.pods.pop(run_id, None)
+        self._remove(run_id)
 
     def poll(self, now: float) -> list[dict]:
         """Phase transitions since last poll, as ReportEvents items."""
@@ -64,7 +116,7 @@ class _PodRuntime:
                 and now >= pod["started"] + self.runtime_s
             ):
                 events.append({"type": "succeeded", **base})
-                self.pods.pop(pod["run_id"], None)
+                self._remove(pod["run_id"])
         return events
 
 
@@ -82,6 +134,7 @@ class SubprocessPodRuntime:
         self.default_runtime_s = default_runtime_s
         self.enforce_rlimits = enforce_rlimits
         self.pods: dict[str, dict] = {}  # run_id -> pod record
+        self.objects = ServiceRegistry()
 
     def create(self, lease: dict, now: float):
         self.pods[lease["run_id"]] = {
@@ -93,6 +146,7 @@ class SubprocessPodRuntime:
             "proc": None,
             "stderr": None,
         }
+        self.objects.create_for(lease)
 
     def _spawn(self, pod: dict):
         import subprocess
@@ -136,8 +190,17 @@ class SubprocessPodRuntime:
             stderr.close()
             raise
 
-    def kill(self, run_id: str):
+    def _remove(self, run_id: str):
+        """Sole removal path: closes the stderr spool and garbage-collects
+        the pod's owner-referenced objects."""
         pod = self.pods.pop(run_id, None)
+        self.objects.collect(run_id)
+        if pod and pod.get("stderr") is not None:
+            pod["stderr"].close()
+        return pod
+
+    def kill(self, run_id: str):
+        pod = self._remove(run_id)
         if pod and pod.get("proc") is not None:
             import os as _os
             import signal
@@ -147,8 +210,6 @@ class SubprocessPodRuntime:
             except (ProcessLookupError, PermissionError):
                 pass
             pod["proc"].wait()
-        if pod and pod.get("stderr") is not None:
-            pod["stderr"].close()
 
     def poll(self, now: float) -> list[dict]:
         events = []
@@ -173,7 +234,7 @@ class SubprocessPodRuntime:
                             "debug": _pod_debug(pod, now),
                         }
                     )
-                    self.pods.pop(pod["run_id"], None)
+                    self._remove(pod["run_id"])
                     continue
                 pod["phase"] = "pending"
                 events.append({"type": "pending", **base})
@@ -206,9 +267,7 @@ class SubprocessPodRuntime:
                             "debug": _pod_debug({**pod, "rc": rc}, now),
                         }
                     )
-                if pod.get("stderr") is not None:
-                    pod["stderr"].close()
-                self.pods.pop(pod["run_id"], None)
+                self._remove(pod["run_id"])
         return events
 
 

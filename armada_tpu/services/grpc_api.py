@@ -20,10 +20,12 @@ import grpc
 from ..core.types import (
     Affinity,
     Gang,
+    IngressConfig,
     JobSpec,
     MatchExpression,
     NodeSelectorTerm,
     QueueSpec,
+    ServiceConfig,
     Toleration,
 )
 from ..jobdb import JobState
@@ -109,6 +111,12 @@ def job_spec_from_dict(d: dict) -> JobSpec:
         annotations=dict(d.get("annotations", {})),
         bid_prices=dict(d.get("bid_prices", {})),
         command=tuple(d.get("command", ())),
+        services=tuple(
+            ServiceConfig.from_obj(s) for s in d.get("services", ())
+        ),
+        ingresses=tuple(
+            IngressConfig.from_obj(i) for i in d.get("ingresses", ())
+        ),
     )
 
 
@@ -420,6 +428,14 @@ class ApiServer:
                                 "requests": job.spec.requests,
                                 "annotations": job.spec.annotations,
                                 "command": list(job.spec.command),
+                                "services": [
+                                    dataclasses.asdict(s)
+                                    for s in job.spec.services
+                                ],
+                                "ingresses": [
+                                    dataclasses.asdict(i)
+                                    for i in job.spec.ingresses
+                                ],
                             }
                         ),
                     }

@@ -217,3 +217,56 @@ def test_subprocess_rlimit_enforces_memory_request():
         assert "rc=" in p.scheduler.jobdb.get(jid).error
     finally:
         p.stop()
+
+
+def test_services_and_ingresses_share_pod_lifecycle(tmp_path):
+    """executor/job/submit.go:110-140: services and ingresses are created
+    alongside the pod (owner-referenced) and garbage-collected with it —
+    end to end through submit -> lease -> runtime."""
+    p = ControlPlane(SchedulingConfig(), cycle_period=0.05).start()
+    try:
+        client = ApiClient(p.address)
+        client.create_queue("svc")
+        agent = ExecutorAgent(
+            ApiClient(p.address),
+            "svc-exec",
+            nodes=[{"id": "sn-0", "total_resources": {"cpu": "8", "memory": "32Gi"}}],
+            runtime=SubprocessPodRuntime(),
+        )
+        jid = client.submit_jobs(
+            "svc", "s1",
+            [
+                {
+                    "requests": {"cpu": "1", "memory": "32Mi"},
+                    "command": ["/bin/sh", "-c", "sleep 2"],
+                    "services": [{"type": "NodePort", "ports": [8080]}],
+                    "ingresses": [
+                        {"ports": [8080],
+                         "annotations": [["nginx", "true"]],
+                         "tls_enabled": True}
+                    ],
+                }
+            ],
+        )[0]
+
+        def created():
+            agent.tick()
+            return bool(agent.runtime.objects.services)
+        assert _wait(created)
+        run_id = next(iter(agent.runtime.objects.services))
+        svc = agent.runtime.objects.services[run_id][0]
+        assert svc["type"] == "NodePort" and svc["ports"] == [8080]
+        ing = agent.runtime.objects.ingresses[run_id][0]
+        assert ing["annotations"] == {"nginx": "true"} and ing["tls_enabled"]
+
+        # Pod completes -> owner-reference GC removes both objects.
+        assert _wait(
+            lambda: (
+                agent.tick(),
+                p.scheduler.jobdb.get(jid).state.value == "succeeded",
+            )[1]
+        )
+        assert run_id not in agent.runtime.objects.services
+        assert run_id not in agent.runtime.objects.ingresses
+    finally:
+        p.stop()

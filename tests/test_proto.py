@@ -9,9 +9,11 @@ from armada_tpu.core.config import PriorityClass, SchedulingConfig
 from armada_tpu.core.types import (
     Affinity,
     Gang,
+    IngressConfig,
     JobSpec,
     MatchExpression,
     NodeSelectorTerm,
+    ServiceConfig,
     Toleration,
 )
 from armada_tpu.events import EventSequence, JobRunErrors, SubmitJob
@@ -56,6 +58,10 @@ def test_job_spec_proto_roundtrip():
         submitted_ts=12.5,
         annotations={"owner": "x"},
         command=("/bin/true",),
+        services=(ServiceConfig(type="Headless", ports=(8080, 9090)),),
+        ingresses=(IngressConfig(ports=(8080,),
+                                 annotations=(("nginx", "true"),),
+                                 tls_enabled=True),),
     )
     back = job_spec_from_proto(job_spec_to_proto(spec))
     assert back == spec
