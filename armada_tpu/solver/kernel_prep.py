@@ -282,27 +282,38 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     )
     job_pc = np.asarray([pc_index[n] for n in snap.job_pc_name], dtype=np.int32)
 
-    # Scheduling-key groups over non-running jobs: one np.unique over the
-    # byte-record of (queue, priority, pc, requests, tolerations, selector).
+    # Scheduling-key groups over non-running jobs: intern the tuple of
+    # (queue, priority, pc, requests, tolerations, selector) per job.
+    # lexsort over the native int columns, not np.unique(axis=0): the
+    # latter argsorts a void byte-record with memcmp comparisons and
+    # dominated 1M-job prep (7.6s of a 9.1s warm prep); the column
+    # lexsort + adjacent-difference grouping computes the identical
+    # inverse in a fraction of the time.
     job_key_group = np.full(J, -1, dtype=np.int32)
     qm = np.flatnonzero(~snap.job_is_running)
     if len(qm):
-        rec = np.concatenate(
-            [
-                snap.job_queue[qm, None].astype(np.int64),
-                snap.job_priority[qm, None].astype(np.int64),
-                job_pc[qm, None].astype(np.int64),
-                snap.job_req[qm].astype(np.int64),
-                snap.job_tolerated[qm].astype(np.int64),
-                snap.job_selector[qm].astype(np.int64),
-            ],
-            axis=1,
-        )
-        _, inverse = np.unique(
-            np.ascontiguousarray(rec), axis=0, return_inverse=True
-        )
-        job_key_group[qm] = inverse.astype(np.int32)
-        num_key_groups = int(inverse.max()) + 1
+        cols = [
+            snap.job_queue[qm].astype(np.int64),
+            snap.job_priority[qm].astype(np.int64),
+            job_pc[qm].astype(np.int64),
+        ]
+        cols += [snap.job_req[qm, r].astype(np.int64)
+                 for r in range(snap.job_req.shape[1])]
+        cols += [snap.job_tolerated[qm, c].astype(np.int64)
+                 for c in range(snap.job_tolerated.shape[1])]
+        cols += [snap.job_selector[qm, c].astype(np.int64)
+                 for c in range(snap.job_selector.shape[1])]
+        order = np.lexsort(cols[::-1])
+        new_group = np.zeros(len(qm), dtype=bool)
+        new_group[0] = True
+        for col in cols:
+            sorted_col = col[order]
+            new_group[1:] |= sorted_col[1:] != sorted_col[:-1]
+        gid_sorted = np.cumsum(new_group, dtype=np.int64) - 1
+        inverse = np.empty(len(qm), dtype=np.int32)
+        inverse[order] = gid_sorted.astype(np.int32)
+        job_key_group[qm] = inverse
+        num_key_groups = int(gid_sorted[-1]) + 1
     else:
         num_key_groups = 1
 
