@@ -317,6 +317,11 @@ class ExecutorAgent:
         self.issue_handler = PodIssueHandler()
         self.utilisation = UtilisationReporter()
         self.non_framework_usage: dict[str, dict] = {}
+        # Runs whose terminal event we already sent but the server still
+        # lists as active (its ingest lags the report by a cycle): the
+        # reconciliation sweep must not re-report them as missing pods —
+        # that would overwrite the real terminal reason.
+        self._reported_terminal: set[str] = set()
 
     def tick(self, now: float | None = None) -> dict:
         now = time.time() if now is None else now
@@ -377,15 +382,27 @@ class ExecutorAgent:
         # Reconciliation: runs the server believes are live here but the
         # runtime doesn't know (agent restart, lost pod) are reported
         # failed so the scheduler retries them elsewhere (the reference
-        # executor's missing-pod reconciliation). A run whose pod finished
-        # THIS tick was just popped from the runtime — it already has its
-        # real terminal event in this batch and must not be re-reported as
-        # missing (that would overwrite the real failure reason).
-        reported = {e["run_id"] for e in events}
+        # executor's missing-pod reconciliation). A run whose pod already
+        # produced its terminal event — this tick OR a recent one the
+        # server hasn't ingested yet (active_runs lags by a cycle) — must
+        # not be re-reported as missing: that would overwrite the real
+        # terminal reason.
+        reported = {
+            e["run_id"] for e in events if e["type"] in ("failed", "succeeded")
+        }
+        active_ids = {r["run_id"] for r in reply.get("active_runs", [])}
+        # Entries leave the set once the server stops listing the run
+        # (ingest caught up), so the set stays bounded. This tick's
+        # terminal reports join only AFTER ReportEvents succeeds below —
+        # a failed send must leave the run eligible for missing-pod
+        # reconciliation (the event was lost; reconciliation is the
+        # retry path).
+        self._reported_terminal &= active_ids
         for run in reply.get("active_runs", []):
             if (
                 run["run_id"] not in self.runtime.pods
                 and run["run_id"] not in reported
+                and run["run_id"] not in self._reported_terminal
             ):
                 events.append(
                     {
@@ -401,6 +418,9 @@ class ExecutorAgent:
                 )
         if events:
             self.client._call("ReportEvents", {"events": events})
+            # The send landed: suppress reconciliation for these runs
+            # until the server's view catches up.
+            self._reported_terminal |= reported
         # Prune acks for pods that no longer exist: completed runs don't
         # need acks (the server only re-sends LEASED runs), and the set
         # must not grow forever.

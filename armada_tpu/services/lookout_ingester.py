@@ -206,6 +206,13 @@ class LookoutStore:
         with self._lock:
             return self.rows.get(job_id)
 
+    def materialize(self, rows, convert):
+        """convert(row) for each row under the store lock: rows mutate in
+        place under the ingester, so converters get internally consistent
+        snapshots (queryapi page materialization)."""
+        with self._lock:
+            return [convert(r) for r in rows]
+
     def get_run(self, run_id: str) -> LookoutRun | None:
         """Run-level drilldown (job_run row by run_id)."""
         with self._lock:

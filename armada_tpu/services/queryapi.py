@@ -13,6 +13,7 @@ group-by with counts and aggregates — the operations the Lookout UI issues.
 
 from __future__ import annotations
 
+import heapq
 from dataclasses import dataclass, field
 
 from ..jobdb import JobDb, JobState
@@ -60,70 +61,98 @@ class JobRow:
 
     @staticmethod
     def from_job(job) -> "JobRow":
-        run = job.latest_run
-        runtime = 0.0
-        if run is not None and run.started and run.finished:
-            runtime = max(0.0, run.finished - run.started)
-        return JobRow(
-            job_id=job.id,
-            queue=job.queue,
-            jobset=job.jobset,
-            state=job.state.value,
-            priority=job.priority,
-            priority_class=job.spec.priority_class,
-            submitted=job.submitted,
-            node=run.node_id if run else "",
-            executor=run.executor if run else "",
-            attempts=job.num_attempts,
-            error=job.error,
-            error_category=job.error_category,
-            last_transition=max(
-                job.submitted,
-                run.finished if run else 0.0,
-                run.started if run else 0.0,
-                run.leased if run else 0.0,
-            ),
-            runtime_s=runtime,
-            run_id=run.id if run else "",
-            annotations=dict(job.spec.annotations),
-        )
+        kw = {f: _value_job(job, f) for f in JobRow.__dataclass_fields__}
+        # Own copy: the accessor returns the live spec dict by reference
+        # (cheap on the filter hot path); a returned row must not alias
+        # load-bearing scheduler state.
+        kw["annotations"] = dict(kw["annotations"])
+        return JobRow(**kw)
 
     @staticmethod
     def from_lookout(row) -> "JobRow":
-        run = row.latest_run
-        runtime = 0.0
-        if run is not None and run.started and run.finished:
-            runtime = max(0.0, run.finished - run.started)
-        return JobRow(
-            job_id=row.job_id,
-            queue=row.queue,
-            jobset=row.jobset,
-            state=row.state,
-            priority=row.priority,
-            priority_class=row.priority_class,
-            submitted=row.submitted,
-            node=run.node if run else "",
-            executor=run.executor if run else "",
-            attempts=len(row.runs),
-            error=row.error,
-            error_category=row.error_category,
-            last_transition=row.last_transition,
-            runtime_s=runtime,
-            run_id=run.run_id if run else "",
-            annotations=dict(row.annotations),
+        kw = {f: _value_lookout(row, f) for f in JobRow.__dataclass_fields__}
+        kw["annotations"] = dict(kw["annotations"])
+        return JobRow(**kw)
+
+_JOB_FIELDS = frozenset(JobRow.__dataclass_fields__)
+
+
+def _check_field(field: str) -> str:
+    """Queryable fields are exactly the JobRow schema — identical on both
+    backends. Unknown (or backend-private) fields are rejected loudly so
+    GET /api/jobs?order=typo is a 400, not a silent None-sort."""
+    if field not in _JOB_FIELDS:
+        raise ValueError(f"unknown field {field!r}")
+    return field
+
+
+def _runtime_s(started, finished) -> float:
+    return max(0.0, finished - started) if started and finished else 0.0
+
+
+def _value_job(obj, field: str):
+    """Field accessor over a raw jobdb Job. Queries filter/sort/aggregate
+    through these accessors and materialize JobRow dataclasses only for
+    the returned page (the reference pushes this down to SQL; building
+    100k+ row objects per query was the Python equivalent of a full table
+    scan with materialization). JobRow.from_job builds from the SAME
+    accessor, so page values can never disagree with filter/sort values."""
+    if field == "job_id":
+        return obj.id
+    if field == "state":
+        return obj.state.value
+    if field == "priority_class":
+        return obj.spec.priority_class
+    if field == "annotations":
+        return obj.spec.annotations
+    if field in ("node", "executor", "run_id", "attempts", "runtime_s",
+                 "last_transition"):
+        run = obj.latest_run
+        if field == "node":
+            return run.node_id if run else ""
+        if field == "executor":
+            return run.executor if run else ""
+        if field == "run_id":
+            return run.id if run else ""
+        if field == "attempts":
+            return obj.num_attempts
+        if field == "runtime_s":
+            return _runtime_s(run.started, run.finished) if run else 0.0
+        return max(
+            obj.submitted,
+            run.finished if run else 0.0,
+            run.started if run else 0.0,
+            run.leased if run else 0.0,
         )
+    return getattr(obj, _check_field(field))
 
 
-def _matches(row: JobRow, f: JobFilter) -> bool:
+def _value_lookout(obj, field: str):
+    """Field accessor over a raw LookoutRow (see _value_job)."""
+    if field in ("node", "executor", "run_id", "attempts", "runtime_s"):
+        run = obj.latest_run
+        if field == "attempts":
+            return len(obj.runs)
+        if field == "runtime_s":
+            return _runtime_s(run.started, run.finished) if run else 0.0
+        if run is None:
+            return ""
+        return {"node": run.node, "executor": run.executor,
+                "run_id": run.run_id}[field]
+    return getattr(obj, _check_field(field))
+
+
+def _matches_raw(value, obj, f: JobFilter) -> bool:
     if f.is_annotation:
-        present = f.field in row.annotations
+        annotations = value(obj, "annotations") or {}
+        present = f.field in annotations
         if f.match == "exists":
             return present
         if not present:
             return False
-        actual = row.annotations[f.field]
+        actual = annotations[f.field]
     else:
-        actual = getattr(row, f.field, None)
+        actual = value(obj, f.field)
         if f.match == "exists":
             return actual not in (None, "")
     if f.match == "exact":
@@ -155,12 +184,24 @@ class QueryApi:
         assert jobdb is not None or lookout is not None
         self.jobdb = jobdb
         self.lookout = lookout
+        # One accessor bound per backend (no per-row type sniffing on the
+        # query hot path).
+        self._value = _value_lookout if lookout is not None else _value_job
 
-    def _rows(self) -> list[JobRow]:
+    def _raw_rows(self) -> list:
         if self.lookout is not None:
-            return [JobRow.from_lookout(r) for r in self.lookout.all_rows()]
-        txn = self.jobdb.read_txn()
-        return [JobRow.from_job(j) for j in txn.all_jobs()]
+            return self.lookout.all_rows()
+        return self.jobdb.read_txn().all_jobs()
+
+    def _to_rows(self, page) -> list[JobRow]:
+        """Materialize the returned page. Lookout rows mutate in place
+        under the ingester; converting under the store lock keeps each
+        returned row internally consistent. (A row may have stopped
+        matching the filters between scan and materialization — the view
+        is eventually consistent, like any UI read of a live system.)"""
+        if self.lookout is not None:
+            return self.lookout.materialize(page, JobRow.from_lookout)
+        return [JobRow.from_job(o) for o in page]
 
     def get_jobs(
         self,
@@ -169,13 +210,29 @@ class QueryApi:
         skip: int = 0,
         take: int = 100,
     ) -> tuple[list[JobRow], int]:
-        """Filtered, ordered, paginated rows + total match count."""
-        rows = [r for r in self._rows() if all(_matches(r, f) for f in filters)]
-        rows.sort(
-            key=lambda r: getattr(r, order.field),
-            reverse=(order.direction == "desc"),
-        )
-        return rows[skip : skip + take], len(rows)
+        """Filtered, ordered, paginated rows + total match count. Filter
+        and sort run on RAW rows; JobRow materialization happens for the
+        returned page only (at 100k+ rows, per-query dataclass
+        construction was seconds of latency)."""
+        value = self._value
+        _check_field(order.field)
+        rows = [
+            obj
+            for obj in self._raw_rows()
+            if all(_matches_raw(value, obj, f) for f in filters)
+        ]
+        keyf = lambda obj: value(obj, order.field)
+        top = skip + take
+        if 0 < top < len(rows) // 4:
+            # Heap-select the page: O(N log K) beats a full O(N log N)
+            # sort when the page is a sliver of the match set (the UI's
+            # common shape: first pages of a 100k+ row table).
+            sel = heapq.nlargest if order.direction == "desc" else heapq.nsmallest
+            page = sel(top, rows, key=keyf)[skip:]
+        else:
+            rows.sort(key=keyf, reverse=(order.direction == "desc"))
+            page = rows[skip : skip + take]
+        return self._to_rows(page), len(rows)
 
     def group_jobs(
         self,
@@ -206,22 +263,27 @@ class QueryApi:
                                   agg["field"], agg["type"]))
             else:
                 agg_specs.append((agg, None, None))
-        for row in self._rows():
-            if not all(_matches(row, f) for f in filters):
+        value = self._value
+        if not group_by_annotation:
+            _check_field(group_by)
+        for row in self._raw_rows():
+            if not all(_matches_raw(value, row, f) for f in filters):
                 continue
             if group_by_annotation:
-                if group_by not in row.annotations:
+                annotations = value(row, "annotations") or {}
+                if group_by not in annotations:
                     continue
-                key = row.annotations[group_by]
+                key = annotations[group_by]
             else:
-                key = getattr(row, group_by)
+                key = value(row, group_by)
             g = groups.setdefault(
                 key, {"name": key, "count": 0, "aggregates": {}}
             )
             g["count"] += 1
+            state = value(row, "state")
             for agg, col, typ in agg_specs:
                 if col is not None:
-                    val = getattr(row, col, None)
+                    val = value(row, col)
                     if typ == "min":
                         cur = g["aggregates"].get(agg)
                         g["aggregates"][agg] = (
@@ -240,37 +302,40 @@ class QueryApi:
                         bucket["n"] += 1
                     elif typ == "state_counts":
                         sc = g["aggregates"].setdefault(agg, {})
-                        sc[row.state] = sc.get(row.state, 0) + 1
+                        sc[state] = sc.get(state, 0) + 1
                     else:
                         raise ValueError(f"unknown aggregate type {typ!r}")
                 elif agg == "submitted_min":
                     cur = g["aggregates"].get(agg)
+                    sub = value(row, "submitted")
                     g["aggregates"][agg] = (
-                        row.submitted if cur is None else min(cur, row.submitted)
+                        sub if cur is None else min(cur, sub)
                     )
                 elif agg == "submitted_max":
                     cur = g["aggregates"].get(agg)
+                    sub = value(row, "submitted")
                     g["aggregates"][agg] = (
-                        row.submitted if cur is None else max(cur, row.submitted)
+                        sub if cur is None else max(cur, sub)
                     )
                 elif agg == "state_counts":
                     sc = g["aggregates"].setdefault(agg, {})
-                    sc[row.state] = sc.get(row.state, 0) + 1
+                    sc[state] = sc.get(state, 0) + 1
                 elif agg == "error_category_counts":
                     sc = g["aggregates"].setdefault(agg, {})
-                    if row.error_category:
-                        sc[row.error_category] = sc.get(row.error_category, 0) + 1
+                    cat = value(row, "error_category")
+                    if cat:
+                        sc[cat] = sc.get(cat, 0) + 1
                 elif agg == "last_transition_max":
                     cur = g["aggregates"].get(agg)
+                    lt = value(row, "last_transition")
                     g["aggregates"][agg] = (
-                        row.last_transition
-                        if cur is None
-                        else max(cur, row.last_transition)
+                        lt if cur is None else max(cur, lt)
                     )
                 elif agg == "runtime_avg":
                     bucket = g["aggregates"].setdefault(agg, {"sum": 0.0, "n": 0})
-                    if row.runtime_s:
-                        bucket["sum"] += row.runtime_s
+                    rt = value(row, "runtime_s")
+                    if rt:
+                        bucket["sum"] += rt
                         bucket["n"] += 1
         for g in groups.values():
             for name, v in list(g["aggregates"].items()):
@@ -295,22 +360,20 @@ class QueryApi:
     ) -> list[dict]:
         """Error drilldown (lookout repository GetJobError + the UI's error
         surfacing): failed jobs with error text + category + run history."""
+        value = self._value
         out = []
-        for row in self._rows():
-            if not row.error:
+        for row in self._raw_rows():
+            if not value(row, "error"):
                 continue
-            if not all(_matches(row, f) for f in filters):
+            if not all(_matches_raw(value, row, f) for f in filters):
                 continue
             out.append(
                 {
-                    "job_id": row.job_id,
-                    "queue": row.queue,
-                    "jobset": row.jobset,
-                    "state": row.state,
-                    "error": row.error,
-                    "error_category": row.error_category,
-                    "attempts": row.attempts,
-                    "node": row.node,
+                    name: value(row, name)
+                    for name in (
+                        "job_id", "queue", "jobset", "state", "error",
+                        "error_category", "attempts", "node",
+                    )
                 }
             )
             if len(out) >= take:
@@ -416,8 +479,13 @@ class QueryApi:
         return None
 
     def active_job_sets(self) -> list[tuple[str, str]]:
+        value = self._value
         seen = {}
-        for row in self._rows():
-            if row.state in ("queued", "leased", "pending", "running"):
-                seen[(row.queue, row.jobset)] = True
+        for row in self._raw_rows():
+            if value(row, "state") in (
+                "queued", "leased", "pending", "running"
+            ):
+                seen[
+                    (value(row, "queue"), value(row, "jobset"))
+                ] = True
         return sorted(seen)
