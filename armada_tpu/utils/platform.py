@@ -16,11 +16,36 @@ output rather than silent (round-1 failure mode: bench silently ran on cpu).
 from __future__ import annotations
 
 import os
+import socket
 import subprocess
 import sys
 
 # Populated by ensure_healthy_backend for callers (bench) to report.
 last_probe_report: dict = {}
+
+# Loopback endpoints the axon PJRT plugin dials (pjrt.py provider docs:
+# jax.devices() -> :8083 stateless, sessions -> :8082). If neither accepts
+# a TCP connection the relay is down and PJRT client init would hang
+# forever retrying — see docs/tpu_tunnel_postmortem.md.
+_RELAY_PORTS = (8083, 8082)
+
+
+def relay_preflight(timeout: float = 0.5) -> tuple[bool, str]:
+    """Fast liveness check of the axon tunnel relay.
+
+    Returns (alive, detail). Only meaningful when the axon plugin is in
+    play (JAX_PLATFORMS mentions axon); callers skip it otherwise. A dead
+    relay is detected in milliseconds instead of waiting out the 120s
+    subprocess-probe window twice per process."""
+    host = os.environ.get("AXON_POOL_SVC_OVERRIDE") or "127.0.0.1"
+    errors = []
+    for port in _RELAY_PORTS:
+        try:
+            with socket.create_connection((host, port), timeout=timeout):
+                return True, f"relay listening on {host}:{port}"
+        except OSError as e:
+            errors.append(f"{host}:{port} {e.__class__.__name__}")
+    return False, "relay down: " + ", ".join(errors)
 
 
 def _probe_once(timeout: float) -> tuple[str | None, str]:
@@ -80,6 +105,27 @@ def ensure_healthy_backend(probe_timeout: float = 120.0, retries: int = 1) -> st
         _force_cpu()
         last_probe_report = {"platform": "cpu", "reason": "JAX_PLATFORMS=cpu"}
         return "cpu"
+    if "axon" in want.split(","):
+        # The tunnel plugin blocks forever inside PJRT_Client_Create when
+        # its loopback relay is down (docs/tpu_tunnel_postmortem.md). A
+        # sub-second TCP preflight settles it without burning the probe
+        # windows; a live relay falls through to the real probe.
+        alive, detail = relay_preflight()
+        if not alive:
+            _force_cpu()
+            last_probe_report = {
+                "platform": "cpu",
+                "reason": f"fallback: axon tunnel {detail} "
+                "(PJRT init would hang; see docs/tpu_tunnel_postmortem.md)",
+                "attempts": [detail],
+            }
+            print(
+                f"[platform] axon tunnel preflight failed ({detail}); "
+                "falling back to CPU",
+                file=sys.stderr,
+                flush=True,
+            )
+            return "cpu"
     attempts = []
     for i in range(retries + 1):
         platform, detail = _probe_once(probe_timeout)

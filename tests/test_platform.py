@@ -1,0 +1,53 @@
+"""Platform guard: the axon-tunnel relay preflight.
+
+The tunnel plugin blocks forever inside PJRT_Client_Create when its
+loopback relay is down (docs/tpu_tunnel_postmortem.md); the preflight must
+settle liveness at TCP speed, both ways.
+"""
+
+import socket
+import threading
+
+from armada_tpu.utils.platform import relay_preflight
+
+
+def test_preflight_down(monkeypatch):
+    # Nothing listens on these ports in the test env (and if something
+    # did, AXON_POOL_SVC_OVERRIDE steers us to a dead name).
+    monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
+    alive, detail = relay_preflight(timeout=0.2)
+    if alive:
+        # A real relay is up on this host — preflight must say so.
+        assert "listening" in detail
+    else:
+        assert "relay down" in detail
+        assert "8083" in detail and "8082" in detail
+
+
+def test_preflight_up(monkeypatch):
+    # Stand up a throwaway listener on one of the relay ports' host —
+    # bind an ephemeral port and monkeypatch the port list instead of
+    # requiring 8083 to be free.
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
+    import armada_tpu.utils.platform as plat
+
+    monkeypatch.setattr(plat, "_RELAY_PORTS", (port,))
+    accepted = []
+
+    def accept():
+        try:
+            conn, _ = srv.accept()
+            accepted.append(1)
+            conn.close()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=accept, daemon=True)
+    t.start()
+    alive, detail = relay_preflight(timeout=1.0)
+    srv.close()
+    assert alive and f":{port}" in detail
