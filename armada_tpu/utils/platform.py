@@ -105,7 +105,16 @@ def ensure_healthy_backend(probe_timeout: float = 120.0, retries: int = 1) -> st
         _force_cpu()
         last_probe_report = {"platform": "cpu", "reason": "JAX_PLATFORMS=cpu"}
         return "cpu"
-    if "axon" in want.split(","):
+    tokens = {t.strip() for t in want.split(",")}
+    # The sitecustomize registers the plugin in every interpreter whenever
+    # PALLAS_AXON_POOL_IPS is set, whatever JAX_PLATFORMS says — preflight
+    # on any sign of the tunnel, not just an exact platform token.
+    axon_in_play = (
+        "axon" in tokens
+        or bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+        or os.environ.get("_AXON_REGISTERED") == "1"
+    )
+    if axon_in_play:
         # The tunnel plugin blocks forever inside PJRT_Client_Create when
         # its loopback relay is down (docs/tpu_tunnel_postmortem.md). A
         # sub-second TCP preflight settles it without burning the probe
