@@ -163,13 +163,20 @@ class SubprocessPodRuntime:
 
                 limit_bytes = int(parse_quantity(mem))
 
-        def preexec():
-            import resource
+        if limit_bytes:
+            # The memory rlimit is applied by a shell wrapper between fork
+            # and the job's exec — NOT preexec_fn: this process is
+            # multithreaded (gRPC client threads, task manager, JAX), and
+            # running Python between fork and exec is documented
+            # deadlock-prone there. `ulimit -v` takes KiB.
+            kib = max(1, limit_bytes // 1024)
+            import shlex
 
-            if limit_bytes:
-                resource.setrlimit(
-                    resource.RLIMIT_AS, (limit_bytes, limit_bytes)
-                )
+            argv = [
+                "/bin/sh",
+                "-c",
+                f"ulimit -v {kib}; exec " + " ".join(shlex.quote(a) for a in argv),
+            ]
 
         # stderr spools to an unnamed temp file, not a PIPE: a chatty job
         # writing past the pipe buffer would block in write(2) forever with
@@ -183,7 +190,6 @@ class SubprocessPodRuntime:
                 argv,
                 stdout=subprocess.DEVNULL,
                 stderr=stderr,
-                preexec_fn=preexec if limit_bytes else None,
                 start_new_session=True,  # kill() takes the process group
             ), stderr
         except OSError:

@@ -53,6 +53,7 @@ class SchedulerService:
         log,
         *,
         backend: str = "oracle",
+        mesh=None,
         queues: list[QueueSpec] | None = None,
         is_leader=lambda: True,
         runner=None,
@@ -68,6 +69,13 @@ class SchedulerService:
             transition_observer=self._observe_transition,
         )
         self.backend = backend
+        # Multi-chip: node axis sharded over a device mesh — the product
+        # analogue of the reference's multi-cluster union scheduling
+        # (scheduling_algo.go:135-147). `mesh` is a jax.sharding.Mesh or a
+        # device count (first N jax devices); placements are exactly those
+        # of the single-device solve (tests/test_multichip.py).
+        self.mesh = mesh
+        self._sharded_run = None
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
         self.priority_overrides: dict[str, float] = {}
         self.cordoned_queues: set[str] = set()
@@ -924,6 +932,28 @@ class SchedulerService:
             for (queue, jobset), events in by_jobset.items()
         ]
 
+    def _resolve_sharded_run(self):
+        """Lazily build the node-sharded solve runner for self.mesh."""
+        if self._sharded_run is None:
+            from jax.sharding import Mesh
+
+            from ..parallel.mesh import make_node_mesh, node_sharded_solve
+
+            mesh = self.mesh
+            if not isinstance(mesh, Mesh):
+                import jax
+
+                n = int(mesh)
+                devices = jax.devices()[:n]
+                if len(devices) < n:
+                    raise RuntimeError(
+                        f"mesh={n} requested but only {len(devices)} devices"
+                    )
+                mesh = make_node_mesh(devices)
+            self._mesh_size = mesh.devices.size
+            self._sharded_run = node_sharded_solve(mesh)
+        return self._sharded_run
+
     def _solve(self, snap):
         if self.backend == "kernel":
             from ..solver.kernel import solve_round
@@ -931,7 +961,14 @@ class SchedulerService:
 
             import numpy as np
 
-            out = solve_round(pad_device_round(prep_device_round(snap)))
+            dev = pad_device_round(prep_device_round(snap))
+            if self.mesh is not None:
+                from ..parallel.mesh import pad_nodes
+
+                run = self._resolve_sharded_run()
+                out = run(pad_nodes(dev, self._mesh_size))
+            else:
+                out = solve_round(dev)
             J, Q = snap.num_jobs, snap.num_queues
             return {
                 "assigned_node": out["assigned_node"][:J],

@@ -109,6 +109,7 @@ class Simulator:
         config: SchedulingConfig | None = None,
         *,
         backend: str = "oracle",
+        mesh=None,
         seed: int = 0,
         cycle_interval: float = 10.0,
         max_time: float = 7 * 24 * 3600.0,
@@ -119,7 +120,9 @@ class Simulator:
         self.max_time = max_time
 
         self.log = InMemoryEventLog()
-        self.scheduler = SchedulerService(self.config, self.log, backend=backend)
+        self.scheduler = SchedulerService(
+            self.config, self.log, backend=backend, mesh=mesh
+        )
         self.submit = SubmitService(self.config, self.log, scheduler=self.scheduler)
 
         self._runtimes: dict[str, float] = {}

@@ -258,15 +258,122 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
     )
 
 
-def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
+@dataclass
+class PrepCache:
+    """Precomputed per-job/per-queue tensors for the incremental path.
+
+    `snapshot.incremental.IncrementalRound` maintains these across cycles
+    (O(delta) updates); passing them here skips the O(J) recompute blocks —
+    the key-group interning lexsort, the pc-name resolution listcomp, the
+    request device-scaling, and the queue-demand bincounts — which dominate
+    warm prep at 1M jobs.
+    """
+
+    req_dev: np.ndarray  # int32[J, R]
+    req_fit_dev: np.ndarray  # int32[J, R]
+    job_pc: np.ndarray  # int32[J]
+    job_key_group: np.ndarray  # int32[J] (-1 for running)
+    num_key_groups: int
+    queue_alloc0: np.ndarray  # int64[Q, R] device units
+    queue_demand_pc: np.ndarray  # int64[Q, C, R] device units
+
+
+def compute_key_groups(
+    job_queue: np.ndarray,
+    job_priority: np.ndarray,
+    job_pc: np.ndarray,
+    job_req: np.ndarray,
+    job_tolerated: np.ndarray,
+    job_selector: np.ndarray,
+    qm: np.ndarray,
+) -> tuple[np.ndarray, int]:
+    """Scheduling-key grouping over the row subset `qm` (non-running jobs):
+    intern (queue, priority, pc, requests, tolerations, selector) tuples
+    into dense group ids via a column lexsort + adjacent-difference pass.
+
+    Shared by the cold prep path and the incremental state's adoption /
+    compaction (snapshot/incremental.py) so the two can never diverge.
+    Returns (int32[J] group per row, -1 off-subset; group count)."""
+    J = len(job_queue)
+    job_key_group = np.full(J, -1, dtype=np.int32)
+    if not len(qm):
+        return job_key_group, 1
+    cols = [
+        job_queue[qm].astype(np.int64),
+        job_priority[qm].astype(np.int64),
+        job_pc[qm].astype(np.int64),
+    ]
+    cols += [job_req[qm, r].astype(np.int64) for r in range(job_req.shape[1])]
+    cols += [
+        job_tolerated[qm, c].astype(np.int64)
+        for c in range(job_tolerated.shape[1])
+    ]
+    cols += [
+        job_selector[qm, c].astype(np.int64)
+        for c in range(job_selector.shape[1])
+    ]
+    order = np.lexsort(cols[::-1])
+    new_group = np.zeros(len(qm), dtype=bool)
+    new_group[0] = True
+    for col in cols:
+        sorted_col = col[order]
+        new_group[1:] |= sorted_col[1:] != sorted_col[:-1]
+    gid_sorted = np.cumsum(new_group, dtype=np.int64) - 1
+    inverse = np.empty(len(qm), dtype=np.int32)
+    inverse[order] = gid_sorted.astype(np.int32)
+    job_key_group[qm] = inverse
+    return job_key_group, int(gid_sorted[-1]) + 1
+
+
+def compute_queue_device_accounting(
+    job_queue: np.ndarray,
+    job_pc: np.ndarray,
+    job_is_running: np.ndarray,
+    req_dev: np.ndarray,
+    Q: int,
+    C: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(queue_alloc0[Q,R], queue_demand_pc[Q,C,R]) in device units — the
+    running allocation and by-priority-class demand bincounts. Shared by
+    the cold prep path and the incremental state's adoption."""
+    R = req_dev.shape[1] if req_dev.ndim == 2 else 0
+    queue_alloc0 = np.zeros((Q, R), dtype=np.int64)
+    queue_demand_pc = np.zeros((Q, C, R), dtype=np.int64)
+    J = len(job_queue)
+    if not (J and Q):
+        return queue_alloc0, queue_demand_pc
+    valid = job_queue >= 0
+    qidx = np.where(valid, job_queue, 0).astype(np.int64)
+    seg = qidx * C + job_pc
+    run_w = valid & job_is_running
+    for r in range(R):
+        col = req_dev[:, r].astype(np.float64)
+        queue_demand_pc[:, :, r] = (
+            np.bincount(seg, weights=np.where(valid, col, 0.0), minlength=Q * C)
+            .reshape(Q, C)
+            .astype(np.int64)
+        )
+        queue_alloc0[:, r] = np.bincount(
+            qidx, weights=np.where(run_w, col, 0.0), minlength=Q
+        )[:Q].astype(np.int64)
+    return queue_alloc0, queue_demand_pc
+
+
+def prep_device_round(
+    snap: RoundSnapshot, cache: PrepCache | None = None
+) -> DeviceRound:
     cfg = snap.config
     factory = snap.factory
     J, N, Q = snap.num_jobs, snap.num_nodes, snap.num_queues
     R = factory.num_resources
     P = snap.num_priorities
 
-    req_dev = factory.to_device(snap.job_req, ceil=True)
-    req_fit_dev = factory.to_device(snap.job_req_fit(), ceil=True)
+    if cache is not None:
+        req_dev = cache.req_dev
+        req_fit_dev = cache.req_fit_dev
+    else:
+        req_dev = factory.to_device(snap.job_req, ceil=True)
+        req_fit_dev = factory.to_device(snap.job_req_fit(), ceil=True)
     alloc_dev = factory.to_device(snap.allocatable, ceil=False)
     total_dev = factory.to_device(snap.node_total, ceil=False)
 
@@ -280,7 +387,11 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     pc_preemptible = np.asarray(
         [cfg.priority_classes[n].preemptible for n in pc_names], dtype=bool
     )
-    job_pc = np.asarray([pc_index[n] for n in snap.job_pc_name], dtype=np.int32)
+    job_pc = (
+        cache.job_pc
+        if cache is not None
+        else np.asarray([pc_index[n] for n in snap.job_pc_name], dtype=np.int32)
+    )
 
     # Scheduling-key groups over non-running jobs: intern the tuple of
     # (queue, priority, pc, requests, tolerations, selector) per job.
@@ -289,33 +400,19 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
     # dominated 1M-job prep (7.6s of a 9.1s warm prep); the column
     # lexsort + adjacent-difference grouping computes the identical
     # inverse in a fraction of the time.
-    job_key_group = np.full(J, -1, dtype=np.int32)
-    qm = np.flatnonzero(~snap.job_is_running)
-    if len(qm):
-        cols = [
-            snap.job_queue[qm].astype(np.int64),
-            snap.job_priority[qm].astype(np.int64),
-            job_pc[qm].astype(np.int64),
-        ]
-        cols += [snap.job_req[qm, r].astype(np.int64)
-                 for r in range(snap.job_req.shape[1])]
-        cols += [snap.job_tolerated[qm, c].astype(np.int64)
-                 for c in range(snap.job_tolerated.shape[1])]
-        cols += [snap.job_selector[qm, c].astype(np.int64)
-                 for c in range(snap.job_selector.shape[1])]
-        order = np.lexsort(cols[::-1])
-        new_group = np.zeros(len(qm), dtype=bool)
-        new_group[0] = True
-        for col in cols:
-            sorted_col = col[order]
-            new_group[1:] |= sorted_col[1:] != sorted_col[:-1]
-        gid_sorted = np.cumsum(new_group, dtype=np.int64) - 1
-        inverse = np.empty(len(qm), dtype=np.int32)
-        inverse[order] = gid_sorted.astype(np.int32)
-        job_key_group[qm] = inverse
-        num_key_groups = int(gid_sorted[-1]) + 1
+    if cache is not None:
+        job_key_group = cache.job_key_group
+        num_key_groups = max(1, cache.num_key_groups)
     else:
-        num_key_groups = 1
+        job_key_group, num_key_groups = compute_key_groups(
+            snap.job_queue,
+            snap.job_priority,
+            job_pc,
+            snap.job_req,
+            snap.job_tolerated,
+            snap.job_selector,
+            np.flatnonzero(~snap.job_is_running),
+        )
 
     # ---- slots ----
     # Segment 0: running gangs (eviction candidates), grouped by gang id.
@@ -590,23 +687,13 @@ def prep_device_round(snap: RoundSnapshot) -> DeviceRound:
 
     # ---- queue tensors ----
     queue_name_rank = np.argsort(np.argsort(snap.queue_names)).astype(np.int32)
-    queue_alloc0 = np.zeros((Q, R), dtype=np.int64)
-    queue_demand_pc = np.zeros((Q, C, R), dtype=np.int64)
-    if J and Q:
-        valid = snap.job_queue >= 0
-        qidx = np.where(valid, snap.job_queue, 0).astype(np.int64)
-        seg = qidx * C + job_pc
-        run_w = valid & snap.job_is_running
-        for r in range(R):
-            col = req_dev[:, r].astype(np.float64)
-            queue_demand_pc[:, :, r] = (
-                np.bincount(seg, weights=np.where(valid, col, 0.0), minlength=Q * C)
-                .reshape(Q, C)
-                .astype(np.int64)
-            )
-            queue_alloc0[:, r] = np.bincount(
-                qidx, weights=np.where(run_w, col, 0.0), minlength=Q
-            )[:Q].astype(np.int64)
+    if cache is not None:
+        queue_alloc0 = cache.queue_alloc0
+        queue_demand_pc = cache.queue_demand_pc
+    else:
+        queue_alloc0, queue_demand_pc = compute_queue_device_accounting(
+            snap.job_queue, job_pc, snap.job_is_running, req_dev, Q, C
+        )
 
     queue_pc_limit = np.full((Q, C, R), np.inf)
     # Canonical pool totals in device units (floating columns = pool caps,

@@ -1,0 +1,335 @@
+"""Differential tests: IncrementalRound must reach solve decisions
+identical to a fresh build_round_snapshot at every point of a delta
+sequence — adds, binds, removals, unbinds, gang completion across cycles.
+"""
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import (
+    Gang,
+    JobSpec,
+    NodeSpec,
+    QueueSpec,
+    RunningJob,
+    Taint,
+    Toleration,
+)
+from armada_tpu.snapshot.incremental import (
+    IncrementalRound,
+    SnapshotRebuildRequired,
+)
+from armada_tpu.snapshot.round import build_round_snapshot
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round, prep_device_round
+
+
+def make_config(**kw):
+    return SchedulingConfig(
+        priority_classes={
+            "high": PriorityClass("high", 30000, preemptible=False),
+            "low": PriorityClass("low", 1000, preemptible=True),
+        },
+        default_priority_class="low",
+        **kw,
+    )
+
+
+def make_nodes(n=8):
+    nodes = []
+    for i in range(n):
+        taints = (Taint("gpu", "true", "NoSchedule"),) if i % 4 == 3 else ()
+        labels = {"zone": f"z{i % 2}", "disk": "ssd" if i % 2 else "hdd"}
+        nodes.append(
+            NodeSpec(
+                id=f"node-{i:03d}",
+                pool="default",
+                taints=taints,
+                labels=labels,
+                total_resources={"cpu": "16", "memory": "64Gi"},
+            )
+        )
+    return nodes
+
+
+def job(i, queue="q-a", cpu=2, pc="low", prio=0, sel=None, tol=False, gang=None):
+    return JobSpec(
+        id=f"job-{i:04d}",
+        queue=queue,
+        priority=prio,
+        priority_class=pc,
+        requests={"cpu": str(cpu), "memory": f"{cpu * 2}Gi"},
+        node_selector=sel or {},
+        tolerations=(Toleration("gpu", "Equal", "true", "NoSchedule"),)
+        if tol
+        else (),
+        gang=gang,
+        submitted_ts=float(i),
+    )
+
+
+QUEUES = [QueueSpec("q-a", 1.0), QueueSpec("q-b", 2.0)]
+
+
+def solve_ids(snap, dev):
+    """Solve and decode to comparable, row-order-independent structures."""
+    out = solve_round(pad_device_round(dev))
+    J = snap.num_jobs
+    sched = {}
+    for j in np.flatnonzero(np.asarray(out["scheduled_mask"][:J])):
+        sched[str(snap.job_ids[j])] = (
+            snap.node_ids[int(out["assigned_node"][j])],
+            int(out["scheduled_priority"][j]),
+        )
+    preempted = {
+        str(snap.job_ids[j])
+        for j in np.flatnonzero(np.asarray(out["preempted_mask"][:J]))
+    }
+    Q = snap.num_queues
+    fs = np.asarray(out["fair_share"][:Q])
+    return sched, preempted, fs
+
+
+class Mirror:
+    """Python-object mirror of the incremental state, driving fresh builds."""
+
+    def __init__(self, cfg, nodes, running, queued):
+        self.cfg = cfg
+        self.nodes = nodes
+        self.running = {r.job.id: r for r in running}
+        self.queued = {j.id: j for j in queued}
+
+    def fresh(self):
+        return build_round_snapshot(
+            self.cfg,
+            "default",
+            self.nodes,
+            QUEUES,
+            list(self.running.values()),
+            list(self.queued.values()),
+        )
+
+    def add(self, jobs):
+        for j in jobs:
+            self.queued[j.id] = j
+
+    def bind(self, leases):
+        for jid, nid, prio, ts in leases:
+            self.running[jid] = RunningJob(
+                job=self.queued.pop(jid),
+                node_id=nid,
+                scheduled_at_priority=prio,
+                leased_ts=ts,
+            )
+
+    def unbind(self, ids):
+        for jid in ids:
+            self.queued[jid] = self.running.pop(jid).job
+
+    def remove(self, ids):
+        for jid in ids:
+            self.running.pop(jid, None)
+            self.queued.pop(jid, None)
+
+
+def assert_same_decisions(inc, mirror):
+    snap_i = inc.snapshot()
+    dev_i = inc.device_round()
+    snap_f = mirror.fresh()
+    dev_f = prep_device_round(snap_f)
+    s_i, p_i, fs_i = solve_ids(snap_i, dev_i)
+    s_f, p_f, fs_f = solve_ids(snap_f, dev_f)
+    assert s_i == s_f
+    assert p_i == p_f
+    np.testing.assert_allclose(fs_i, fs_f, rtol=1e-12)
+    # Accounting parity, mapped by id (row orders differ).
+    ids_f = list(snap_f.job_ids)
+    rows_i = [inc._id_to_row[i] for i in ids_f]
+    np.testing.assert_array_equal(snap_i.job_req[rows_i], snap_f.job_req)
+    np.testing.assert_array_equal(snap_i.job_queue[rows_i], snap_f.job_queue)
+    np.testing.assert_array_equal(
+        snap_i.job_is_running[rows_i], snap_f.job_is_running
+    )
+    np.testing.assert_array_equal(snap_i.job_priority[rows_i], snap_f.job_priority)
+    np.testing.assert_array_equal(snap_i.queue_allocated, snap_f.queue_allocated)
+    np.testing.assert_array_equal(snap_i.queue_demand, snap_f.queue_demand)
+    np.testing.assert_array_equal(snap_i.allocatable, snap_f.allocatable)
+    # Node identity of bound jobs.
+    for k, r in zip(range(len(ids_f)), rows_i):
+        nf = snap_f.job_node[k]
+        ni = snap_i.job_node[r]
+        if nf >= 0 or ni >= 0:
+            assert snap_i.node_ids[ni] == snap_f.node_ids[nf]
+    # Relative within-queue order among live jobs must match.
+    of = np.argsort(snap_f.job_order)
+    oi = np.argsort(snap_i.job_order[rows_i])
+    seq_f = [ids_f[int(j)] for j in of]
+    seq_i = [ids_f[int(j)] for j in oi]
+    assert seq_f == seq_i
+
+
+def test_lifecycle_differential():
+    cfg = make_config()
+    nodes = make_nodes(8)
+    running = [
+        RunningJob(job=job(900 + i, cpu=4), node_id=f"node-{i:03d}",
+                   scheduled_at_priority=1000, leased_ts=float(i))
+        for i in range(2)
+    ]
+    queued = [job(i, queue="q-a" if i % 2 else "q-b", cpu=1 + i % 3,
+                  sel={"zone": "z0"} if i % 5 == 0 else None,
+                  tol=i % 7 == 0) for i in range(40)]
+    inc = IncrementalRound(cfg, "default", nodes, QUEUES, running, queued)
+    mirror = Mirror(cfg, nodes, running, queued)
+    assert_same_decisions(inc, mirror)
+
+    # Cycle 1: submit more work, including a gang that stays incomplete.
+    gang = Gang(id="g1", cardinality=3)
+    new1 = [job(100 + i, cpu=2, gang=gang) for i in range(2)]
+    new1 += [job(120 + i, queue="q-b", cpu=1, prio=-1) for i in range(5)]
+    inc.add_jobs(new1)
+    mirror.add(new1)
+    assert_same_decisions(inc, mirror)
+
+    # Cycle 2: the gang completes; bind a few of last round's decisions.
+    new2 = [job(102, cpu=2, gang=gang)]
+    inc.add_jobs(new2)
+    mirror.add(new2)
+    snap = inc.snapshot()
+    dev = inc.device_round()
+    sched, _, _ = solve_ids(snap, dev)
+    leases = [
+        (jid, nid, prio, 50.0) for jid, (nid, prio) in sorted(sched.items())[:6]
+    ]
+    inc.bind(leases)
+    mirror.bind(leases)
+    assert_same_decisions(inc, mirror)
+
+    # Cycle 3: some running jobs finish, some queued are cancelled.
+    done = [leases[0][0], leases[1][0], "job-0003", "job-0010"]
+    inc.remove_jobs(done)
+    mirror.remove(done)
+    assert_same_decisions(inc, mirror)
+
+    # Cycle 4: a running job is preempted back to queued.
+    back = [leases[2][0]]
+    inc.unbind(back)
+    mirror.unbind(back)
+    assert_same_decisions(inc, mirror)
+
+    # Cycle 5: row reuse — new submits land in tombstoned rows.
+    new3 = [job(200 + i, queue="q-b", cpu=3) for i in range(6)]
+    inc.add_jobs(new3)
+    mirror.add(new3)
+    assert_same_decisions(inc, mirror)
+
+
+def test_market_lifecycle():
+    cfg = make_config(market_driven=True)
+    nodes = make_nodes(4)
+    queued = [
+        JobSpec(
+            id=f"bid-{i:03d}",
+            queue="q-a" if i % 2 else "q-b",
+            priority_class="low",
+            requests={"cpu": "2", "memory": "4Gi"},
+            submitted_ts=float(i),
+            bid_prices={"default": {"queued": 1.0 + i * 0.25, "running": 2.0 + i * 0.25}},
+        )
+        for i in range(12)
+    ]
+    inc = IncrementalRound(cfg, "default", nodes, QUEUES, [], queued)
+    mirror = Mirror(cfg, nodes, [], queued)
+    assert_same_decisions(inc, mirror)
+
+    snap = inc.snapshot()
+    sched, _, _ = solve_ids(snap, inc.device_round())
+    leases = [(jid, nid, p, 9.0) for jid, (nid, p) in sorted(sched.items())[:3]]
+    inc.bind(leases)
+    mirror.bind(leases)
+    assert_same_decisions(inc, mirror)
+
+    # Market unbind restores the queued-phase bid.
+    inc.unbind([leases[0][0]])
+    mirror.unbind([leases[0][0]])
+    assert_same_decisions(inc, mirror)
+
+
+def test_vocab_miss_raises():
+    cfg = make_config()
+    nodes = make_nodes(4)
+    queued = [job(i) for i in range(4)]
+    inc = IncrementalRound(cfg, "default", nodes, QUEUES, [], queued)
+    # "disk" exists on nodes but was never referenced -> not interned.
+    with pytest.raises(SnapshotRebuildRequired):
+        inc.add_jobs([job(50, sel={"disk": "ssd"})])
+    # Unknown queue.
+    with pytest.raises(SnapshotRebuildRequired):
+        inc.add_jobs([JobSpec(id="x", queue="nope", requests={"cpu": "1"})])
+    # A selector on a key no node carries is NOT a rebuild (impossible job).
+    inc.add_jobs([job(51, sel={"ghost": "v"})])
+    snap = inc.snapshot()
+    assert not snap.job_possible[inc._id_to_row["job-0051"]]
+
+
+def test_failed_batch_leaves_state_untouched():
+    cfg = make_config()
+    nodes = make_nodes(2)
+    queued = [job(i) for i in range(4)]
+    inc = IncrementalRound(cfg, "default", nodes, QUEUES, [], queued)
+    size0, free0, gen0 = inc._size, list(inc._free), inc._gen
+    # Duplicate ids WITHIN one batch must raise, not leak a ghost row.
+    dup = [job(50), job(50)]
+    with pytest.raises(SnapshotRebuildRequired):
+        inc.add_jobs(dup)
+    assert (inc._size, inc._free, inc._gen) == (size0, free0, gen0)
+    assert "job-0050" not in inc._id_to_row
+    # A malformed quantity raises before any mutation.
+    bad = JobSpec(id="bad", queue="q-a", requests={"memory": "4GiBB"})
+    with pytest.raises(Exception):
+        inc.add_jobs([job(51), bad])
+    assert (inc._size, inc._free, inc._gen) == (size0, free0, gen0)
+    assert "job-0051" not in inc._id_to_row
+    # State still fully functional.
+    mirror = Mirror(cfg, nodes, [], queued)
+    assert_same_decisions(inc, mirror)
+
+
+def test_key_group_compaction():
+    cfg = make_config()
+    nodes = make_nodes(2)
+    inc = IncrementalRound(cfg, "default", nodes, QUEUES, [], [job(0)])
+    mirror = Mirror(cfg, nodes, [], [job(0)])
+    # Churn 1500 distinct request shapes through the state; without
+    # compaction num_key_groups would exceed 1500.
+    for wave in range(3):
+        batch = [
+            JobSpec(
+                id=f"w{wave}-{i}",
+                queue="q-a",
+                requests={"cpu": "1", "memory": f"{1000 + wave * 500 + i}Ki"},
+                submitted_ts=float(i),
+            )
+            for i in range(500)
+        ]
+        inc.add_jobs(batch)
+        mirror.add(batch)
+        ids = [j.id for j in batch[:400]]
+        inc.remove_jobs(ids)
+        mirror.remove(ids)
+    assert inc._num_key_groups < 1500
+    assert_same_decisions(inc, mirror)
+
+
+def test_grow_past_capacity():
+    cfg = make_config()
+    nodes = make_nodes(2)
+    queued = [job(i) for i in range(3)]
+    inc = IncrementalRound(cfg, "default", nodes, QUEUES, [], queued)
+    mirror = Mirror(cfg, nodes, [], queued)
+    big = [job(1000 + i, cpu=1) for i in range(2000)]
+    inc.add_jobs(big)
+    mirror.add(big)
+    assert inc._cap >= 2003
+    assert_same_decisions(inc, mirror)

@@ -26,7 +26,7 @@ CFG = SchedulingConfig(
 )
 
 
-def run(backend, seed):
+def run(backend, seed, mesh=None):
     sim = Simulator(
         [
             ClusterSpec(
@@ -73,6 +73,7 @@ def run(backend, seed):
         ),
         config=CFG,
         backend=backend,
+        mesh=mesh,
         seed=seed,
         max_time=5000.0,
     )
@@ -95,3 +96,19 @@ def test_full_simulation_differential(seed):
     assert oracle["placements"] == kernel["placements"]
     # sanity: the scenario actually exercises the interesting paths
     assert oracle["finished"] >= 74
+
+
+def test_full_simulation_differential_sharded():
+    """The node-sharded product backend (SchedulerService mesh=...) must
+    reproduce the single-device kernel history exactly — the whole-system
+    analogue of the per-round shard parity suite."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 (virtual) devices")
+    kernel = run("kernel", 0)
+    sharded = run("kernel", 0, mesh=4)
+    assert kernel["finished"] == sharded["finished"]
+    assert kernel["preemptions"] == sharded["preemptions"]
+    assert kernel["states"] == sharded["states"]
+    assert kernel["placements"] == sharded["placements"]
