@@ -172,10 +172,14 @@ class SubprocessPodRuntime:
             kib = max(1, limit_bytes // 1024)
             import shlex
 
+            # `|| exit 127`: a failed ulimit (hard limit already lower, or
+            # a shell without -v) must fail the pod visibly, not exec the
+            # job uncapped — matching the old preexec_fn abort semantics.
             argv = [
                 "/bin/sh",
                 "-c",
-                f"ulimit -v {kib}; exec " + " ".join(shlex.quote(a) for a in argv),
+                f"ulimit -v {kib} || exit 127; exec "
+                + " ".join(shlex.quote(a) for a in argv),
             ]
 
         # stderr spools to an unnamed temp file, not a PIPE: a chatty job

@@ -258,16 +258,7 @@ class IncrementalRound:
                 and not self._is_running[j]
                 and self._gang_ids[j]
             ):
-                key = (int(self._queue[j]), str(self._gang_ids[j]))
-                ent = self._gangs.get(key)
-                if ent is None:
-                    ent = {
-                        "card": int(self._gang_card[j]),
-                        "uniformity": str(self._gang_uni[j]),
-                        "members": set(),
-                    }
-                    self._gangs[key] = ent
-                ent["members"].add(j)
+                self._gang_add(j)
 
         # ---- affinity expressions -> group rows ----
         self._affinity_map: dict = {}
@@ -551,16 +542,7 @@ class IncrementalRound:
                 self._gang_card[r] = job.gang.cardinality
                 self._gang_uni[r] = job.gang.node_uniformity_label
                 if job.gang.cardinality > 1:
-                    key = (int(self._queue[r]), job.gang.id)
-                    ent = self._gangs.get(key)
-                    if ent is None:
-                        ent = {
-                            "card": job.gang.cardinality,
-                            "uniformity": job.gang.node_uniformity_label,
-                            "members": set(),
-                        }
-                        self._gangs[key] = ent
-                    ent["members"].add(r)
+                    self._gang_add(r)
             else:
                 self._gang_ids[r] = ""
                 self._gang_card[r] = 1
@@ -574,12 +556,23 @@ class IncrementalRound:
         np.add.at(self._queue_demand_pc_dev, (q_rows, seg_pc), req_dev)
         self._maybe_compact_key_groups()
 
+    @staticmethod
+    def _check_unique(ids):
+        """Reject duplicate ids within one delta batch BEFORE any mutation:
+        np.add.at would double-apply accounting silently otherwise."""
+        seen: set = set()
+        for i in ids:
+            if i in seen:
+                raise SnapshotRebuildRequired(f"duplicate id {i!r} in batch")
+            seen.add(i)
+
     def bind(self, leases: list[tuple]):
         """Queued -> running: (job_id, node_id, scheduled_at_priority,
         leased_ts) per lease — the service applies last round's
         JobRunLeased events here."""
         if not leases:
             return
+        self._check_unique([jid for jid, *_ in leases])
         self._touch()
         rows = np.asarray(
             [self._id_to_row[jid] for jid, *_ in leases], dtype=np.int64
@@ -618,6 +611,7 @@ class IncrementalRound:
         """Running -> queued (e.g. preempted and requeued)."""
         if not ids:
             return
+        self._check_unique(ids)
         self._touch()
         rows = np.asarray([self._id_to_row[i] for i in ids], dtype=np.int64)
         if not self._is_running[rows].all():
@@ -639,22 +633,15 @@ class IncrementalRound:
         for r in rows.tolist():
             self._key_group[r] = self._intern_key(r)
             if self._gang_card[r] > 1 and self._gang_ids[r]:
-                key = (int(self._queue[r]), str(self._gang_ids[r]))
-                ent = self._gangs.get(key)
-                if ent is None:
-                    ent = {
-                        "card": int(self._gang_card[r]),
-                        "uniformity": str(self._gang_uni[r]),
-                        "members": set(),
-                    }
-                    self._gangs[key] = ent
-                ent["members"].add(r)
+                self._gang_add(r)
+        self._maybe_compact_key_groups()
 
     def remove_jobs(self, ids: list[str]):
         """Terminal removals (succeeded / failed / cancelled), queued or
         running."""
         if not ids:
             return
+        self._check_unique(ids)
         self._touch()
         rows = np.asarray([self._id_to_row[i] for i in ids], dtype=np.int64)
         running = self._is_running[rows]
@@ -758,6 +745,19 @@ class IncrementalRound:
             if not ent["members"]:
                 del self._gangs[key]
 
+    def _gang_add(self, r: int):
+        """Register row r (a queued true-gang member) in the gang dict."""
+        key = (int(self._queue[r]), str(self._gang_ids[r]))
+        ent = self._gangs.get(key)
+        if ent is None:
+            ent = {
+                "card": int(self._gang_card[r]),
+                "uniformity": str(self._gang_uni[r]),
+                "members": set(),
+            }
+            self._gangs[key] = ent
+        ent["members"].add(r)
+
     def _job_order(self, J: int) -> np.ndarray:
         if self._market:
             pcp = self._pc_priority_table[self._pc_idx[:J]].astype(np.int64)
@@ -774,7 +774,15 @@ class IncrementalRound:
 
     def snapshot(self) -> RoundSnapshot:
         """Assemble a RoundSnapshot over the current state. Cached per
-        generation — repeated calls between deltas are free."""
+        generation — repeated calls between deltas are free.
+
+        LIFETIME CONTRACT: the returned snapshot shares (views of) the
+        live columnar arrays — that zero-copy sharing is the point of the
+        incremental design. It is valid until the next delta method call;
+        applying a delta mutates the shared arrays in place, so a consumer
+        that must outlive the cycle (e.g. an async reporter) must copy the
+        fields it keeps. `build_round_snapshot` semantics (fresh arrays
+        every call) do NOT hold here."""
         if self._snap_cache is not None and self._snap_cache[0] == self._gen:
             return self._snap_cache[1]
         import dataclasses

@@ -96,10 +96,31 @@ def enable_persistent_compile_cache(path: str | None = None):
         print(f"[platform] compile cache disabled: {e!r}")
 
 
+def enable_exact_costs():
+    """Enable x64 — the production solver configuration.
+
+    Every large DeviceRound tensor is explicitly int32/uint32, so x64 only
+    widens the Q-sized cost vectors (DRF costs, fair shares, budgets) to
+    float64 — measured free on CPU (0.196s vs 0.197s per 100k round) and
+    emulation-sized on TPU. In exchange the cost keys match the float64
+    oracle bit-for-bit: the whole x64 parity suite is the proof. Opt out
+    with ARMADA_TPU_X64=0 (float32 costs; placement parity then becomes
+    approximate — quantified by tools/float32_parity.py and docs/parity.md)."""
+    if os.environ.get("ARMADA_TPU_X64", "1") == "0":
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    except Exception as e:  # pragma: no cover - config failure must not kill
+        print(f"[platform] x64 enable failed: {e!r}", file=sys.stderr)
+
+
 def ensure_healthy_backend(probe_timeout: float = 120.0, retries: int = 1) -> str:
     """Returns the platform that will be used ("axon"/"tpu"/"cpu")."""
     global last_probe_report
     enable_persistent_compile_cache()
+    enable_exact_costs()
     want = os.environ.get("JAX_PLATFORMS", "")
     if want and "cpu" in want.split(","):
         _force_cpu()
