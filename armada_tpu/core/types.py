@@ -181,6 +181,10 @@ class JobSpec:
     id: str
     queue: str
     jobset: str = ""
+    # Pools this job may be scheduled in (job.Pools() in the reference);
+    # empty = eligible for every pool. A pool's round only considers
+    # queued jobs eligible for it (getQueuedJobs, scheduling_algo.go:533).
+    pools: tuple = ()
     priority: int = 0  # within-queue ordering: lower schedules first
     priority_class: str = ""
     requests: dict = field(default_factory=dict)
@@ -271,3 +275,10 @@ class RunningJob:
     # When the active run was leased (market anti-churn ordering:
     # longer-running jobs reschedule first, comparison.go:148-153).
     leased_ts: float = 0.0
+    # Cross-pool away job: its run belongs to a pool that borrows nodes
+    # from the round's pool (run.pool in awayAllocationPools,
+    # scheduling_algo.go:421-426,658-666). It accounts under the phantom
+    # "<queue>-away" fairness bucket (context/util.go CalculateAwayQueueName)
+    # and is an eviction candidate only when bound to one of this round's
+    # nodes; unbound away jobs contribute allocation pressure only.
+    away: bool = False

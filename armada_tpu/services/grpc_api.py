@@ -101,6 +101,7 @@ def job_spec_from_dict(d: dict) -> JobSpec:
         id=d.get("id", ""),
         queue=d.get("queue", ""),
         jobset=d.get("jobset", ""),
+        pools=tuple(d.get("pools", ())),
         priority=int(d.get("priority", 0)),
         priority_class=d.get("priority_class", ""),
         requests=dict(d.get("requests", {})),

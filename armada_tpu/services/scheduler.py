@@ -416,19 +416,34 @@ class SchedulerService:
             for hb in executors.values()
             for n in hb.nodes
         } | {hb.pool for hb in executors.values()}
+        # Configured pools with away pools run rounds even with no own
+        # nodes alive — all their work may ride borrowed capacity.
+        pools |= {p.name for p in self.config.pools if p.away_pools}
         pools = pools or {p.name for p in self.config.pools}
         sequences: list[EventSequence] = []
         leased_this_cycle: set[str] = set()
+        # Leases from earlier pools' rounds this cycle, visible to later
+        # rounds as if already in the jobdb (the reference writes each
+        # pool's results into the txn; pool node sets can now overlap via
+        # away pools, so id-exclusion alone would double-book nodes).
+        pending_leases: dict[str, tuple] = {}
         for pool in sorted(pools):
             pool_seqs = self._schedule_pool(
                 pool, now, exclude=leased_this_cycle,
                 executors=executors, cordoned=cordoned, overrides=overrides,
-                skipped=skipped,
+                skipped=skipped, pending_leases=pending_leases,
             )
             for seq in pool_seqs:
                 for event in seq.events:
                     if isinstance(event, JobRunLeased):
                         leased_this_cycle.add(event.job_id)
+                        pending_leases[event.job_id] = (
+                            event.node_id,
+                            event.pool,
+                            event.scheduled_at_priority,
+                            event.created,
+                            event.run_id,
+                        )
             sequences += pool_seqs
         return sequences
 
@@ -665,10 +680,21 @@ class SchedulerService:
         executors: dict | None = None,
         overrides: dict | None = None,
         skipped: set[str] | None = None,
+        pending_leases: dict | None = None,
     ):
         executors = executors if executors is not None else dict(self.executors)
         if skipped is None:
             skipped = self._skipped_executors(executors)
+        # Cross-pool borrowing (scheduling_algo.go:421-504): this round's
+        # node set is the pool's own nodes plus its configured away pools'
+        # nodes; pools that list US as an away pool contribute their
+        # running jobs as away candidates / allocation pressure.
+        pool_cfg = next((p for p in self.config.pools if p.name == pool), None)
+        away_node_pools = set(pool_cfg.away_pools) if pool_cfg else set()
+        allowed_pools = {pool} | away_node_pools
+        borrower_pools = {
+            p.name for p in self.config.pools if pool in p.away_pools
+        }
         nodes: list[NodeSpec] = []
         node_executor: dict[str, str] = {}
         for hb in executors.values():
@@ -677,28 +703,89 @@ class SchedulerService:
             for node in hb.nodes:
                 # Per-node pools (node_group.go GetPool): an executor's
                 # nodes may span pools; match each node, not the cluster.
-                if (node.pool or hb.pool) != pool:
+                if (node.pool or hb.pool) not in allowed_pools:
                     continue
                 nodes.append(node)
                 node_executor[node.id] = hb.name
 
+        from ..core.resources import parse_quantity
+
         txn = self.jobdb.read_txn()
         running: list[RunningJob] = []
+        # Jobs of unrelated pools running on this round's nodes: their
+        # resources become unallocatable on the node — scheduled around,
+        # never evicted (scheduling_algo.go:489-498 otherPoolsJobs).
+        # Floating resources are pool-level, never node capacity: they must
+        # not enter node unallocatable (they would drive the zeroed
+        # floating columns negative and fail every fit on the node).
+        blockers: dict[str, dict] = {}
+        floating_names = {fr.name for fr in self.config.floating_resources}
+
+        def classify(job, node_id, run_pool, prio, leased_ts):
+            if run_pool == pool or run_pool in borrower_pools:
+                running.append(
+                    RunningJob(
+                        job=job.spec.with_(priority=job.priority),
+                        node_id=node_id,
+                        scheduled_at_priority=prio,
+                        leased_ts=leased_ts,
+                        away=run_pool != pool,
+                    )
+                )
+            elif node_id in node_executor:
+                bucket = blockers.setdefault(node_id, {})
+                for name, qty in job.spec.requests.items():
+                    if name in floating_names:
+                        continue
+                    bucket[name] = bucket.get(name, 0) + parse_quantity(qty)
+
+        pending_leases = pending_leases or {}
         for job in txn.leased_jobs():
             run = job.latest_run
-            if run is None or run.pool != pool:
+            if run is None or job.id in pending_leases:
                 continue
-            running.append(
-                RunningJob(
-                    job=job.spec.with_(priority=job.priority),
-                    node_id=run.node_id,
-                    scheduled_at_priority=run.scheduled_at_priority,
-                    leased_ts=run.leased,
+            classify(job, run.node_id, run.pool, run.scheduled_at_priority,
+                     run.leased)
+        # Leases from earlier pools' rounds this cycle (not yet in the
+        # jobdb): bind them exactly like jobdb runs so overlapping node
+        # sets never double-book.
+        for jid, (node_id, run_pool, prio, leased_ts, _rid) in pending_leases.items():
+            job = txn.get(jid)
+            if job is not None:
+                classify(job, node_id, run_pool, prio, leased_ts)
+        if blockers:
+            import dataclasses as _dc
+
+            from ..core.priorities import priority_levels
+
+            top = int(priority_levels(self.config.priority_classes)[-1])
+            patched = []
+            for node in nodes:
+                extra = blockers.get(node.id)
+                if not extra:
+                    patched.append(node)
+                    continue
+                unalloc = {
+                    k: dict(v)
+                    for k, v in (node.unallocatable_by_priority or {}).items()
+                }
+                at_top = unalloc.setdefault(top, {})
+                for name, qty in extra.items():
+                    at_top[name] = parse_quantity(at_top.get(name, 0)) + qty
+                patched.append(
+                    _dc.replace(node, unallocatable_by_priority=unalloc)
                 )
-            )
+            nodes = patched
         # Unsorted: the snapshot builder re-derives fair-share order
         # vectorized (np.lexsort), so the O(k log k) Python sort is skipped.
-        queued_jobs = [j for j in txn.queued_jobs(sort=False) if j.id not in exclude]
+        queued_jobs = [
+            j
+            for j in txn.queued_jobs(sort=False)
+            if j.id not in exclude
+            # Pool eligibility (getQueuedJobs, scheduling_algo.go:533):
+            # empty pools = eligible everywhere.
+            and (not j.spec.pools or pool in j.spec.pools)
+        ]
         queued = [j.spec.with_(priority=j.priority) for j in queued_jobs]
         # Retry anti-affinity: nodes where earlier attempts failed
         # (scheduler.go:589-636).
@@ -749,6 +836,7 @@ class SchedulerService:
         cordoned: set | None = None,
         overrides: dict | None = None,
         skipped: set[str] | None = None,
+        pending_leases: dict | None = None,
     ) -> list[EventSequence]:
         (
             nodes,
@@ -758,7 +846,9 @@ class SchedulerService:
             node_executor,
             txn,
             excluded_nodes,
-        ) = self._build_pool_inputs(pool, exclude, executors, overrides, skipped)
+        ) = self._build_pool_inputs(
+            pool, exclude, executors, overrides, skipped, pending_leases
+        )
         if not nodes or not (queued or running):
             return []
         limits = self.config.rate_limits
@@ -919,10 +1009,16 @@ class SchedulerService:
         for j in np.flatnonzero(result["preempted_mask"]):
             job = txn.get(snap.job_ids[j])
             run = job.latest_run
+            run_id = run.id if run else ""
+            if not run_id and pending_leases and job.id in pending_leases:
+                # Preempting a lease granted by an earlier pool's round in
+                # this same cycle (cross-pool away eviction): the run isn't
+                # in the jobdb yet — the pending lease carries its id.
+                run_id = pending_leases[job.id][4]
             event = JobRunPreempted(
                 created=now,
                 job_id=job.id,
-                run_id=run.id if run else "",
+                run_id=run_id,
                 reason="preempted by scheduler round",
             )
             by_jobset.setdefault((job.queue, job.jobset), []).append(event)

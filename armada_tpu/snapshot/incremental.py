@@ -178,6 +178,7 @@ class IncrementalRound:
         self._priority = _grown(snap.job_priority.astype(np.int32), cap, 0)
         self._preemptible = _grown(snap.job_preemptible, cap, False)
         self._is_running = _grown(snap.job_is_running, cap, False)
+        self._away = _grown(snap.job_away, cap, False)
         self._node = _grown(snap.job_node.astype(np.int32), cap, NO_NODE)
         self._excluded = _grown(snap.job_excluded_nodes, cap, -1)
         self._affinity_group = _grown(snap.job_affinity_group, cap, -1)
@@ -384,6 +385,7 @@ class IncrementalRound:
             ("_priority", 0),
             ("_preemptible", False),
             ("_is_running", False),
+            ("_away", False),
             ("_node", NO_NODE),
             ("_excluded", -1),
             ("_affinity_group", -1),
@@ -612,10 +614,15 @@ class IncrementalRound:
         if not ids:
             return
         self._check_unique(ids)
-        self._touch()
         rows = np.asarray([self._id_to_row[i] for i in ids], dtype=np.int64)
         if not self._is_running[rows].all():
             raise SnapshotRebuildRequired("unbind of a non-running job")
+        if self._away[rows].any():
+            # A requeued cross-pool away job returns to its HOME pool's
+            # queue — it cannot become a queued candidate in this (the
+            # borrowing) pool's phantom bucket. Rebuild from the jobdb.
+            raise SnapshotRebuildRequired("unbind of a cross-pool away job")
+        self._touch()
         if self._market and np.isnan(self._bid_queued[rows]).any():
             raise SnapshotRebuildRequired(
                 "market unbind of a job whose queued-phase bid is unknown"
@@ -668,6 +675,7 @@ class IncrementalRound:
         self._alive[rows] = False
         self._queue[rows] = -1
         self._is_running[rows] = False
+        self._away[rows] = False
         self._node[rows] = NO_NODE
         self._possible[rows] = False
         self._key_group[rows] = -1
@@ -851,6 +859,7 @@ class IncrementalRound:
             job_priority=self._priority[:J],
             job_preemptible=self._preemptible[:J],
             job_is_running=self._is_running[:J],
+            job_away=self._away[:J],
             job_node=self._node[:J],
             job_order=job_order,
             job_excluded_nodes=self._excluded[:J],

@@ -80,6 +80,9 @@ class RoundSnapshot:
     job_priority: np.ndarray  # int32[J]: scheduled-at (running) or PC priority
     job_preemptible: np.ndarray  # bool[J]
     job_is_running: np.ndarray  # bool[J]
+    # Cross-pool away job (accounts under its "<queue>-away" phantom row;
+    # eviction candidate only when bound to a node of this round).
+    job_away: np.ndarray  # bool[J]
     job_node: np.ndarray  # int32[J]: bound node (running) or NO_NODE
     job_order: np.ndarray  # int64[J]: within-queue order rank (lower first)
     # Nodes previous attempts failed on (retry anti-affinity,
@@ -205,7 +208,16 @@ def build_round_snapshot(
     priorities = np.asarray(priority_levels(config.priority_classes), dtype=np.int32)
     P = len(priorities)
 
-    nodes = [n for n in nodes if n.pool == pool]
+    # Cross-pool borrowing: the round's node set is the pool's own nodes
+    # plus the nodes of its configured away pools
+    # (scheduling_algo.go:501-504 nodePools = awayPoolNames + currentPool).
+    away_node_pools: set = set()
+    for pc in config.pools:
+        if pc.name == pool:
+            away_node_pools = set(pc.away_pools)
+            break
+    allowed_pools = {pool} | away_node_pools
+    nodes = [n for n in nodes if n.pool in allowed_pools]
     node_index = {n.id: i for i, n in enumerate(nodes)}
     N = len(nodes)
 
@@ -277,7 +289,22 @@ def build_round_snapshot(
     job_node = np.full(J, NO_NODE, dtype=np.int32)
 
     queue_index = {q.name: i for i, q in enumerate(queues)}
-    Q = len(queues)
+    # Phantom away-queue fairness buckets (CalculateAwayQueueName,
+    # context/util.go:5): every away job accounts under "<queue>-away" with
+    # the home queue's weight, zero demand, and no rate limiter — the
+    # borrower's footprint prices into this pool's fairness without
+    # becoming home demand (scheduling_algo.go:757-779).
+    ext_names = [q.name for q in queues]
+    ext_weights = [q.weight for q in queues]
+    away_rows: dict[str, int] = {}
+    for r in running:
+        if r.away and r.job.queue not in away_rows:
+            home = queue_index.get(r.job.queue)
+            away_rows[r.job.queue] = len(ext_names)
+            ext_names.append(f"{r.job.queue}-away")
+            ext_weights.append(ext_weights[home] if home is not None else 1.0)
+    Q = len(ext_names)
+    job_away = np.zeros(J, dtype=bool)
 
     # Vectorized fast paths: the common case (no taints, no selectors) skips
     # per-job bitset work entirely; priority-class attributes resolve via a
@@ -322,6 +349,9 @@ def build_round_snapshot(
         job_is_running[j] = True
         job_node[j] = node_index.get(run.node_id, NO_NODE)
         job_priority[j] = run.scheduled_at_priority
+        if run.away:
+            job_away[j] = True
+            job_queue[j] = away_rows[run.job.queue]
 
     # Within-queue order: (job priority number asc, submitted ts asc, id asc),
     # the jobdb FairShareOrder (jobdb/jobdb.go:27-31). Encoded as a dense rank
@@ -419,15 +449,19 @@ def build_round_snapshot(
             allocatable[rows, n, :] -= req_fit[j]
 
     # --- queue accounting (segment sums) ---
-    queue_weight = np.asarray([q.weight for q in queues], dtype=np.float64)
+    queue_weight = np.asarray(ext_weights, dtype=np.float64)
     queue_allocated = np.zeros((Q, R), dtype=np.int64)
     queue_demand = np.zeros((Q, R), dtype=np.int64)
     if J and Q:
         valid_q = job_queue >= 0
         qidx = np.where(valid_q, job_queue, 0)
+        # Away jobs carry allocation (under their phantom row) but no
+        # demand: the reference registers away queue contexts with an
+        # empty demand ResourceList (scheduling_algo.go:776).
+        demand_w = valid_q & ~job_away
         for r in range(R):
             queue_demand[:, r] = np.bincount(
-                qidx, weights=np.where(valid_q, job_req[:, r], 0), minlength=Q
+                qidx, weights=np.where(demand_w, job_req[:, r], 0), minlength=Q
             )[:Q]
             queue_allocated[:, r] = np.bincount(
                 qidx,
@@ -567,13 +601,13 @@ def build_round_snapshot(
         node_unschedulable=node_unschedulable,
         order_res_idx=order_res_idx,
         order_res_resolution=order_res_resolution,
-        queue_names=[q.name for q in queues],
+        queue_names=ext_names,
         queue_weight=queue_weight,
         queue_cordoned=np.asarray(
-            [q.name in (cordoned_queues or set()) for q in queues], dtype=bool
+            [name in (cordoned_queues or set()) for name in ext_names], dtype=bool
         ),
         queue_short_penalty=factory.encode_requests_batch(
-            [(short_job_penalty or {}).get(q.name, {}) for q in queues],
+            [(short_job_penalty or {}).get(name, {}) for name in ext_names],
             ceil=True,
         ),
         queue_allocated=queue_allocated,
@@ -587,6 +621,7 @@ def build_round_snapshot(
         job_priority=job_priority,
         job_preemptible=job_preemptible,
         job_is_running=job_is_running,
+        job_away=job_away,
         job_node=job_node,
         job_order=job_order,
         job_excluded_nodes=job_excluded_nodes,

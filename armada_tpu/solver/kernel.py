@@ -429,11 +429,13 @@ def _constraint_code(dev, carry, s, all_ev):
     )
     blocked_code = jnp.where(all_ev, OK, blocked_code)
     # Floating-resource pool caps apply to every gang, evicted included
-    # (IsWithinFloatingResourceLimits, gang_scheduler.go:144).
+    # (IsWithinFloatingResourceLimits, gang_scheduler.go:144) — EXCEPT
+    # cross-pool away gangs, whose limits were checked by their home
+    # pool's round (context/scheduling.go:546-557).
     floating_over = jnp.any(
         dev.floating_mask
         & (carry.floating + _f(dev.slot_req[s]) > dev.floating_total)
-    )
+    ) & ~dev.slot_away[s]
     return jnp.where((blocked_code == OK) & floating_over, FAIL, blocked_code)
 
 

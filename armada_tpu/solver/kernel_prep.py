@@ -107,6 +107,10 @@ class DeviceRound:
     slot_uni_start: np.ndarray  # int32[S]
     slot_uni_end: np.ndarray  # int32[S]
     slot_price: np.ndarray  # float[S] market gang price (min member bid)
+    # Cross-pool away slot: members are away jobs (floating-resource
+    # limits were checked by their home pool's round; skip here —
+    # context/scheduling.go:546-557).
+    slot_away: np.ndarray  # bool[S]
     uni_value_bits: np.ndarray  # uint32[V, Wl]
     queue_slot_start: np.ndarray  # int32[Q]
     queue_slot_end: np.ndarray  # int32[Q]
@@ -241,6 +245,7 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         slot_uni_start=pad(dev.slot_uni_start, 0, Sp),
         slot_uni_end=pad(dev.slot_uni_end, 0, Sp),
         slot_price=pad(dev.slot_price, 0, Sp),
+        slot_away=pad(dev.slot_away, 0, Sp, fill=False),
         job_bid=pad(dev.job_bid, 0, Jp),
         queue_slot_start=pad(dev.queue_slot_start, 0, Qp),
         queue_slot_end=pad(dev.queue_slot_end, 0, Qp),
@@ -420,7 +425,14 @@ def prep_device_round(
     # Built columnar: the overwhelming bulk (singleton candidates) is pure
     # array work; only multi-member gangs take per-gang Python paths, so a
     # 1M-singleton round preps in vectorized time.
-    rj = np.flatnonzero(snap.job_is_running & (snap.job_queue >= 0))
+    rj = np.flatnonzero(
+        snap.job_is_running
+        & (snap.job_queue >= 0)
+        # Unbound away jobs (runs on nodes outside this round) contribute
+        # fairness pressure only — never candidacy (populateNodeDb skips
+        # them, scheduling_algo.go:936-938).
+        & ~(snap.job_away & (snap.job_node < 0))
+    )
     r_gids = (
         np.asarray(snap.job_gang_id, dtype=object)[rj]
         if len(rj)
@@ -582,6 +594,7 @@ def prep_device_round(
     slot_uni_start = np.zeros(S, dtype=np.int32)
     slot_uni_end = np.zeros(S, dtype=np.int32)
     slot_price = np.zeros(S, dtype=np.float64)
+    slot_away = np.zeros(S, dtype=bool)
     queue_slot_start = np.zeros(Q, dtype=np.int32)
     queue_slot_end = np.zeros(Q, dtype=np.int32)
 
@@ -604,6 +617,9 @@ def prep_device_round(
             req_dev[flat].astype(np.int64), starts
         ).astype(np.int32)
         slot_price[:n_cand] = np.minimum.reduceat(snap.job_bid[flat], starts)
+        slot_away[:n_cand] = snap.job_away[
+            np.clip(slot_members[:n_cand, 0], 0, max(J - 1, 0))
+        ]
 
         # Uniformity ranges: only multi-member queued gangs carry one.
         if n_qg:
@@ -650,6 +666,7 @@ def prep_device_round(
                 slot_uni_start = _shrink(slot_uni_start, kept, S)
                 slot_uni_end = _shrink(slot_uni_end, kept, S)
                 slot_price = _shrink(slot_price, kept, S)
+                slot_away = _shrink(slot_away, kept, S)
                 queue_slot_start[:] = np.searchsorted(sq, np.arange(Q), side="left")
                 queue_slot_end[:] = np.searchsorted(sq, np.arange(Q), side="right")
 
@@ -768,6 +785,7 @@ def prep_device_round(
         slot_uni_start=slot_uni_start,
         slot_uni_end=slot_uni_end,
         slot_price=slot_price,
+        slot_away=slot_away,
         uni_value_bits=(
             np.stack(uni_bits_rows)
             if uni_bits_rows

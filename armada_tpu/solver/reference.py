@@ -876,8 +876,10 @@ class ReferenceSolver:
                     return self._fail(members, R_QUEUE_LIMIT)
 
         # Floating-resource pool caps (IsWithinFloatingResourceLimits,
-        # gang_scheduler.go:144; applies to evicted gangs too).
-        if snap.floating_mask.any():
+        # gang_scheduler.go:144; applies to evicted gangs too) — except
+        # cross-pool away gangs, whose limits were checked by their home
+        # pool's round (context/scheduling.go:546-557).
+        if snap.floating_mask.any() and not snap.job_away[members[0]]:
             gang_req = snap.job_req[members].sum(axis=0)
             over = snap.floating_mask & (
                 self.pool_floating + gang_req > snap.floating_total

@@ -130,3 +130,285 @@ def test_away_disabled_without_well_known_taints():
     queued = [cpu_job(0, cpu="12")]  # only fits gpu nodes
     snap, res = both(cfg, nodes(n_cpu=1, n_gpu=1), [QueueSpec("q")], [], queued)
     assert res.scheduled_mask.sum() == 0  # no away capability granted
+
+
+# ---------------------------------------------------------------------------
+# Cross-pool away nodes (scheduling_algo.go:421-504, nodedb.go:506-595):
+# pool "cpu-pool" borrows "gpu-pool" nodes; borrowed jobs account under the
+# phantom "<queue>-away" bucket in gpu-pool's round and evict before home
+# queues suffer.
+# ---------------------------------------------------------------------------
+
+from armada_tpu.core.config import PoolConfig  # noqa: E402
+from armada_tpu.core.types import RunningJob as _RJ  # noqa: E402
+
+CROSS_CFG = SchedulingConfig(
+    priority_classes={
+        "gpu-native": PriorityClass("gpu-native", 30000, preemptible=False),
+        "cpu": PriorityClass(
+            "cpu",
+            10000,
+            preemptible=True,
+            away_node_types=(
+                AwayNodeType(priority=500, well_known_node_type="gpu-node"),
+            ),
+        ),
+    },
+    default_priority_class="cpu",
+    well_known_node_types={"gpu-node": (Taint("gpu", "true", "NoSchedule"),)},
+    pools=(
+        PoolConfig(name="cpu-pool", away_pools=("gpu-pool",)),
+        PoolConfig(name="gpu-pool"),
+    ),
+)
+
+
+def cross_nodes(n_cpu=1, n_gpu=2):
+    out = [
+        NodeSpec(id=f"cpu-{i}", pool="cpu-pool",
+                 total_resources={"cpu": "8", "memory": "32Gi"})
+        for i in range(n_cpu)
+    ]
+    out += [
+        NodeSpec(id=f"gpu-{i}", pool="gpu-pool",
+                 taints=(Taint("gpu", "true", "NoSchedule"),),
+                 total_resources={"cpu": "16", "memory": "64Gi"})
+        for i in range(n_gpu)
+    ]
+    return out
+
+
+def cross_both(pool, ns, queues, running, queued):
+    snap = build_round_snapshot(CROSS_CFG, pool, ns, queues, running, queued)
+    oracle = ReferenceSolver(snap).solve()
+    out = solve_round(pad_device_round(prep_device_round(snap)))
+    J = snap.num_jobs
+    assert (oracle.assigned_node == out["assigned_node"][:J]).all()
+    assert (oracle.scheduled_mask == out["scheduled_mask"][:J]).all()
+    assert (oracle.preempted_mask == out["preempted_mask"][:J]).all()
+    assert (oracle.scheduled_priority == out["scheduled_priority"][:J]).all()
+    return snap, oracle
+
+
+def test_cross_pool_borrowing():
+    """cpu-pool's round includes gpu-pool's nodes; overflow cpu jobs land
+    on them at the away priority."""
+    queued = [
+        JobSpec(id=f"c{i}", queue="q", priority_class="cpu",
+                requests={"cpu": "4", "memory": "1Gi"}, submitted_ts=float(i))
+        for i in range(4)
+    ]
+    snap, res = cross_both("cpu-pool", cross_nodes(), [QueueSpec("q")], [], queued)
+    assert set(snap.node_ids) == {"cpu-0", "gpu-0", "gpu-1"}
+    assert res.scheduled_mask.sum() == 4
+    away = [
+        j for j in range(4)
+        if snap.node_ids[res.assigned_node[j]].startswith("gpu-")
+    ]
+    assert len(away) == 2
+    for j in away:
+        assert res.scheduled_priority[j] == 500
+
+
+def test_cross_pool_away_bucket_and_eviction():
+    """gpu-pool's round sees borrowed cpu jobs under 'q-away' (weight of
+    home queue, zero demand) and evicts them for native work."""
+    running = [
+        _RJ(
+            job=JobSpec(id=f"away{i}", queue="q", priority_class="cpu",
+                        requests={"cpu": "12", "memory": "1Gi"},
+                        tolerations=(Toleration(key="gpu", value="true"),)),
+            node_id=f"gpu-{i}",
+            scheduled_at_priority=500,
+            away=True,
+        )
+        for i in range(2)
+    ]
+    native = [
+        JobSpec(id=f"n{i}", queue="gq", priority_class="gpu-native",
+                requests={"cpu": "12", "memory": "1Gi"},
+                tolerations=(Toleration(key="gpu", value="true"),),
+                submitted_ts=10.0 + i)
+        for i in range(2)
+    ]
+    ns = cross_nodes(n_cpu=0, n_gpu=2)
+    snap, res = cross_both(
+        "gpu-pool", ns, [QueueSpec("q"), QueueSpec("gq")], running, native
+    )
+    # Phantom fairness bucket exists with the home queue's weight and no
+    # demand; the away allocation sits under it.
+    assert "q-away" in snap.queue_names
+    a_row = snap.queue_names.index("q-away")
+    q_row = snap.queue_names.index("q")
+    assert snap.queue_weight[a_row] == snap.queue_weight[q_row]
+    assert (snap.queue_demand[a_row] == 0).all()
+    assert snap.queue_allocated[a_row][0] > 0  # cpu of the borrowed jobs
+    for i in range(2):
+        j = list(snap.job_ids).index(f"away{i}")
+        assert snap.job_queue[j] == a_row
+        assert res.preempted_mask[j]
+    for i in range(2):
+        j = list(snap.job_ids).index(f"n{i}")
+        assert res.scheduled_mask[j]
+
+
+def test_cross_pool_unbound_away_pressure_only():
+    """Away jobs on nodes outside this round contribute allocation under
+    the phantom bucket but are never candidates (never preempted)."""
+    running = [
+        _RJ(
+            job=JobSpec(id="faraway", queue="q", priority_class="cpu",
+                        requests={"cpu": "12", "memory": "1Gi"}),
+            node_id="not-a-node-here",
+            scheduled_at_priority=500,
+            away=True,
+        )
+    ]
+    native = [
+        JobSpec(id="n0", queue="gq", priority_class="gpu-native",
+                requests={"cpu": "12", "memory": "1Gi"},
+                tolerations=(Toleration(key="gpu", value="true"),),
+                submitted_ts=10.0)
+    ]
+    ns = cross_nodes(n_cpu=0, n_gpu=1)
+    snap, res = cross_both(
+        "gpu-pool", ns, [QueueSpec("q"), QueueSpec("gq")], running, native
+    )
+    j = list(snap.job_ids).index("faraway")
+    a_row = snap.queue_names.index("q-away")
+    assert snap.job_queue[j] == a_row
+    assert not res.preempted_mask[j]
+    assert snap.queue_allocated[a_row][0] > 0
+    assert res.scheduled_mask[list(snap.job_ids).index("n0")]
+
+
+def test_cross_pool_service_end_to_end():
+    """Full control plane: cpu jobs spill onto the gpu executor via
+    cpu-pool's round (run.pool == cpu-pool); native gpu work then preempts
+    the borrowers in gpu-pool's round; pool-restricted queued jobs only
+    appear in their pools' rounds."""
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.jobdb import JobState
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    log = InMemoryEventLog()
+    sched = SchedulerService(CROSS_CFG, log)
+    submit = SubmitService(CROSS_CFG, log, scheduler=sched)
+    cpu_exec = FakeExecutor(
+        "cpu-cluster", log, sched,
+        nodes=make_nodes("cpu-cluster", count=1, cpu="8", memory="32Gi",
+                         pool="cpu-pool"),
+        pool="cpu-pool",
+    )
+    gpu_exec = FakeExecutor(
+        "gpu-cluster", log, sched,
+        nodes=make_nodes("gpu-cluster", count=1, cpu="16", memory="64Gi",
+                         pool="gpu-pool",
+                         taints=(Taint("gpu", "true", "NoSchedule"),)),
+        pool="gpu-pool",
+    )
+    submit.create_queue(QueueSpec("q"))
+    # 4x4cpu cpu-pool jobs: 2 fit the cpu node, 2 borrow the gpu node.
+    submit.submit(
+        "q", "s",
+        [
+            JobSpec(id=f"c{i}", queue="q", priority_class="cpu",
+                    pools=("cpu-pool",),
+                    requests={"cpu": "4", "memory": "1Gi"})
+            for i in range(4)
+        ],
+        now=0.0,
+    )
+    cpu_exec.tick(0.0)
+    gpu_exec.tick(0.0)
+    sched.cycle(now=1.0)
+    txn = sched.jobdb.read_txn()
+    borrowed = [
+        jid for jid in ("c0", "c1", "c2", "c3")
+        if txn.get(jid).latest_run.executor == "gpu-cluster"
+    ]
+    assert len(borrowed) == 2
+    for jid in borrowed:
+        run = txn.get(jid).latest_run
+        assert run.pool == "cpu-pool"  # run pool = scheduling round's pool
+        assert run.scheduled_at_priority == 500
+    # Native gpu work arrives: borrowers get preempted in gpu-pool's round.
+    submit.submit(
+        "q", "s",
+        [
+            JobSpec(id=f"g{i}", queue="q", priority_class="gpu-native",
+                    pools=("gpu-pool",),
+                    tolerations=(Toleration(key="gpu", value="true"),),
+                    requests={"cpu": "8", "memory": "1Gi"})
+            for i in range(2)
+        ],
+        now=2.0,
+    )
+    cpu_exec.tick(2.0)
+    gpu_exec.tick(2.0)
+    sched.cycle(now=3.0)
+    txn = sched.jobdb.read_txn()
+    assert all(
+        txn.get(f"g{i}").latest_run is not None
+        and txn.get(f"g{i}").latest_run.executor == "gpu-cluster"
+        for i in range(2)
+    )
+    preempted = [jid for jid in borrowed if txn.get(jid).state == JobState.PREEMPTED
+                 or txn.get(jid).state == JobState.QUEUED]
+    assert len(preempted) == 2
+
+
+def test_cross_pool_no_same_cycle_double_booking():
+    """Within one cycle, a node leased by an earlier pool's round must not
+    be double-booked by a later round (pool rounds share nodes via away
+    pools; earlier rounds' leases bind as pending runs)."""
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    log = InMemoryEventLog()
+    sched = SchedulerService(CROSS_CFG, log)
+    submit = SubmitService(CROSS_CFG, log, scheduler=sched)
+    # No cpu nodes at all: every cpu job must borrow the single gpu node.
+    gpu_exec = FakeExecutor(
+        "gpu-cluster", log, sched,
+        nodes=make_nodes("gpu-cluster", count=1, cpu="16", memory="64Gi",
+                         pool="gpu-pool",
+                         taints=(Taint("gpu", "true", "NoSchedule"),)),
+        pool="gpu-pool",
+    )
+    submit.create_queue(QueueSpec("q"))
+    # cpu-pool round (sorted first) borrows 12 of 16 cpus; the gpu-pool
+    # round in the SAME cycle must see only 4 left for its native job.
+    submit.submit(
+        "q", "s",
+        [
+            JobSpec(id=f"c{i}", queue="q", priority_class="cpu",
+                    pools=("cpu-pool",),
+                    requests={"cpu": "6", "memory": "1Gi"})
+            for i in range(2)
+        ]
+        + [
+            JobSpec(id="g0", queue="q", priority_class="gpu-native",
+                    pools=("gpu-pool",),
+                    tolerations=(Toleration(key="gpu", value="true"),),
+                    requests={"cpu": "6", "memory": "1Gi"})
+        ],
+        now=0.0,
+    )
+    gpu_exec.tick(0.0)
+    sched.cycle(now=1.0)
+    txn = sched.jobdb.read_txn()
+    leased = [j for j in ("c0", "c1", "g0") if txn.get(j).latest_run is not None]
+    total_cpu = sum(6 for _ in leased)
+    # 16-cpu node: at most 2 of the three 6-cpu jobs fit concurrently —
+    # never 18/16. (Preemption may bump a borrower in the gpu round, but
+    # the set of live leases must fit.)
+    live = [
+        j for j in leased
+        if txn.get(j).state.name in ("LEASED", "PENDING", "RUNNING")
+    ]
+    assert sum(6 for _ in live) <= 16, f"double-booked: {live}"

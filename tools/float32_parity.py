@@ -24,11 +24,21 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient sitecustomize may have registered the axon
+# tunnel plugin AND set jax_platforms=axon,cpu at interpreter start —
+# env vars alone cannot undo that; _force_cpu deregisters the factories
+# and pins the config. (Deliberately NOT ensure_healthy_backend: that
+# enables x64, and this tool measures the x64-OFF fallback.)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PYTHONPATH", None)
 
 import numpy as np  # noqa: E402
 
 import jax  # noqa: E402
+
+from armada_tpu.utils.platform import _force_cpu  # noqa: E402
+
+_force_cpu()
 
 assert not jax.config.jax_enable_x64, "run without conftest (x64 must be off)"
 
