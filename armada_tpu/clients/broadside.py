@@ -40,7 +40,7 @@ from .load_tester import percentile
 class BroadsideConfig:
     """configuration.Configuration, reduced to the knobs that matter."""
 
-    backend: str = "inproc"  # inproc | grpc
+    backend: str = "inproc"  # inproc | grpc | sqlite
     server: str = "127.0.0.1:50051"
     duration_s: float = 10.0
     warmup_s: float = 0.0
@@ -115,11 +115,10 @@ class InprocBackend:
 
     def __init__(self):
         from ..events import InMemoryEventLog
-        from ..services.lookout_ingester import LookoutStore
         from ..services.queryapi import QueryApi
 
         self.log = InMemoryEventLog()
-        self.store = LookoutStore(self.log)
+        self.store = self._make_store()
         self.query = QueryApi(lookout=self.store)
         self._stop = threading.Event()
         self._pump = threading.Thread(target=self._pump_loop, daemon=True)
@@ -127,6 +126,11 @@ class InprocBackend:
         self._seq = 0
         self._seq_lock = threading.Lock()
         self.recent_ids: list[str] = []
+
+    def _make_store(self):
+        from ..services.lookout_ingester import LookoutStore
+
+        return LookoutStore(self.log)
 
     def _pump_loop(self):
         while not self._stop.is_set():
@@ -242,6 +246,29 @@ class InprocBackend:
         self._pump.join(timeout=2)
 
 
+class SqliteBackend(InprocBackend):
+    """The persistent lookout store under the same pipeline: event log ->
+    SqliteLookoutStore (WAL file) -> QueryApi. Compares disk-backed
+    materialization + query latency against the in-proc dict store — the
+    reference Broadside's reason to exist is exactly this backend matrix
+    (internal/broadside/orchestrator/doc.go)."""
+
+    name = "sqlite"
+
+    def _make_store(self):
+        import tempfile
+
+        from ..services.lookout_sqlite import SqliteLookoutStore
+
+        self._tmp = tempfile.TemporaryDirectory(prefix="broadside-sqlite-")
+        return SqliteLookoutStore(self.log, f"{self._tmp.name}/lookout.db")
+
+    def teardown(self):
+        super().teardown()
+        self.store.close()
+        self._tmp.cleanup()
+
+
 class GrpcBackend:
     """The same actor mix against a live control plane's gRPC surface."""
 
@@ -309,9 +336,12 @@ class Runner:
 
     def __init__(self, cfg: BroadsideConfig, backend=None):
         self.cfg = cfg
-        self.backend = backend or (
-            GrpcBackend(cfg.server) if cfg.backend == "grpc" else InprocBackend()
-        )
+        if backend is None:
+            backend = {
+                "grpc": lambda: GrpcBackend(cfg.server),
+                "sqlite": SqliteBackend,
+            }.get(cfg.backend, InprocBackend)()
+        self.backend = backend
         self.stats = {
             name: OpStats(name)
             for name in ("ingest", "get_jobs", "group_jobs", "job_details")
@@ -393,6 +423,12 @@ class Runner:
                 self.backend.submit_batch(self._queue(batch_i), "bs-seed", n, cfg)
                 seeded += n
                 batch_i += 1
+            # Measure steady state, not catch-up: wait for the view to
+            # drain the seed backlog before the clock starts (the
+            # reference's warmup exists for exactly this).
+            deadline = time.time() + 600
+            while self.backend.lag_events() > 0 and time.time() < deadline:
+                time.sleep(0.05)
         threads = [
             threading.Thread(target=self._ingest_actor, args=(i,), daemon=True)
             for i in range(cfg.ingest_actors)
@@ -450,7 +486,9 @@ class Runner:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="armada-tpu-broadside")
-    ap.add_argument("--backend", choices=("inproc", "grpc"), default="inproc")
+    ap.add_argument(
+        "--backend", choices=("inproc", "grpc", "sqlite"), default="inproc"
+    )
     ap.add_argument("--server", default="127.0.0.1:50051")
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--warmup", type=float, default=0.0)

@@ -24,7 +24,7 @@ def _print(obj):
 
 
 def cmd_queue(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     cordoned = True if args.cordon else (False if args.uncordon else None)
     if args.action == "create":
         client.create_queue(
@@ -90,7 +90,7 @@ def _jobs_from_yaml(path: str) -> tuple[str, str, list[dict]]:
 
 
 def cmd_submit(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     queue, jobset, jobs = _jobs_from_yaml(args.file)
     queue = args.queue or queue
     jobset = args.jobset or jobset
@@ -100,7 +100,7 @@ def cmd_submit(args):
 
 
 def cmd_cancel(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     client.cancel_jobs(
         args.queue,
         args.jobset,
@@ -111,19 +111,19 @@ def cmd_cancel(args):
 
 
 def cmd_reprioritize(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     client.reprioritize_jobs(args.queue, args.jobset, [args.job_id], args.priority)
     print("reprioritized")
 
 
 def cmd_watch(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     for event in client.watch_jobset(args.queue, args.jobset, watch=not args.no_follow):
         print(json.dumps(event, default=str))
 
 
 def cmd_jobs(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     filters = []
     if args.queue:
         filters.append({"field": "queue", "value": args.queue})
@@ -133,25 +133,25 @@ def cmd_jobs(args):
 
 
 def cmd_logs(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     for line in client.get_job_logs(args.job_id, args.tail):
         print(line)
 
 
 def cmd_cordon(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     client.cordon_node(args.node_id, uncordon=args.action == "uncordon")
     print(f"{args.action}ed {args.node_id}")
 
 
 def cmd_cordon_executor(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     client.cordon_executor(args.name, uncordon=args.action == "uncordon")
     print(f"{args.action}ed executor {args.name}")
 
 
 def cmd_report(args):
-    client = connect(args.server)
+    client = connect(args.server, ca_cert=args.ca_cert or None)
     if args.kind == "scheduling":
         print(client.scheduling_report())
     elif args.kind == "queue":
@@ -180,6 +180,11 @@ def cmd_server(args):
                 "cpu": parts[2] if len(parts) > 2 else "8",
             }
         )
+    tls = None
+    if args.tls_cert or args.tls_key:
+        if not (args.tls_cert and args.tls_key):
+            raise SystemExit("--tls-cert and --tls-key must be given together")
+        tls = (args.tls_cert, args.tls_key)
     plane = ControlPlane(
         config,
         backend=args.backend,
@@ -189,6 +194,7 @@ def cmd_server(args):
         fake_executors=fakes,
         cycle_period=args.cycle_period,
         data_dir=args.data_dir,
+        tls=tls,
     ).start()
     extras = []
     if args.metrics_port:
@@ -212,6 +218,11 @@ def build_parser():
         "--server",
         default=os.environ.get("ARMADA_SERVER", "127.0.0.1:50051"),
         help="gRPC server address",
+    )
+    p.add_argument(
+        "--ca-cert",
+        default=os.environ.get("ARMADA_CA_CERT", ""),
+        help="CA bundle: connect with TLS and verify the server against it",
     )
     sub = p.add_subparsers(dest="command", required=True)
 
@@ -284,6 +295,8 @@ def build_parser():
     srv.add_argument("--config")
     srv.add_argument("--backend", default="oracle", choices=["oracle", "kernel"])
     srv.add_argument("--cycle-period", type=float, default=1.0)
+    srv.add_argument("--tls-cert", default="", help="TLS certificate (PEM)")
+    srv.add_argument("--tls-key", default="", help="TLS private key (PEM)")
     srv.add_argument(
         "--fake-executor",
         action="append",

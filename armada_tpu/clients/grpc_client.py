@@ -2,8 +2,16 @@
 
 from __future__ import annotations
 
+import os
+
 from ..services.grpc_api import ApiClient
 
 
-def connect(target: str) -> ApiClient:
-    return ApiClient(target)
+def connect(target: str, ca_cert: str | None = None,
+            token: str | None = None) -> ApiClient:
+    """TLS when a CA bundle is given (flag or ARMADA_CA_CERT), Bearer
+    token from ARMADA_TOKEN when present — the client-side half of the
+    server's TLS + auth chain (client/rust/src/auth.rs role)."""
+    ca_cert = ca_cert or os.environ.get("ARMADA_CA_CERT") or None
+    token = token or os.environ.get("ARMADA_TOKEN") or None
+    return ApiClient(target, ca_cert=ca_cert, token=token)

@@ -246,6 +246,12 @@ class FileEventLog(EventLog):
             self._seg_count = 0
         elif not self._seg_starts:
             self._seg_starts.append((name, first))
+        elif self._seg_count >= self.segment_size:
+            # Re-opening after recovery with the last segment already at
+            # the bound: start a fresh offset-named segment instead of
+            # growing the full one by one record per restart.
+            self._seg_starts.append((name, first))
+            self._seg_count = 0
         else:
             # Re-opening after recovery: append to the last live segment.
             name = self._seg_starts[-1][0]

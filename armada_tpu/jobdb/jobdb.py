@@ -308,6 +308,12 @@ class JobDb:
         self._terminal: dict[str, Job] = {}
         self._gangs: dict[tuple, dict[str, Job]] = {}
         self._by_run: dict[str, str] = {}  # latest run id -> job id
+        # Append-only (serial, job_id) changelog for delta consumers
+        # (the incremental snapshot path; the reference delta-syncs by
+        # serial, scheduler.go:441). Compacted when oversized; consumers
+        # whose watermark predates the history get None and resync.
+        self._changelog: list[tuple[int, str]] = []
+        self._changelog_start = 0  # serials <= this may be missing
 
     # ---- txns ----
 
@@ -397,13 +403,36 @@ class JobDb:
                 old = self._jobs.get(jid)
                 if old is not None:
                     self._index_remove(old)
+                self.serial += 1
+                self._changelog.append((self.serial, jid))
                 if job is None:
                     self._jobs.pop(jid, None)
                     continue
-                self.serial += 1
                 stamped = job.with_(serial=self.serial)
                 self._jobs[jid] = stamped
                 self._index_add(stamped)
+            if len(self._changelog) > max(65536, 2 * len(self._jobs)):
+                keep = len(self._changelog) // 2
+                self._changelog_start = self._changelog[-keep - 1][0]
+                self._changelog = self._changelog[-keep:]
+
+    def changed_since(self, serial: int):
+        """Ids of jobs written after `serial` (deletions included), oldest
+        first, deduplicated. None when the changelog no longer reaches
+        back that far — the consumer must resync from a full read."""
+        import bisect
+
+        with self._state_lock:
+            if serial < self._changelog_start:
+                return None
+            idx = bisect.bisect(self._changelog, (serial, "￿"))
+            seen: set = set()
+            out: list[str] = []
+            for _, jid in self._changelog[idx:]:
+                if jid not in seen:
+                    seen.add(jid)
+                    out.append(jid)
+            return out
 
     def _assert_indexes(self):
         """Index↔store consistency (the sanitizer part of jobdb.Assert)."""
@@ -440,6 +469,8 @@ class JobDb:
         with self._state_lock:
             assert not self._jobs, "load() requires a fresh JobDb"
             self.serial = state["serial"]
+            # No history before the checkpoint: delta consumers resync.
+            self._changelog_start = self.serial
             for job in state["jobs"]:
                 self._jobs[job.id] = job
                 self._index_add(job)

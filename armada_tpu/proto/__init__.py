@@ -73,6 +73,17 @@ def job_spec_to_proto(spec) -> pb.JobSpecMsg:
         msg.gang.id = spec.gang.id
         msg.gang.cardinality = int(spec.gang.cardinality)
         msg.gang.node_uniformity_label = spec.gang.node_uniformity_label
+    for pool, v in spec.bid_prices.items():
+        if isinstance(v, (tuple, list)) and len(v) == 2:
+            q, r = float(v[0]), float(v[1])
+        else:
+            try:
+                q = r = float(v)
+            except (TypeError, ValueError):
+                q = r = 0.0
+        msg.bid_prices[pool].queued = q
+        msg.bid_prices[pool].running = r
+    msg.pools.extend(spec.pools)
     for svc in spec.services:
         msg.services.add(type=svc.type, ports=[int(p) for p in svc.ports])
     for ing in spec.ingresses:
@@ -127,6 +138,10 @@ def job_spec_from_proto(msg: pb.JobSpecMsg):
         priority_class=msg.priority_class,
         requests=dict(msg.requests),
         node_selector=dict(msg.node_selector),
+        pools=tuple(msg.pools),
+        bid_prices={
+            k: (v.queued, v.running) for k, v in msg.bid_prices.items()
+        },
         tolerations=tuple(
             Toleration(
                 key=t.key, operator=t.operator, value=t.value, effect=t.effect

@@ -155,6 +155,154 @@ class TokenAuth:
         )
 
 
+class JwksTokenAuth:
+    """auth/oidc.go-shaped: `authorization: Bearer <jwt>` verified RS256
+    against a JWKS document (the reference validates OIDC access tokens
+    against the IdP's JWKS; this environment is zero-egress, so the JWKS
+    is supplied as a dict or local file — rotate by rewriting the file,
+    it is re-read when its mtime changes). Claim mapping (sub, groups,
+    exp, iss) matches TokenAuth/oidc.go."""
+
+    def __init__(
+        self,
+        jwks: dict | None = None,
+        jwks_file: str | None = None,
+        issuer: str = "armada-tpu",
+        audience: str | None = None,
+    ):
+        if jwks is None and jwks_file is None:
+            raise ValueError("JwksTokenAuth needs jwks= or jwks_file=")
+        self._jwks = jwks
+        self._jwks_file = jwks_file
+        self._mtime = None
+        self.issuer = issuer
+        self.audience = audience
+        self._keys: dict[str, object] = {}
+        self._load()
+
+    def _load(self):
+        import os
+
+        doc = self._jwks
+        if self._jwks_file is not None:
+            mtime = os.stat(self._jwks_file).st_mtime
+            if mtime == self._mtime:
+                return
+            self._mtime = mtime
+            with open(self._jwks_file) as f:
+                doc = json.load(f)
+        from cryptography.hazmat.primitives.asymmetric.rsa import (
+            RSAPublicNumbers,
+        )
+
+        keys = {}
+        for k in doc.get("keys", ()):
+            if k.get("kty") != "RSA" or k.get("alg", "RS256") != "RS256":
+                continue
+            n = int.from_bytes(_unb64url(k["n"]), "big")
+            e = int.from_bytes(_unb64url(k["e"]), "big")
+            keys[k.get("kid", "")] = RSAPublicNumbers(e, n).public_key()
+        self._keys = keys
+
+    def authenticate(self, metadata: dict) -> Principal | None:
+        header = metadata.get("authorization", "")
+        if not header.startswith("Bearer "):
+            return None
+        token = header[7:]
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise AuthError("malformed token")
+        try:
+            hdr = json.loads(_unb64url(parts[0]))
+        except Exception:
+            raise AuthError("malformed token header")
+        if hdr.get("alg") != "RS256":
+            # Not ours — let the next authenticator (e.g. HS256) decide.
+            return None
+        if self._jwks_file is not None:
+            # Hot-reload on rotation; a mid-rotation unreadable/partial
+            # file must not take the API down — keep serving the
+            # previously loaded keys until the new document is readable.
+            try:
+                self._load()
+            except Exception:
+                pass
+        key = self._keys.get(hdr.get("kid", ""))
+        if key is None and len(self._keys) == 1:
+            key = next(iter(self._keys.values()))
+        if key is None:
+            raise AuthError("no JWKS key for token kid")
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        signing = (parts[0] + "." + parts[1]).encode()
+        try:
+            key.verify(
+                _unb64url(parts[2]), signing, padding.PKCS1v15(), hashes.SHA256()
+            )
+        except InvalidSignature:
+            raise AuthError("bad token signature")
+        except Exception as e:
+            raise AuthError(f"malformed token signature: {e}")
+        try:
+            claims = json.loads(_unb64url(parts[1]))
+        except Exception:
+            raise AuthError("malformed token claims")
+        if claims.get("iss") != self.issuer:
+            raise AuthError("wrong token issuer")
+        if self.audience is not None:
+            aud = claims.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.audience not in auds:
+                raise AuthError("wrong token audience")
+        exp = claims.get("exp")
+        if exp is not None and time.time() > float(exp):
+            raise AuthError("token expired")
+        return Principal(
+            name=str(claims.get("sub", "")),
+            groups=frozenset(claims.get("groups", ())),
+            auth_method="jwks",
+        )
+
+
+def make_rs256_token(private_key, sub: str, groups=(), exp=None,
+                     iss: str = "armada-tpu", kid: str = "k1", aud=None) -> str:
+    """Mint an RS256 JWT (test/ops helper; private_key is a cryptography
+    RSAPrivateKey)."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    header = {"alg": "RS256", "typ": "JWT", "kid": kid}
+    claims = {"sub": sub, "groups": list(groups), "iss": iss}
+    if exp is not None:
+        claims["exp"] = exp
+    if aud is not None:
+        claims["aud"] = aud
+    signing = (
+        _b64url(json.dumps(header).encode())
+        + "."
+        + _b64url(json.dumps(claims).encode())
+    )
+    sig = private_key.sign(signing.encode(), padding.PKCS1v15(), hashes.SHA256())
+    return signing + "." + _b64url(sig)
+
+
+def jwks_of(public_key, kid: str = "k1") -> dict:
+    """The JWKS document for an RSA public key (test/ops helper)."""
+    nums = public_key.public_numbers()
+
+    def be(i: int) -> str:
+        return _b64url(i.to_bytes((i.bit_length() + 7) // 8, "big"))
+
+    return {
+        "keys": [
+            {"kty": "RSA", "alg": "RS256", "use": "sig", "kid": kid,
+             "n": be(nums.n), "e": be(nums.e)}
+        ]
+    }
+
+
 class MultiAuth:
     """auth/multi.go: try each authenticator in order; the first that
     recognises the credential shape decides; none matching -> error."""

@@ -110,7 +110,17 @@ def job_spec_from_dict(d: dict) -> JobSpec:
         affinity=affinity,
         gang=gang,
         annotations=dict(d.get("annotations", {})),
-        bid_prices=dict(d.get("bid_prices", {})),
+        bid_prices={
+            # Accept the proto json_format shape {"queued": q, "running": r}
+            # alongside scalars and (queued, running) pairs; normalize to
+            # the pair form bid_price_pair understands.
+            k: (
+                (float(v.get("queued", 0.0)), float(v.get("running", 0.0)))
+                if isinstance(v, dict)
+                else v
+            )
+            for k, v in dict(d.get("bid_prices", {})).items()
+        },
         command=tuple(d.get("command", ())),
         services=tuple(
             ServiceConfig.from_obj(s) for s in d.get("services", ())
@@ -578,7 +588,17 @@ class ApiServer:
                     # matching or not — never rewound to the last match.
                     batch = []
                     cursor = max(cursor, self.log.start_offset)
-                    for entry in self.log.read(cursor, 1000):
+                    from ..events.file_log import CompactedLogError
+
+                    try:
+                        entries = self.log.read(cursor, 1000)
+                    except CompactedLogError:
+                        # A concurrent compact() advanced start_offset
+                        # between the clamp and the read — skip the
+                        # compacted history and retry rather than aborting
+                        # the watch stream.
+                        continue
+                    for entry in entries:
                         cursor = entry.offset + 1
                         seq = entry.sequence
                         if seq.queue == queue and seq.jobset == jobset:
@@ -730,8 +750,11 @@ class ApiServer:
             "CordonExecutor": self._cordon_executor,
         }
 
-    def serve(self, port: int = 0, max_workers: int = 16, max_watchers: int | None = None):
-        """Serve on 127.0.0.1:port.
+    def serve(self, port: int = 0, max_workers: int = 16, max_watchers: int | None = None,
+              tls: tuple | None = None):
+        """Serve on 127.0.0.1:port; `tls=(cert_file, key_file)` serves TLS
+        (grpc ssl_server_credentials — the reference's
+        internal/common/grpc TLS listener config).
 
         Watch streams park a worker thread each in a wait loop; unbounded
         watchers would starve unary RPCs (executor lease exchanges) of the
@@ -817,7 +840,16 @@ class ApiServer:
 
         server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         server.add_generic_rpc_handlers((Handler(),))
-        bound_port = server.add_insecure_port(f"127.0.0.1:{port}")
+        if tls is not None:
+            cert_file, key_file = tls
+            with open(cert_file, "rb") as f:
+                cert = f.read()
+            with open(key_file, "rb") as f:
+                key = f.read()
+            creds = grpc.ssl_server_credentials(((key, cert),))
+            bound_port = server.add_secure_port(f"127.0.0.1:{port}", creds)
+        else:
+            bound_port = server.add_insecure_port(f"127.0.0.1:{port}")
         server.start()
         return server, bound_port
 
@@ -829,8 +861,14 @@ class ApiClient:
     the client attaches the authorization metadata the server's auth chain
     expects (client/rust/src/auth.rs plays the same role)."""
 
-    def __init__(self, target: str, token: str | None = None, basic=None):
-        self.channel = grpc.insecure_channel(target)
+    def __init__(self, target: str, token: str | None = None, basic=None,
+                 ca_cert: str | None = None):
+        if ca_cert:
+            with open(ca_cert, "rb") as f:
+                creds = grpc.ssl_channel_credentials(root_certificates=f.read())
+            self.channel = grpc.secure_channel(target, creds)
+        else:
+            self.channel = grpc.insecure_channel(target)
         self._metadata: list = []
         if token:
             self._metadata = [("authorization", f"Bearer {token}")]
@@ -972,8 +1010,14 @@ class ProtoApiClient:
     reference's generated pkg/api clients). Python builds it from the
     same generated armada_pb2 the server uses."""
 
-    def __init__(self, target: str, token: str | None = None, basic=None):
-        self.channel = grpc.insecure_channel(target)
+    def __init__(self, target: str, token: str | None = None, basic=None,
+                 ca_cert: str | None = None):
+        if ca_cert:
+            with open(ca_cert, "rb") as f:
+                creds = grpc.ssl_channel_credentials(root_certificates=f.read())
+            self.channel = grpc.secure_channel(target, creds)
+        else:
+            self.channel = grpc.insecure_channel(target)
         # Same credential surface as ApiClient: Bearer or Basic metadata
         # for the server's auth chain.
         self._metadata: list = []

@@ -58,7 +58,8 @@ def _parse_filters(params: dict) -> list[JobFilter]:
 
 
 class LookoutHttpServer:
-    def __init__(self, query, scheduler, submit, port: int = 0, bind: str = "127.0.0.1"):
+    def __init__(self, query, scheduler, submit, port: int = 0,
+                 bind: str = "127.0.0.1", tls: tuple | None = None):
         self.query = query
         self.scheduler = scheduler
         self.submit = submit
@@ -228,6 +229,14 @@ class LookoutHttpServer:
         # Loopback by default, matching the gRPC API posture; pass
         # bind="0.0.0.0" explicitly to expose on the network.
         self.server = http.server.ThreadingHTTPServer((bind, port), Handler)
+        if tls is not None:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls[0], tls[1])
+            self.server.socket = ctx.wrap_socket(
+                self.server.socket, server_side=True
+            )
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
         self._thread.start()

@@ -216,12 +216,24 @@ class QueryApi:
         construction was seconds of latency)."""
         value = self._value
         _check_field(order.field)
+        if self.lookout is not None and hasattr(self.lookout, "query_rows"):
+            # Persistent stores translate filter/sort/page to SQL
+            # (querybuilder.go); None = not expressible, fall through to
+            # the generic scan.
+            pushed = self.lookout.query_rows(filters, order, skip, take)
+            if pushed is not None:
+                page, total = pushed
+                return self._to_rows(page), total
         rows = [
             obj
             for obj in self._raw_rows()
             if all(_matches_raw(value, obj, f) for f in filters)
         ]
-        keyf = lambda obj: value(obj, order.field)
+        # Deterministic total order: job_id is the secondary key and
+        # follows the primary direction — a persistent store can then
+        # serve either direction with a single composite index scan
+        # (reversing an index reverses every column together).
+        keyf = lambda obj: (value(obj, order.field), value(obj, "job_id"))
         top = skip + take
         if 0 < top < len(rows) // 4:
             # Heap-select the page: O(N log K) beats a full O(N log N)
@@ -266,6 +278,42 @@ class QueryApi:
         value = self._value
         if not group_by_annotation:
             _check_field(group_by)
+        pushed = None
+        if (
+            not group_by_annotation
+            and self.lookout is not None
+            and hasattr(self.lookout, "group_rows")
+        ):
+            pushed = self.lookout.group_rows(group_by, filters, agg_specs)
+        if pushed is not None:
+            groups = pushed
+        else:
+            groups = self._group_scan(
+                groups, agg_specs, group_by, group_by_annotation, filters
+            )
+        for g in groups.values():
+            for name, v in list(g["aggregates"].items()):
+                if isinstance(v, dict) and set(v) == {"sum", "n"}:
+                    g["aggregates"][name] = v["sum"] / v["n"] if v["n"] else 0.0
+        out = list(groups.values())
+        if order_by == "count":
+            key = lambda g: g["count"]
+        elif order_by == "name":
+            key = lambda g: g["name"]
+        else:
+            key = lambda g: g["aggregates"].get(order_by, 0)
+        # Deterministic ties: group name is the secondary key, so the
+        # scan path and a SQL GROUP BY pushdown order identically.
+        out.sort(key=lambda g: str(g["name"]))
+        out.sort(key=key, reverse=(direction == "desc"))
+        if skip:
+            out = out[skip:]
+        if take:
+            out = out[:take]
+        return out
+
+    def _group_scan(self, groups, agg_specs, group_by, group_by_annotation, filters):
+        value = self._value
         for row in self._raw_rows():
             if not all(_matches_raw(value, row, f) for f in filters):
                 continue
@@ -337,23 +385,7 @@ class QueryApi:
                     if rt:
                         bucket["sum"] += rt
                         bucket["n"] += 1
-        for g in groups.values():
-            for name, v in list(g["aggregates"].items()):
-                if isinstance(v, dict) and set(v) == {"sum", "n"}:
-                    g["aggregates"][name] = v["sum"] / v["n"] if v["n"] else 0.0
-        out = list(groups.values())
-        if order_by == "count":
-            key = lambda g: g["count"]
-        elif order_by == "name":
-            key = lambda g: g["name"]
-        else:
-            key = lambda g: g["aggregates"].get(order_by, 0)
-        out.sort(key=key, reverse=(direction == "desc"))
-        if skip:
-            out = out[skip:]
-        if take:
-            out = out[:take]
-        return out
+        return groups
 
     def get_job_errors(
         self, filters: list[JobFilter] = (), take: int = 100

@@ -44,6 +44,7 @@ class RestGateway:
         auth=None,
         authorizer=None,
         api=None,
+        tls: tuple | None = None,
     ):
         self.submit = submit
         self.scheduler = scheduler
@@ -113,6 +114,14 @@ class RestGateway:
                 pass
 
         self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        if tls is not None:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls[0], tls[1])
+            self.server.socket = ctx.wrap_socket(
+                self.server.socket, server_side=True
+            )
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
         self._thread.start()

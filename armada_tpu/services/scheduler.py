@@ -54,6 +54,7 @@ class SchedulerService:
         *,
         backend: str = "oracle",
         mesh=None,
+        snapshot_mode: str = "auto",
         queues: list[QueueSpec] | None = None,
         is_leader=lambda: True,
         runner=None,
@@ -76,6 +77,13 @@ class SchedulerService:
         # of the single-device solve (tests/test_multichip.py).
         self.mesh = mesh
         self._sharded_run = None
+        # Snapshot strategy: "auto" uses incremental O(delta) cycles when
+        # eligible (kernel backend, single pool, no market/away);
+        # "rebuild" always rebuilds; "incremental" forces eligibility
+        # checks only (still falls back per cycle on structure changes).
+        self.snapshot_mode = snapshot_mode
+        self._inc_state: dict = {}
+        self._cycle_incremental_ok = False
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
         self.priority_overrides: dict[str, float] = {}
         self.cordoned_queues: set[str] = set()
@@ -420,6 +428,7 @@ class SchedulerService:
         # nodes alive — all their work may ride borrowed capacity.
         pools |= {p.name for p in self.config.pools if p.away_pools}
         pools = pools or {p.name for p in self.config.pools}
+        self._cycle_incremental_ok = self._incremental_eligible(pools)
         sequences: list[EventSequence] = []
         leased_this_cycle: set[str] = set()
         # Leases from earlier pools' rounds this cycle, visible to later
@@ -838,54 +847,60 @@ class SchedulerService:
         skipped: set[str] | None = None,
         pending_leases: dict | None = None,
     ) -> list[EventSequence]:
-        (
-            nodes,
-            queues,
-            running,
-            queued,
-            node_executor,
-            txn,
-            excluded_nodes,
-        ) = self._build_pool_inputs(
-            pool, exclude, executors, overrides, skipped, pending_leases
-        )
-        if not nodes or not (queued or running):
-            return []
-        limits = self.config.rate_limits
-        last = self._rate_last_refill.get(pool)
-        dt = max(0.0, now - last) if last is not None else 0.0
-        self._rate_last_refill[pool] = now
-        g_tokens = min(
-            self._rate_tokens.get(pool, float(limits.maximum_scheduling_burst))
-            + dt * limits.maximum_scheduling_rate,
-            float(limits.maximum_scheduling_burst),
-        )
-        q_tokens = {
-            q.name: min(
-                self._queue_rate_tokens.get(
-                    (pool, q.name),
-                    float(limits.maximum_per_queue_scheduling_burst),
-                )
-                + dt * limits.maximum_per_queue_scheduling_rate,
-                float(limits.maximum_per_queue_scheduling_burst),
+        inc = None
+        txn = self.jobdb.read_txn()
+        if self._cycle_incremental_ok and not exclude and not pending_leases:
+            inc = self._incremental_round(
+                pool, now, executors, overrides, skipped, cordoned, txn
             )
-            for q in queues
-        }
-        snap = build_round_snapshot(
-            self.config,
-            pool,
-            nodes,
-            queues,
-            running,
-            queued,
-            excluded_nodes=excluded_nodes,
-            cordoned_queues=cordoned if cordoned is not None else self.cordoned_queues,
-            short_job_penalty=self._short_job_penalties(txn, pool, now),
-            global_rate_tokens=g_tokens,
-            queue_rate_tokens=q_tokens,
-        )
+        if inc is not None:
+            st = self._inc_state[pool]
+            node_executor = st["node_executor"]
+            g_tokens, q_tokens = st["tokens"]
+            if not st["node_executor"] or inc._size == len(inc._free):
+                # Idle round: persist the refilled buckets anyway —
+                # _refill_rate_tokens already advanced the refill clock,
+                # so dropping them would freeze depleted buckets for the
+                # whole idle stretch.
+                self._rate_tokens[pool] = g_tokens
+                for qn, tokens in q_tokens.items():
+                    self._queue_rate_tokens[(pool, qn)] = tokens
+                return []
+            snap = inc.snapshot()
+        else:
+            (
+                nodes,
+                queues,
+                running,
+                queued,
+                node_executor,
+                txn,
+                excluded_nodes,
+            ) = self._build_pool_inputs(
+                pool, exclude, executors, overrides, skipped, pending_leases
+            )
+            if not nodes or not (queued or running):
+                return []
+            g_tokens, q_tokens = self._refill_rate_tokens(
+                pool, now, [q.name for q in queues]
+            )
+            snap = build_round_snapshot(
+                self.config,
+                pool,
+                nodes,
+                queues,
+                running,
+                queued,
+                excluded_nodes=excluded_nodes,
+                cordoned_queues=(
+                    cordoned if cordoned is not None else self.cordoned_queues
+                ),
+                short_job_penalty=self._short_job_penalties(txn, pool, now),
+                global_rate_tokens=g_tokens,
+                queue_rate_tokens=q_tokens,
+            )
         solve_started = _time.time()
-        result = self._solve(snap)
+        result = self._solve(snap, inc=inc)
         # Spend rate-limit tokens on newly scheduled jobs (ReserveN in the
         # reference, gang_scheduler.go:118-123); rescheduled evictees are
         # free (scheduled_mask covers new work only).
@@ -1050,14 +1065,266 @@ class SchedulerService:
             self._sharded_run = node_sharded_solve(mesh)
         return self._sharded_run
 
-    def _solve(self, snap):
+    # ------------------------------------------------------------------
+    # Incremental snapshots (O(delta) cycles): the service-side analogue
+    # of the reference's serial-based delta sync (scheduler.go:441). The
+    # jobdb changelog feeds per-pool IncrementalRound state; structural
+    # changes (nodes, queues/weights, vocab misses, truncated history)
+    # fall back to a full rebuild for that cycle.
+    # ------------------------------------------------------------------
+
+    def _incremental_eligible(self, pools) -> bool:
+        """v1 scope: the flagship single-pool kernel configuration. Market
+        mode re-prices existing queued specs in place (bid refresh), and
+        cross-pool away classification depends on multi-pool run state —
+        both use the rebuild path."""
+        return (
+            self.backend == "kernel"
+            and self.snapshot_mode != "rebuild"
+            and len(pools) == 1
+            and not self.config.market_driven
+            and not any(p.away_pools for p in self.config.pools)
+        )
+
+    @staticmethod
+    def _node_sig(nodes) -> int:
+        """Content signature of the round's node set (cached per NodeSpec
+        object — heartbeats that resend the same objects re-hash nothing)."""
+        sigs = []
+        for n in nodes:
+            s = n.__dict__.get("_content_sig")
+            if s is None:
+                s = hash((
+                    n.id,
+                    n.executor,
+                    n.pool,
+                    n.unschedulable,
+                    tuple(sorted(n.labels.items())),
+                    n.taints,
+                    tuple(sorted(n.total_resources.items())),
+                    tuple(
+                        (p, tuple(sorted(r.items())))
+                        for p, r in sorted(
+                            (n.unallocatable_by_priority or {}).items()
+                        )
+                    ),
+                ))
+                object.__setattr__(n, "_content_sig", s)
+            sigs.append(s)
+        return hash(tuple(sigs))
+
+    def _queue_sig(self, queue_names, overrides) -> int:
+        return hash(
+            tuple(
+                (name, self._effective_queue(name, overrides).weight)
+                for name in sorted(queue_names)
+            )
+        )
+
+    def _refill_rate_tokens(self, pool, now, queue_names):
+        """Refill the persisted token buckets for this cycle (the
+        reference's limiter carries across cycles; rate * dt refills)."""
+        limits = self.config.rate_limits
+        last = self._rate_last_refill.get(pool)
+        dt = max(0.0, now - last) if last is not None else 0.0
+        self._rate_last_refill[pool] = now
+        g_tokens = min(
+            self._rate_tokens.get(pool, float(limits.maximum_scheduling_burst))
+            + dt * limits.maximum_scheduling_rate,
+            float(limits.maximum_scheduling_burst),
+        )
+        q_tokens = {
+            name: min(
+                self._queue_rate_tokens.get(
+                    (pool, name),
+                    float(limits.maximum_per_queue_scheduling_burst),
+                )
+                + dt * limits.maximum_per_queue_scheduling_rate,
+                float(limits.maximum_per_queue_scheduling_burst),
+            )
+            for name in queue_names
+        }
+        return g_tokens, q_tokens
+
+    def _incremental_round(
+        self, pool, now, executors, overrides, skipped, cordoned, txn
+    ):
+        """Return an up-to-date IncrementalRound for this cycle, or None
+        when the rebuild path must run (no nodes / structure changed in a
+        way that needs the full input build)."""
+        from ..snapshot.incremental import (
+            IncrementalRound,
+            SnapshotRebuildRequired,
+        )
+
+        executors = executors if executors is not None else dict(self.executors)
+        if skipped is None:
+            skipped = self._skipped_executors(executors)
+        nodes = []
+        node_executor: dict[str, str] = {}
+        for hb in executors.values():
+            if hb.name in skipped:
+                continue
+            for node in hb.nodes:
+                if (node.pool or hb.pool) != pool:
+                    continue
+                nodes.append(node)
+                node_executor[node.id] = hb.name
+        if not nodes:
+            return None
+        node_sig = self._node_sig(nodes)
+
+        st = self._inc_state.get(pool)
+
+        def rebuild():
+            (
+                _nodes,
+                queues,
+                running,
+                queued,
+                _node_executor,
+                _txn,
+                excluded,
+            ) = self._build_pool_inputs(pool, frozenset(), executors,
+                                        overrides, skipped)
+            if not (queued or running):
+                self._inc_state.pop(pool, None)
+                return None
+            inc = IncrementalRound(
+                self.config, pool, _nodes, queues, running, queued,
+            )
+            self._inc_state[pool] = {
+                "inc": inc,
+                "serial": self.jobdb.serial,
+                "node_sig": node_sig,
+                "queue_sig": self._queue_sig(
+                    [q.name for q in queues], overrides
+                ),
+                "node_executor": _node_executor,
+                "queue_names": [q.name for q in queues],
+                "excluded": dict(excluded or {}),
+            }
+            return inc
+
+        if st is not None:
+            queue_sig = self._queue_sig(st["queue_names"], overrides)
+        if (
+            st is None
+            or st["node_sig"] != node_sig
+            or st["queue_sig"] != queue_sig
+        ):
+            inc = rebuild()
+        else:
+            changed = self.jobdb.changed_since(st["serial"])
+            if changed is None:
+                inc = rebuild()
+            else:
+                inc = st["inc"]
+                try:
+                    self._apply_job_deltas(pool, st, inc, changed, txn)
+                except (SnapshotRebuildRequired, KeyError) as e:
+                    self.log_.with_fields(pool=pool).info(
+                        "incremental snapshot rebuild: %s", e
+                    )
+                    inc = rebuild()
+        if inc is None:
+            return None
+        st = self._inc_state[pool]
+        g_tokens, q_tokens = self._refill_rate_tokens(
+            pool, now, st["queue_names"]
+        )
+        st["tokens"] = (g_tokens, q_tokens)
+        inc.set_round_params(
+            excluded_nodes=st["excluded"],
+            cordoned_queues=(
+                cordoned if cordoned is not None else self.cordoned_queues
+            ),
+            short_job_penalty=self._short_job_penalties(txn, pool, now),
+            global_rate_tokens=g_tokens,
+            queue_rate_tokens=q_tokens,
+        )
+        return inc
+
+    def _apply_job_deltas(self, pool, st, inc, changed, txn):
+        """Translate jobdb changes since the watermark into incremental
+        ops; raises SnapshotRebuildRequired on anything unexpected."""
+        from ..snapshot.incremental import SnapshotRebuildRequired
+
+        adds, binds, unbinds, removes = [], [], [], []
+        live = (JobState.LEASED, JobState.PENDING, JobState.RUNNING)
+        excluded = st["excluded"]
+        for jid in changed:
+            job = txn.get(jid)
+            row = inc._id_to_row.get(jid)
+            if job is None or job.state.terminal:
+                if row is not None:
+                    removes.append(jid)
+                excluded.pop(jid, None)
+                continue
+            if job.spec.pools and pool not in job.spec.pools:
+                # Pool-restricted elsewhere (getQueuedJobs eligibility,
+                # scheduling_algo.go:533) — not this round's candidate.
+                if row is not None:
+                    removes.append(jid)
+                excluded.pop(jid, None)
+                continue
+            if job.failed_nodes:
+                excluded[jid] = list(job.failed_nodes)
+            else:
+                excluded.pop(jid, None)
+            if job.state == JobState.QUEUED:
+                if row is None:
+                    adds.append(job.spec.with_(priority=job.priority))
+                else:
+                    if inc._is_running[row]:
+                        unbinds.append(jid)
+                    if inc._submit_prio[row] != job.priority:
+                        inc.set_priority(jid, job.priority)
+            elif job.state in live:
+                run = job.latest_run
+                if run is None or run.pool != pool:
+                    if row is not None:
+                        removes.append(jid)
+                    continue
+                lease = (jid, run.node_id, run.scheduled_at_priority,
+                         run.leased)
+                if row is None:
+                    adds.append(job.spec.with_(priority=job.priority))
+                    binds.append(lease)
+                elif not inc._is_running[row]:
+                    binds.append(lease)
+                else:
+                    node_idx = inc._node_index.get(run.node_id, -1)
+                    if (
+                        inc._node[row] != node_idx
+                        or inc._priority[row] != run.scheduled_at_priority
+                    ):
+                        # Re-leased elsewhere within one sync window.
+                        unbinds.append(jid)
+                        binds.append(lease)
+            else:
+                raise SnapshotRebuildRequired(
+                    f"unhandled state {job.state} for {jid}"
+                )
+        # Order matters: unbinds release gang/alloc state, removals free
+        # rows, adds must precede binds that reference them.
+        inc.unbind(unbinds)
+        inc.remove_jobs(removes)
+        inc.add_jobs(adds)
+        inc.bind(binds)
+        st["serial"] = self.jobdb.serial
+
+    def _solve(self, snap, inc=None):
         if self.backend == "kernel":
             from ..solver.kernel import solve_round
             from ..solver.kernel_prep import pad_device_round, prep_device_round
 
             import numpy as np
 
-            dev = pad_device_round(prep_device_round(snap))
+            if inc is not None:
+                dev = pad_device_round(inc.device_round())
+            else:
+                dev = pad_device_round(prep_device_round(snap))
             if self.mesh is not None:
                 from ..parallel.mesh import pad_nodes
 

@@ -39,6 +39,7 @@ class ControlPlane:
         fake_executors: list[dict] | None = None,
         enable_submit_check: bool = False,
         data_dir: str | None = None,
+        tls: tuple | None = None,
     ):
         self.config = config or SchedulingConfig()
         self.checkpoints = None
@@ -138,7 +139,7 @@ class ControlPlane:
             event_index=self.event_index,
             store_health=self.store_health,
         )
-        self.grpc_server, self.grpc_port = self.api.serve(grpc_port)
+        self.grpc_server, self.grpc_port = self.api.serve(grpc_port, tls=tls)
         self.metrics_server = (
             serve_metrics(self.metrics, metrics_port) if metrics_port else None
         )

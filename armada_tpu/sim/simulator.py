@@ -110,6 +110,7 @@ class Simulator:
         *,
         backend: str = "oracle",
         mesh=None,
+        snapshot_mode: str = "auto",
         seed: int = 0,
         cycle_interval: float = 10.0,
         max_time: float = 7 * 24 * 3600.0,
@@ -121,7 +122,8 @@ class Simulator:
 
         self.log = InMemoryEventLog()
         self.scheduler = SchedulerService(
-            self.config, self.log, backend=backend, mesh=mesh
+            self.config, self.log, backend=backend, mesh=mesh,
+            snapshot_mode=snapshot_mode,
         )
         self.submit = SubmitService(self.config, self.log, scheduler=self.scheduler)
 

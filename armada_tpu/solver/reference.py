@@ -23,8 +23,12 @@ Known deliberate deviations from the Go reference (documented, small):
     (the reference rounds within a node type but merges types on raw values,
     nodeiteration.go:170-185); ties differ only between near-identical nodes.
   - Away scheduling covers within-pool away node types (well-known taint
-    sets at reduced priority); cross-pool away nodes and the optimiser pass
-    are not yet implemented (experimental/flag-gated in the reference).
+    sets at reduced priority) AND cross-pool away nodes (round 5): borrowed
+    jobs arrive as snapshot rows under phantom "<queue>-away" fairness
+    buckets built by build_round_snapshot, so this solver handles them
+    generically; away gangs skip floating-resource caps
+    (context/scheduling.go:546-557). The optimiser pass runs as a host-side
+    post-pass (solver/optimiser.py), not inside this solver.
 """
 
 from __future__ import annotations

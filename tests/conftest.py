@@ -52,3 +52,16 @@ import pytest  # noqa: E402
 def _bound_jit_cache():
     yield
     jax.clear_caches()
+
+
+def pytest_collection_modifyitems(config, items):
+    """Exhaustive parity sweeps (random-scenario fleets, the full
+    fast-fill matrix) run only with ARMADA_FULL_SUITE=1: the default
+    suite keeps one representative per mechanism and finishes in
+    minutes, the full sweep stays one env var away."""
+    if os.environ.get("ARMADA_FULL_SUITE") == "1":
+        return
+    skip = pytest.mark.skip(reason="slow sweep; set ARMADA_FULL_SUITE=1")
+    for item in items:
+        if item.get_closest_marker("slow") is not None:
+            item.add_marker(skip)

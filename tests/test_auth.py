@@ -172,3 +172,196 @@ def test_authorized_flow_and_queue_grants(served):
         "team", "s", [{"id": "j2", "requests": {"cpu": "1", "memory": "1Gi"}}]
     )
     assert ids == ["j2"]
+
+
+# ---------------------------------------------------------------------------
+# RS256 / JWKS verification (auth/oidc.go analogue) + TLS listeners
+# (internal/common/grpc TLS config analogue).
+# ---------------------------------------------------------------------------
+
+
+def _rsa_keypair():
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    return key, key.public_key()
+
+
+def test_jwks_rs256_roundtrip_and_failures(tmp_path):
+    import json as _json
+
+    from armada_tpu.services.auth import (
+        JwksTokenAuth,
+        jwks_of,
+        make_rs256_token,
+    )
+
+    key, pub = _rsa_keypair()
+    jwks = jwks_of(pub, kid="kid-a")
+    auth = JwksTokenAuth(jwks=jwks)
+    tok = make_rs256_token(key, "alice", groups=("devs",), kid="kid-a")
+    p = auth.authenticate({"authorization": f"Bearer {tok}"})
+    assert p.name == "alice" and "devs" in p.groups and p.auth_method == "jwks"
+
+    # Tampered payload -> bad signature.
+    head, body, sig = tok.split(".")
+    evil = A._b64url(_json.dumps({"sub": "mallory", "iss": "armada-tpu"}).encode())
+    with pytest.raises(AuthError):
+        auth.authenticate({"authorization": f"Bearer {head}.{evil}.{sig}"})
+
+    # Wrong issuer / expiry.
+    with pytest.raises(AuthError):
+        auth.authenticate(
+            {"authorization": "Bearer "
+             + make_rs256_token(key, "a", iss="other", kid="kid-a")}
+        )
+    with pytest.raises(AuthError):
+        auth.authenticate(
+            {"authorization": "Bearer "
+             + make_rs256_token(key, "a", exp=time.time() - 5, kid="kid-a")}
+        )
+
+    # A different keypair's token -> rejected.
+    other_key, _ = _rsa_keypair()
+    with pytest.raises(AuthError):
+        auth.authenticate(
+            {"authorization": "Bearer "
+             + make_rs256_token(other_key, "a", kid="kid-a")}
+        )
+
+    # HS256 tokens are not this authenticator's shape: it defers (None),
+    # so MultiAuth can chain RS256 + HS256 side by side.
+    hs = make_token(SECRET, "bob")
+    assert auth.authenticate({"authorization": f"Bearer {hs}"}) is None
+    chain = MultiAuth([auth, TokenAuth(SECRET)])
+    assert chain.authenticate({"authorization": f"Bearer {hs}"}).name == "bob"
+    assert chain.authenticate({"authorization": f"Bearer {tok}"}).name == "alice"
+
+
+def test_jwks_file_rotation(tmp_path):
+    import json as _json
+
+    from armada_tpu.services.auth import (
+        JwksTokenAuth,
+        jwks_of,
+        make_rs256_token,
+    )
+
+    key1, pub1 = _rsa_keypair()
+    key2, pub2 = _rsa_keypair()
+    path = tmp_path / "jwks.json"
+    path.write_text(_json.dumps(jwks_of(pub1, kid="k1")))
+    auth = JwksTokenAuth(jwks_file=str(path))
+    tok1 = make_rs256_token(key1, "alice", kid="k1")
+    assert auth.authenticate({"authorization": f"Bearer {tok1}"}).name == "alice"
+
+    # Rotate the file: new kid verifies after reload, old key is gone.
+    import os
+
+    path.write_text(_json.dumps(jwks_of(pub2, kid="k2")))
+    os.utime(path, (time.time() + 2, time.time() + 2))
+    tok2 = make_rs256_token(key2, "carol", kid="k2")
+    assert auth.authenticate({"authorization": f"Bearer {tok2}"}).name == "carol"
+    with pytest.raises(AuthError):
+        auth.authenticate({"authorization": f"Bearer {tok1}"})
+
+
+def _self_signed(tmp_path):
+    """Self-signed localhost cert via cryptography; returns (cert, key)."""
+    import datetime
+    import ipaddress
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "localhost")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [
+                    x509.DNSName("localhost"),
+                    x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+                ]
+            ),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_file = tmp_path / "tls.crt"
+    key_file = tmp_path / "tls.key"
+    cert_file.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_file.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(cert_file), str(key_file)
+
+
+def test_grpc_tls_roundtrip(tmp_path):
+    cert_file, key_file = _self_signed(tmp_path)
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    submit = SubmitService(config, log, scheduler=sched)
+    server = ApiServer(submit, sched, QueryApi(sched.jobdb), log)
+    grpc_server, port = server.serve(port=0, tls=(cert_file, key_file))
+    try:
+        client = ApiClient(f"localhost:{port}", ca_cert=cert_file)
+        client.create_queue("tls-q", priority_factor=1.0)
+        queues = client.list_queues()
+        assert any(q["name"] == "tls-q" for q in queues)
+        # Plaintext against the TLS port must fail.
+        plain = ApiClient(f"localhost:{port}")
+        with pytest.raises(grpc.RpcError):
+            plain.list_queues()
+    finally:
+        grpc_server.stop(0)
+
+
+def test_rest_gateway_tls(tmp_path):
+    import json as _json
+    import ssl
+    import urllib.request
+
+    cert_file, key_file = _self_signed(tmp_path)
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    submit = SubmitService(config, log, scheduler=sched)
+    from armada_tpu.services.rest_gateway import RestGateway
+
+    gw = RestGateway(
+        submit, sched, QueryApi(sched.jobdb), log, port=0,
+        tls=(cert_file, key_file),
+    )
+    try:
+        ctx = ssl.create_default_context(cafile=cert_file)
+        with urllib.request.urlopen(
+            f"https://localhost:{gw.port}/api/v1/queues", context=ctx, timeout=5
+        ) as resp:
+            assert resp.status == 200
+            assert "queues" in _json.loads(resp.read())
+    finally:
+        gw.stop()

@@ -351,3 +351,35 @@ def test_gang_contexts_in_reports():
     assert rep.gang_contexts[("gq", "too-big")].startswith("not scheduled")
     assert "gang fits" in sched.reports.queue_report("gq")
     assert "gang too-big" in sched.reports.scheduling_report()
+
+
+def test_incremental_cycle_respects_pool_restriction():
+    """Delta-applied submits honor JobSpec.pools eligibility exactly like
+    the full rebuild (incremental snapshot path, single-pool kernel)."""
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="kernel",
+                             snapshot_mode="incremental")
+    submit = SubmitService(config, log, scheduler=sched)
+    executor = FakeExecutor(
+        "c1", log, sched,
+        nodes=make_nodes("c1", count=2, cpu="8", memory="32Gi"),
+        runtime_for=lambda job_id: 100.0,
+    )
+    submit.create_queue(QueueSpec("q"))
+    submit.submit("q", "s", [job(0)], now=0.0)
+    executor.tick(0.0)
+    sched.cycle(now=1.0)  # builds the incremental state
+    assert sched.jobdb.read_txn().get("job-0000").latest_run is not None
+    # Now a delta-applied submit restricted to another pool: must NOT be
+    # leased here, exactly like the rebuild path would filter it.
+    submit.submit("q", "s", [job(1, pools=("gpu-pool",)), job(2)], now=2.0)
+    executor.tick(2.0)
+    sched.cycle(now=3.0)
+    txn = sched.jobdb.read_txn()
+    assert txn.get("job-0002").latest_run is not None  # eligible: leased
+    assert txn.get("job-0001").latest_run is None  # restricted: untouched
+    assert txn.get("job-0001").state == JobState.QUEUED

@@ -208,3 +208,27 @@ def test_settings_survive_restart(tmp_path):
     sched2 = SchedulerService(SchedulingConfig(), FileEventLog(d))
     assert sched2.cordoned_executors == {"cluster-x"}
     assert sched2.priority_overrides == {"q1": 4.0}
+
+
+def test_restart_does_not_grow_full_segment(tmp_path):
+    """A restart with the last segment already at segment_size must roll a
+    fresh segment instead of growing the full one (size bound honored)."""
+    import os
+
+    from armada_tpu.events.file_log import FileEventLog
+
+    d = str(tmp_path / "log")
+    log = FileEventLog(d, segment_size=4)
+    for i in range(4):
+        log.publish(EventSequence.of("q", "s", SubmitJob(created=0.0, job=job(i))))
+    log.close()
+    # Reopen (recovery counts 4 records in the live segment) and publish:
+    log2 = FileEventLog(d, segment_size=4)
+    log2.publish(EventSequence.of("q", "s", SubmitJob(created=1.0, job=job(9))))
+    log2.close()
+    segs = sorted(f for f in os.listdir(d) if f.startswith("seg-"))
+    assert len(segs) == 2, segs
+    counts = [
+        sum(1 for _ in open(os.path.join(d, s))) for s in segs
+    ]
+    assert counts[0] == 4 and counts[1] == 1

@@ -81,6 +81,7 @@ def assert_set_parity(snap, serial, fast, label=""):
 
 
 @pytest.mark.parametrize("seed", range(6))
+@pytest.mark.slow
 def test_fast_fill_set_parity_queued_only(seed):
     rng = np.random.default_rng(1000 + seed)
     nodes, queues, running, queued = rand_scenario(
@@ -131,6 +132,7 @@ def test_fast_fill_collapses_loops():
     assert int(fast["num_loops"]) <= 12, f"fast loops {fast['num_loops']}"
 
 
+@pytest.mark.slow
 def test_fast_fill_respects_burst_caps():
     cfg = SchedulingConfig()
     cfg = dataclasses.replace(
@@ -156,6 +158,7 @@ def test_fast_fill_respects_burst_caps():
     assert_set_parity(snap, serial, fast, "burst")
 
 
+@pytest.mark.slow
 def test_fast_fill_heterogeneous_stream():
     """Mixed scheduling keys WITHIN each queue's stream (random sizes, so
     same-key runs average ~1.3 slots): the heterogeneous window must batch
@@ -190,6 +193,7 @@ def test_fast_fill_heterogeneous_stream():
     assert int(fast["num_loops"]) <= 12, f"fast loops {fast['num_loops']}"
 
 
+@pytest.mark.slow
 def test_fast_fill_group_cap_cut():
     """More distinct keys than fill_group_max in one window: the window is
     cut, extra keys batch next iteration — still set-exact."""
@@ -253,6 +257,7 @@ def test_fast_fill_heterogeneous_queues():
     assert int(fast["num_loops"]) < int(serial["num_loops"]) // 4
 
 
+@pytest.mark.slow
 def test_fast_fill_batches_evicted_rebinds():
     """Preemption-heavy round: a hog queue's running jobs are evicted for
     balance and mostly rebind to their nodes. The evicted-window fast path
@@ -316,6 +321,7 @@ def test_fast_fill_batches_evicted_rebinds():
     )
 
 
+@pytest.mark.slow
 def test_fast_fill_evicted_rebind_capacity_cut():
     """An evicted window where later rebinds no longer fit (queued work
     from another queue got the capacity first in merged order): the window

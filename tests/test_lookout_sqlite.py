@@ -1,0 +1,199 @@
+"""SqliteLookoutStore: differential vs the in-memory view, persistent
+restart-without-replay, and the retention pruner."""
+
+import dataclasses
+
+from armada_tpu.core.types import JobSpec
+from armada_tpu.events import (
+    CancelJob,
+    CancelJobSet,
+    EventSequence,
+    InMemoryEventLog,
+    JobErrors,
+    JobRequeued,
+    JobRunLeased,
+    JobRunPreempted,
+    JobRunRunning,
+    JobRunSucceeded,
+    JobSucceeded,
+    ReprioritiseJob,
+    SubmitJob,
+)
+from armada_tpu.services.lookout_ingester import LookoutStore
+from armada_tpu.services.lookout_sqlite import SqliteLookoutStore
+from armada_tpu.services.queryapi import JobFilter, Order, QueryApi
+
+
+def publish_lifecycle(log):
+    """A stream covering every event the view materializes."""
+    now = 100.0
+
+    def job(i, queue="qa", jobset="s1"):
+        return JobSpec(
+            id=f"lk-{i:03d}",
+            queue=queue,
+            jobset=jobset,
+            requests={"cpu": "1", "memory": "1Gi"},
+            annotations={"team": f"t{i % 2}"},
+            submitted_ts=now + i,
+        )
+
+    log.publish(
+        EventSequence.of(
+            "qa", "s1", *[SubmitJob(created=100.0 + i, job=job(i)) for i in range(6)]
+        )
+    )
+    log.publish(
+        EventSequence.of(
+            "qb",
+            "s2",
+            *[
+                SubmitJob(created=110.0 + i, job=job(10 + i, "qb", "s2"))
+                for i in range(4)
+            ],
+        )
+    )
+    # Leases + running for the first few.
+    leases = [
+        JobRunLeased(
+            created=120.0,
+            job_id=f"lk-{i:03d}",
+            run_id=f"run-{i:03d}",
+            executor="ex1",
+            node_id=f"n{i}",
+            pool="default",
+        )
+        for i in range(4)
+    ]
+    log.publish(EventSequence.of("qa", "s1", *leases))
+    log.publish(
+        EventSequence.of(
+            "qa",
+            "s1",
+            *[
+                JobRunRunning(created=130.0, job_id=f"lk-{i:03d}", run_id=f"run-{i:03d}")
+                for i in range(4)
+            ],
+        )
+    )
+    # One success, one run-success + job-success, one preempt + requeue,
+    # one failure; a cancel, a reprioritise, a jobset cancel.
+    log.publish(
+        EventSequence.of(
+            "qa",
+            "s1",
+            JobRunSucceeded(created=140.0, job_id="lk-000", run_id="run-000"),
+            JobSucceeded(created=140.0, job_id="lk-000"),
+            JobRunPreempted(
+                created=141.0, job_id="lk-001", run_id="run-001", reason="evicted"
+            ),
+            JobRequeued(created=141.5, job_id="lk-001"),
+            JobErrors(created=142.0, job_id="lk-002", error="oom killed"),
+            CancelJob(created=143.0, job_id="lk-004"),
+            ReprioritiseJob(created=143.5, job_id="lk-005", priority=7),
+        )
+    )
+    log.publish(EventSequence.of("qb", "s2", CancelJobSet(created=150.0)))
+
+
+def row_key(row):
+    d = dataclasses.asdict(row)
+    d["runs"] = [dataclasses.asdict(r) if not isinstance(r, dict) else r
+                 for r in row.runs]
+    return d
+
+
+def test_differential_vs_in_memory(tmp_path):
+    log = InMemoryEventLog()
+    publish_lifecycle(log)
+    ram = LookoutStore(log)
+    ram.sync()
+    sq = SqliteLookoutStore(log, str(tmp_path / "lk.db"))
+    sq.sync()
+
+    ram_rows = {r.job_id: row_key(r) for r in ram.all_rows()}
+    sq_rows = {r.job_id: row_key(r) for r in sq.all_rows()}
+    assert ram_rows == sq_rows
+
+    # The full query surface answers identically.
+    q_ram, q_sq = QueryApi(lookout=ram), QueryApi(lookout=sq)
+    for flt, order in [
+        ([JobFilter("queue", "qa")], Order("submitted", "asc")),
+        ([JobFilter("state", "cancelled")], Order("submitted", "desc")),
+        ([], Order("last_transition", "desc")),
+    ]:
+        rows_ram, tot_ram = q_ram.get_jobs(flt, order, 0, 50)
+        rows_sq, tot_sq = q_sq.get_jobs(flt, order, 0, 50)
+        assert tot_ram == tot_sq
+        assert [r.job_id for r in rows_ram] == [r.job_id for r in rows_sq]
+    assert q_ram.group_jobs("state", []) == q_sq.group_jobs("state", [])
+    assert row_key(sq.get("lk-001")) == row_key(ram.get("lk-001"))
+    assert sq.get_run("run-001").termination_reason == "evicted"
+    sq.close()
+
+
+def test_restart_without_replay(tmp_path):
+    path = str(tmp_path / "lk.db")
+    log = InMemoryEventLog()
+    publish_lifecycle(log)
+    sq = SqliteLookoutStore(log, path)
+    sq.sync()
+    cursor = sq.cursor
+    n = sq.count()
+    assert n == 10
+    sq.close()
+
+    # Reopen: cursor persisted — nothing to replay.
+    sq2 = SqliteLookoutStore(log, path)
+    assert sq2.cursor == cursor
+    assert sq2.sync() == 0
+    assert sq2.count() == n
+
+    # New events apply incrementally from the suffix only.
+    log.publish(
+        EventSequence.of(
+            "qa",
+            "s1",
+            SubmitJob(
+                created=200.0,
+                job=JobSpec(id="lk-new", queue="qa", jobset="s1",
+                            requests={"cpu": "1"}),
+            ),
+        )
+    )
+    assert sq2.sync() == 1
+    assert sq2.get("lk-new") is not None
+    sq2.close()
+
+
+def test_pruner(tmp_path):
+    log = InMemoryEventLog()
+    publish_lifecycle(log)
+    sq = SqliteLookoutStore(log, str(tmp_path / "lk.db"))
+    sq.sync()
+    # Terminal rows: lk-000 succeeded@140, lk-002 failed@142, lk-004
+    # cancelled@143, and the 4 qb rows cancelled@150. lk-001 requeued
+    # (active) must survive any cutoff.
+    dropped = sq.prune(older_than=145.0)
+    assert dropped == 3
+    assert sq.get("lk-000") is None
+    assert sq.get_run("run-000") is None  # run index cleaned
+    assert sq.get("lk-001") is not None  # active survives
+    dropped2 = sq.prune(older_than=1e9)
+    assert dropped2 == 4  # the jobset-cancelled qb rows
+    assert sq.get("lk-001") is not None
+    assert sq.count() == 3  # lk-001 (queued), lk-003 (running), lk-005
+    sq.close()
+
+
+def test_broadside_sqlite_backend_smoke():
+    from armada_tpu.clients.broadside import BroadsideConfig, Runner
+
+    cfg = BroadsideConfig(
+        backend="sqlite", duration_s=1.0, ingest_actors=1, query_actors=1,
+        batch=20,
+    )
+    report = Runner(cfg).run()
+    assert report["backend"] == "sqlite"
+    assert report["ingest"]["ops"] > 0
+    assert report["get_jobs"]["ops"] > 0

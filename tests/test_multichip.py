@@ -103,6 +103,7 @@ def test_mixed_round_parity(sharded):
     assert np.isfinite(out["demand_capped_fair_share"]).all()
 
 
+@pytest.mark.slow
 def test_uneven_shards_parity(sharded):
     """Node counts that do not divide the mesh exercise inert padding."""
     for n_nodes in (9, 13, 27):
@@ -115,6 +116,7 @@ def test_uneven_shards_parity(sharded):
         )
 
 
+@pytest.mark.slow
 def test_random_scenarios_parity(sharded):
     """Random sweeps with running jobs, gangs, taints, selectors."""
     rng = np.random.default_rng(7)

@@ -26,7 +26,7 @@ CFG = SchedulingConfig(
 )
 
 
-def run(backend, seed, mesh=None):
+def run(backend, seed, mesh=None, snapshot_mode="auto"):
     sim = Simulator(
         [
             ClusterSpec(
@@ -74,6 +74,7 @@ def run(backend, seed, mesh=None):
         config=CFG,
         backend=backend,
         mesh=mesh,
+        snapshot_mode=snapshot_mode,
         seed=seed,
         max_time=5000.0,
     )
@@ -112,3 +113,15 @@ def test_full_simulation_differential_sharded():
     assert kernel["preemptions"] == sharded["preemptions"]
     assert kernel["states"] == sharded["states"]
     assert kernel["placements"] == sharded["placements"]
+
+
+def test_full_simulation_differential_incremental_snapshots():
+    """O(delta) incremental service cycles (jobdb changelog ->
+    IncrementalRound) must reproduce the full-rebuild kernel history
+    exactly — the whole-system proof for the serial-based delta sync."""
+    rebuild = run("kernel", 0, snapshot_mode="rebuild")
+    incremental = run("kernel", 0, snapshot_mode="incremental")
+    assert rebuild["finished"] == incremental["finished"]
+    assert rebuild["preemptions"] == incremental["preemptions"]
+    assert rebuild["states"] == incremental["states"]
+    assert rebuild["placements"] == incremental["placements"]
