@@ -131,6 +131,120 @@ def job_spec_from_dict(d: dict) -> JobSpec:
     )
 
 
+def _lease_req_from_proto_dict(req: dict) -> dict:
+    """LeaseRequest json_format dict -> the JSON handler's layout: unwrap
+    the map<int32, ResourceMap> nesting (json_format keys maps by string)."""
+    for node in req.get("nodes", ()):
+        unalloc = node.get("unallocatable_by_priority")
+        if unalloc:
+            node["unallocatable_by_priority"] = {
+                k: dict(v.get("resources", {})) for k, v in unalloc.items()
+            }
+    return req
+
+
+def _lease_resp_to_proto_dict(out: dict) -> dict:
+    """JSON lease reply -> LeaseResponse-shaped dict: the jobspec travels
+    as always-zlib bytes on the proto wire (base64 for ParseDict), like
+    the reference's compressed lease payloads."""
+    import base64
+    import zlib
+
+    leases = []
+    for lease in out.get("leases", ()):
+        lease = dict(lease)
+        spec = lease.pop("spec", None)
+        if isinstance(spec, dict) and "__zlib__" in spec:
+            raw = base64.b64decode(spec["__zlib__"])
+        else:
+            raw = zlib.compress(json.dumps(spec).encode(), level=6)
+        lease["spec_zlib"] = base64.b64encode(raw).decode()
+        leases.append(lease)
+    return {**out, "leases": leases}
+
+
+class ProtoExecutorClient:
+    """Executor-agent connector over the binary-protobuf wire: implements
+    the agent's `_call` surface (ExecutorLease / ReportEvents) with
+    LeaseRequest/LeaseResponse messages — what a non-Python executor
+    build against proto/armada.proto speaks."""
+
+    def __init__(self, target: str, token: str | None = None,
+                 ca_cert: str | None = None):
+        self._proto = ProtoApiClient(target, token=token, ca_cert=ca_cert)
+
+    def _call(self, method: str, req: dict):
+        from google.protobuf import json_format
+
+        from ..proto import armada_pb2 as pb
+
+        if method == "ExecutorLease":
+            msg = pb.LeaseRequest(
+                executor=req["executor"],
+                pool=req.get("pool", "default"),
+                acked_run_ids=list(req.get("acked_run_ids", ())),
+            )
+            for n in req.get("nodes", ()):
+                node = msg.nodes.add(
+                    id=n["id"],
+                    name=n.get("name", n["id"]),
+                    pool=n.get("pool", ""),
+                    unschedulable=bool(n.get("unschedulable", False)),
+                )
+                node.labels.update(
+                    {k: str(v) for k, v in (n.get("labels") or {}).items()}
+                )
+                node.total_resources.update(
+                    {
+                        k: str(v)
+                        for k, v in (n.get("total_resources") or {}).items()
+                    }
+                )
+                node.usage.update(
+                    {k: str(v) for k, v in (n.get("usage") or {}).items()}
+                )
+                for t in n.get("taints", ()):
+                    node.taints.add(
+                        key=t.get("key", ""),
+                        value=t.get("value", ""),
+                        effect=t.get("effect", "NoSchedule"),
+                    )
+                for prio, res in (
+                    n.get("unallocatable_by_priority") or {}
+                ).items():
+                    node.unallocatable_by_priority[int(prio)].resources.update(
+                        {k: str(v) for k, v in res.items()}
+                    )
+            resp = self._proto._unary("ExecutorLease", msg, pb.LeaseResponse)
+            out = json_format.MessageToDict(
+                resp,
+                preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True,
+            )
+            # spec_zlib bytes -> the JSON wire's {"__zlib__": b64} shape,
+            # which the agent's decompress_obj already understands.
+            for lease in out.get("leases", ()):
+                lease["spec"] = {"__zlib__": lease.pop("spec_zlib", "")}
+            return out
+        if method == "ReportEvents":
+            msg = pb.ReportEventsRequest()
+            for e in req.get("events", ()):
+                msg.events.add(
+                    type=e.get("type", ""),
+                    job_id=e.get("job_id", ""),
+                    run_id=e.get("run_id", ""),
+                    queue=e.get("queue", ""),
+                    jobset=e.get("jobset", ""),
+                    created=float(e.get("created", 0.0)),
+                    error=str(e.get("error", "")),
+                    retryable=bool(e.get("retryable", True)),
+                    debug=str(e.get("debug", "")),
+                )
+            self._proto._unary("ReportEvents", msg, pb.ReportEventsResponse)
+            return {}
+        raise ValueError(f"ProtoExecutorClient does not speak {method!r}")
+
+
 class ApiServer:
     """Hosts submit/query/events/reports over one gRPC server."""
 
@@ -655,7 +769,13 @@ class ApiServer:
                 pb.JobReprioritizeRequest,
                 pb.JobReprioritizeResponse,
             ),
+            # Executor wire (executorapi.proto role): transforms adapt the
+            # nested proto map/bytes shapes to the JSON handler's layout.
+            "ExecutorLease": (pb.LeaseRequest, pb.LeaseResponse),
+            "ReportEvents": (pb.ReportEventsRequest, pb.ReportEventsResponse),
         }
+        req_transforms = {"ExecutorLease": _lease_req_from_proto_dict}
+        resp_transforms = {"ExecutorLease": _lease_resp_to_proto_dict}
         if method == "WatchJobSet":
             def stream(request, context):
                 msg = pb.WatchRequest.FromString(request)
@@ -698,6 +818,9 @@ class ApiServer:
                 preserving_proto_field_name=True,
                 always_print_fields_with_no_presence=True,
             )
+            req_tf = req_transforms.get(method)
+            if req_tf is not None:
+                req = req_tf(req)
             gate(method, req, context)
             try:
                 out = fn(req) or {}
@@ -705,6 +828,9 @@ class ApiServer:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            resp_tf = resp_transforms.get(method)
+            if resp_tf is not None:
+                out = resp_tf(out)
             resp = resp_type()
             json_format.ParseDict(out, resp, ignore_unknown_fields=True)
             return resp

@@ -59,10 +59,15 @@ def _parse_filters(params: dict) -> list[JobFilter]:
 
 class LookoutHttpServer:
     def __init__(self, query, scheduler, submit, port: int = 0,
-                 bind: str = "127.0.0.1", tls: tuple | None = None):
+                 bind: str = "127.0.0.1", tls: tuple | None = None,
+                 auth=None, authorizer=None):
         self.query = query
         self.scheduler = scheduler
         self.submit = submit
+        # Optional auth chain for the mutation endpoints (reads stay
+        # open, like the reference's lookout deployment posture).
+        self.auth = auth
+        self.authorizer = authorizer
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -81,6 +86,97 @@ class LookoutHttpServer:
                     self._route(parsed, params)
                 except Exception as e:  # surface handler errors as 500s
                     self._json({"error": str(e)}, 500)
+
+            def do_POST(self):
+                parsed = urllib.parse.urlparse(self.path)
+                try:
+                    # CSRF defense: cross-origin <form enctype=text/plain>
+                    # submissions cannot set custom headers or this
+                    # content type; the UI's fetch() sets both.
+                    if (
+                        self.headers.get("Content-Type", "")
+                        .split(";")[0]
+                        .strip()
+                        != "application/json"
+                        or self.headers.get("X-Requested-With")
+                        != "armada-lookout"
+                    ):
+                        self._json(
+                            {"error": "missing CSRF headers"}, 403
+                        )
+                        return
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = (
+                        json.loads(self.rfile.read(length)) if length else {}
+                    )
+                    self._mutate(parsed.path, body)
+                except Exception as e:
+                    self._json({"error": str(e)}, 500)
+
+            def _mutate(self, path, body):
+                """UI mutations (the reference UI's cancel/reprioritize
+                actions, lookoutui submitApi usage)."""
+                if outer.submit is None:
+                    self._json({"error": "mutations unavailable"}, 503)
+                    return
+                if outer.auth is not None:
+                    # Same chain as the gRPC API: Authorization header ->
+                    # principal -> queue-scoped cancel/reprioritize verbs.
+                    from .auth import (
+                        CANCEL_ANY_JOBS,
+                        REPRIORITIZE_ANY_JOBS,
+                        AuthError,
+                        PermissionDenied,
+                    )
+
+                    try:
+                        principal = outer.auth.authenticate(
+                            {
+                                "authorization": self.headers.get(
+                                    "Authorization", ""
+                                )
+                            }
+                        )
+                        if outer.authorizer is not None:
+                            queue = outer.submit.get_queue(
+                                body.get("queue", "")
+                            )
+                            verb, perm = (
+                                ("cancel", CANCEL_ANY_JOBS)
+                                if path == "/api/cancel"
+                                else ("reprioritize", REPRIORITIZE_ANY_JOBS)
+                            )
+                            outer.authorizer.authorize_queue(
+                                principal, verb, queue, perm
+                            )
+                    except AuthError as e:
+                        self._json({"error": str(e)}, 401)
+                        return
+                    except PermissionDenied as e:
+                        self._json({"error": str(e)}, 403)
+                        return
+                if path == "/api/cancel":
+                    queue, jobset = body.get("queue"), body.get("jobset")
+                    ids = body.get("job_ids") or []
+                    reason = body.get("reason", "cancelled from lookout")
+                    if not queue or not jobset:
+                        self._json({"error": "queue and jobset required"}, 400)
+                        return
+                    if ids:
+                        for jid in ids:
+                            outer.submit.cancel_job(queue, jobset, jid, reason)
+                    else:
+                        outer.submit.cancel_jobset(queue, jobset, reason)
+                    self._json({"cancelled": len(ids) or "jobset"})
+                elif path == "/api/reprioritize":
+                    for jid in body.get("job_ids") or []:
+                        outer.submit.reprioritise_job(
+                            body["queue"], body["jobset"], jid,
+                            int(body["priority"]),
+                        )
+                    self._json({"reprioritized": len(body.get("job_ids") or [])})
+                else:
+                    self._json({"error": "not found"}, 404)
 
             def _route(self, parsed, params):
                 if parsed.path == "/" or parsed.path == "/index.html":

@@ -227,12 +227,22 @@ class SqliteLookoutStore:
             if not entries:
                 return applied
             with self._lock:
-                self._prefetch(entries)
-                for entry in entries:
-                    for event in entry.sequence.events:
-                        self._apply(entry.sequence, event)
-                self.cursor = entries[-1].offset + 1
-                self._flush()
+                try:
+                    self._prefetch(entries)
+                    for entry in entries:
+                        for event in entry.sequence.events:
+                            self._apply(entry.sequence, event)
+                    self.cursor = entries[-1].offset + 1
+                    self._flush()
+                except Exception:
+                    # A mid-batch failure must not leave half-applied rows
+                    # in the cache: the caller's retry would re-apply the
+                    # same events on top and persist doubled state. Drop
+                    # the batch's in-memory work; the cursor did not move.
+                    self.rows.cache.clear()
+                    self.rows.absent.clear()
+                    self.run_to_job.pending.clear()
+                    raise
             applied += len(entries)
 
     def _prefetch(self, entries):

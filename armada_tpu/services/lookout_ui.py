@@ -71,6 +71,8 @@ color:var(--mut)}
 <nav>
 <button id="tab-jobs" class="on" onclick="show('jobs')">Jobs</button>
 <button id="tab-groups" onclick="show('groups')">Groups</button>
+<button id="tab-jobsets" onclick="show('jobsets')">Job Sets</button>
+<button id="tab-errors" onclick="show('errors')">Errors</button>
 <button id="tab-queues" onclick="show('queues')">Queues</button>
 <button id="tab-report" onclick="show('report')">Report</button>
 </nav>
@@ -126,6 +128,20 @@ auto-refresh</label>
   </div>
   <table id="groups"><thead></thead><tbody></tbody></table>
 </div>
+<div id="v-jobsets" style="display:none">
+  <div class="controls">
+    queue <input id="js-queue" placeholder="(all queues)" style="width:160px">
+    <button class="pri" onclick="loadJobsets()">refresh</button>
+  </div>
+  <table id="jobsets"><thead><tr><th>queue</th><th>jobset</th><th>jobs</th>
+    <th>states</th><th>first submit</th><th>last submit</th><th></th>
+  </tr></thead><tbody></tbody></table>
+</div>
+<div id="v-errors" style="display:none">
+  <div class="err" id="errors-err" style="display:none"></div>
+  <table id="errors"><thead><tr><th>job</th><th>queue</th><th>jobset</th>
+    <th>category</th><th>error</th></tr></thead><tbody></tbody></table>
+</div>
 <div id="v-queues">
   <div id="fairshare"></div>
 </div>
@@ -137,6 +153,7 @@ auto-refresh</label>
 <div id="drawer">
   <button style="float:right" onclick="closeDrawer()">close</button>
   <h2 id="d-title"></h2>
+  <div id="d-actions" style="margin-bottom:8px"></div>
   <div class="kv" id="d-kv"></div>
   <h2>runs</h2>
   <table id="d-runs"><thead><tr><th>run</th><th>node</th><th>state</th>
@@ -152,7 +169,7 @@ async function jget(u){const r=await fetch(u);if(!r.ok)throw new Error(
 function esc(x){return String(x??'').replace(/[&<>"']/g,
   c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function show(v){st.view=v;
-  for(const t of ['jobs','groups','queues','report']){
+  for(const t of ['jobs','groups','jobsets','errors','queues','report']){
     document.getElementById('v-'+t).style.display=t===v?'':'none';
     document.getElementById('tab-'+t).classList.toggle('on',t===v)}
   refresh()}
@@ -196,18 +213,20 @@ async function load(){
   try{
     const groups=await jget('/api/groups?by=state'+filtersParamNoState());
     const total=groups.groups.reduce((a,g)=>a+g.count,0);
-    document.getElementById('cards').innerHTML=
-      `<div class="card ${st.state?'':'on'}" onclick="st.state='';st.skip=0;load()">
+    const cards=document.getElementById('cards');
+    cards.innerHTML=
+      `<div class="card ${st.state?'':'on'}" data-state="">
        <b>${total}</b><span>all</span></div>`+
       groups.groups.map(g=>
-      `<div class="card ${st.state===g.name?'on':''}"
-        onclick="st.state='${esc(g.name)}';st.skip=0;load()">
+      `<div class="card ${st.state===g.name?'on':''}" data-state="${esc(g.name)}">
        <b>${g.count}</b><span>${esc(g.name)}</span></div>`).join('');
+    cards.querySelectorAll('.card').forEach(c=>
+      c.onclick=()=>{st.state=c.dataset.state;st.skip=0;load()});
     const u=`/api/jobs?take=${take}&skip=${st.skip}&order=${st.order}`+
       `&direction=${st.dir}`+filtersParam();
     const data=await jget(u);
     document.querySelector('#jobs tbody').innerHTML=data.jobs.map(j=>
-      `<tr class="row" onclick="openJob('${esc(j.job_id)}')">
+      `<tr class="row">
        <td>${esc(j.job_id)}</td><td>${esc(j.queue)}</td><td>${esc(j.jobset)}</td>
        <td><span class="state ${esc(j.state)}">${esc(j.state)}</span></td>
        <td>${esc(j.priority)}</td><td>${esc(j.node)}</td>
@@ -215,6 +234,8 @@ async function load(){
        <td>${new Date(j.submitted*1000).toISOString().slice(0,19)}</td>
        <td title="${esc(j.error)}">${esc(j.error_category||(j.error?'error':''))}
        </td></tr>`).join('');
+    document.querySelectorAll('#jobs tbody tr').forEach((tr,i)=>
+      tr.onclick=()=>openJob(data.jobs[i].job_id));
     document.getElementById('pageinfo').textContent=
       `${st.skip+1}-${Math.min(st.skip+take,data.total)} of ${data.total}`;
   }catch(e){err.textContent=e.message;err.style.display=''}
@@ -242,13 +263,15 @@ async function loadGroups(){
     '<tr><th>'+esc(by)+'</th><th>count</th>'+
     cl.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>';
   document.querySelector('#groups tbody').innerHTML=data.groups.map(g=>
-    `<tr class="row" onclick="drillGroup('${esc(by)}','${esc(g.name)}',${ann})">
+    `<tr class="row">
      <td>${esc(g.name)}</td><td>${g.count}</td>`+
     cl.map(c=>{let v=g.aggregates[c];
       if(typeof v==='object'&&v)v=Object.entries(v).map(
         ([k,n])=>`${k}:${n}`).join(' ');
       if(typeof v==='number'&&!Number.isInteger(v))v=v.toFixed(2);
       return '<td>'+esc(v??'')+'</td>'}).join('')+'</tr>').join('');
+  document.querySelectorAll('#groups tbody tr').forEach((tr,i)=>
+    tr.onclick=()=>drillGroup(by,data.groups[i].name,ann));
 }
 function drillGroup(field,value,ann){
   st.filters=[{field,value,match:'exact',isAnnotation:!!ann}];st.skip=0;
@@ -301,13 +324,25 @@ async function openJob(id){
     `<tr><td title="${esc(r.run_id)}">${esc(r.run_id.slice(0,13))}</td>
      <td>${esc(r.node)}</td>
      <td><span class="state ${esc(r.state)}">${esc(r.state)}</span></td>
-     <td><button class="lnk" onclick="drillRun('${esc(r.run_id)}','error')">err</button>
-     <button class="lnk" onclick="drillRun('${esc(r.run_id)}','debug')">debug</button>
-     <button class="lnk" onclick="drillRun('${esc(r.run_id)}','termination')">term</button>
+     <td><button class="lnk" data-k="error">err</button>
+     <button class="lnk" data-k="debug">debug</button>
+     <button class="lnk" data-k="termination">term</button>
      </td></tr>`).join('');
+  document.querySelectorAll('#d-runs tbody tr').forEach((tr,i)=>
+    tr.querySelectorAll('button').forEach(b=>
+      b.onclick=()=>drillRun(d.runs[i].run_id,b.dataset.k)));
   document.getElementById('d-spec').textContent=
     JSON.stringify({requests:d.requests,annotations:d.annotations},null,2);
   document.getElementById('d-drill').style.display='none';
+  const act=document.getElementById('d-actions');act.innerHTML='';
+  if(['queued','leased','pending','running'].includes(d.state)){
+    const c=document.createElement('button');c.className='pri';
+    c.textContent='cancel';
+    c.onclick=()=>cancelJob(d.queue,d.jobset,d.job_id);
+    const r=document.createElement('button');r.textContent='reprioritize';
+    r.onclick=()=>reprioritizeJob(d.queue,d.jobset,d.job_id);
+    act.append(c,' ',r);
+  }
   document.getElementById('drawer').classList.add('open');
 }
 async function drillRun(runId,kind){
@@ -317,9 +352,71 @@ async function drillRun(runId,kind){
   el.style.display='';
 }
 function closeDrawer(){document.getElementById('drawer').classList.remove('open')}
+async function post(u,body){const r=await fetch(u,{method:'POST',
+  headers:{'Content-Type':'application/json',
+           'X-Requested-With':'armada-lookout'},body:JSON.stringify(body)});
+  const d=await r.json().catch(()=>({}));
+  if(!r.ok)throw new Error(d.error||r.statusText);return d}
+async function cancelJob(queue,jobset,id){
+  if(!confirm(`cancel ${id}?`))return;
+  try{await post('/api/cancel',{queue,jobset,job_ids:[id]});closeDrawer();load()}
+  catch(e){alert(e.message)}}
+async function reprioritizeJob(queue,jobset,id){
+  const p=prompt('new priority (lower schedules first)');if(p===null)return;
+  try{await post('/api/reprioritize',{queue,jobset,job_ids:[id],priority:+p});
+    closeDrawer();load()}catch(e){alert(e.message)}}
+async function cancelJobset(queue,jobset){
+  if(!confirm(`cancel every active job in ${queue}/${jobset}?`))return;
+  try{await post('/api/cancel',{queue,jobset});loadJobsets()}
+  catch(e){alert(e.message)}}
+async function loadJobsets(){
+  // Group per (queue, jobset): same-named jobsets in different queues
+  // must stay separate rows (and cancel the right queue).
+  const filter=document.getElementById('js-queue').value.trim();
+  let queues=filter?[filter]:
+    (await jget('/api/queues')).queues.map(x=>x.name);
+  const aggs=encodeURIComponent(JSON.stringify(
+    ['state_counts',{field:'submitted',type:'min'},{field:'submitted',type:'max'}]));
+  const rows=[];
+  for(const queue of queues){
+    const fs=encodeURIComponent(JSON.stringify(
+      [{field:'queue',value:queue,match:'exact'}]));
+    const data=await jget(`/api/groups?by=jobset&aggregates=${aggs}&filters=${fs}`);
+    for(const g of data.groups)rows.push({queue,g});
+  }
+  const fmt=t=>t?new Date(t*1000).toISOString().slice(0,19):'';
+  document.querySelector('#jobsets tbody').innerHTML=rows.map(({queue,g})=>{
+    const sc=g.aggregates.state_counts||{};
+    const states=Object.entries(sc).map(([k,n])=>
+      `<span class="state ${esc(k)}">${esc(k)} ${n}</span>`).join(' ');
+    return `<tr><td>${esc(queue)}</td>
+      <td>${esc(g.name)}</td><td>${g.count}</td><td>${states}</td>
+      <td>${fmt(g.aggregates.submitted_min)}</td>
+      <td>${fmt(g.aggregates.submitted_max)}</td>
+      <td><button class="lnk">cancel</button></td></tr>`}).join('')||
+    '<tr><td colspan="7">no jobsets</td></tr>';
+  document.querySelectorAll('#jobsets tbody button').forEach((b,i)=>
+    b.onclick=()=>cancelJobset(rows[i].queue,rows[i].g.name));
+}
+async function loadErrors(){
+  const err=document.getElementById('errors-err');err.style.display='none';
+  try{
+    const data=await jget('/api/errors');
+    document.querySelector('#errors tbody').innerHTML=(data.errors||[]).map(e=>
+      `<tr class="row">
+       <td>${esc(e.job_id)}</td><td>${esc(e.queue)}</td><td>${esc(e.jobset)}</td>
+       <td>${esc(e.error_category||'')}</td>
+       <td title="${esc(e.error)}">${esc((e.error||'').slice(0,160))}</td>
+       </tr>`).join('')||'<tr><td colspan="5">no failed jobs</td></tr>';
+    document.querySelectorAll('#errors tbody tr.row').forEach((tr,i)=>
+      tr.onclick=()=>openJob(data.errors[i].job_id));
+  }catch(e){err.textContent=e.message;err.style.display=''}
+}
 function refresh(){
   if(st.view==='jobs')load();
   else if(st.view==='groups')loadGroups();
+  else if(st.view==='jobsets')loadJobsets();
+  else if(st.view==='errors')loadErrors();
   else if(st.view==='queues')loadQueues();
   else loadReport()}
 setInterval(()=>{if(document.getElementById('auto').checked&&

@@ -323,3 +323,113 @@ def test_lookout_http_rich_query_surface():
         assert any(r["queue"] == "team" for r in pools["default"])
     finally:
         server.stop()
+
+
+def test_ui_mutation_endpoints():
+    """The UI's cancel/reprioritize POSTs (the reference UI's submitApi
+    actions) flow through the submission service into the view."""
+    import json as _json
+    import urllib.request
+
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.core.types import JobSpec, QueueSpec
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.lookout_http import LookoutHttpServer
+    from armada_tpu.services.lookout_ingester import LookoutStore
+    from armada_tpu.services.queryapi import QueryApi
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log)
+    submit = SubmitService(config, log, scheduler=sched)
+    store = LookoutStore(log)
+    server = LookoutHttpServer(QueryApi(lookout=store), sched, submit, port=0)
+    try:
+        submit.create_queue(QueueSpec("ui-q"))
+        submit.submit(
+            "ui-q", "s1",
+            [JobSpec(id=f"ui-{i}", queue="ui-q", requests={"cpu": "1"})
+             for i in range(3)],
+            now=0.0,
+        )
+        store.sync()
+
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}{path}",
+                data=_json.dumps(body).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    # CSRF gate: mutations require the custom header a
+                    # cross-origin form cannot set.
+                    "X-Requested-With": "armada-lookout",
+                },
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return _json.loads(resp.read())
+
+        out = post("/api/reprioritize",
+                   {"queue": "ui-q", "jobset": "s1", "job_ids": ["ui-0"],
+                    "priority": 7})
+        assert out == {"reprioritized": 1}
+        out = post("/api/cancel",
+                   {"queue": "ui-q", "jobset": "s1", "job_ids": ["ui-1"]})
+        assert out == {"cancelled": 1}
+        out = post("/api/cancel", {"queue": "ui-q", "jobset": "s1"})
+        assert out == {"cancelled": "jobset"}
+        store.sync()
+        assert store.get("ui-0").priority == 7
+        assert store.get("ui-1").state == "cancelled"
+        assert store.get("ui-2").state == "cancelled"
+    finally:
+        server.stop()
+
+
+def test_ui_mutations_reject_csrf_shapes():
+    """Cross-origin form-style POSTs (no custom header / text-plain body)
+    are rejected; only the UI's fetch shape passes."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.lookout_http import LookoutHttpServer
+    from armada_tpu.services.lookout_ingester import LookoutStore
+    from armada_tpu.services.queryapi import QueryApi
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log)
+    submit = SubmitService(config, log, scheduler=sched)
+    server = LookoutHttpServer(
+        QueryApi(lookout=LookoutStore(log)), sched, submit, port=0
+    )
+    try:
+        body = _json.dumps({"queue": "q", "jobset": "s"}).encode()
+        for headers in (
+            {"Content-Type": "text/plain"},
+            {"Content-Type": "application/json"},  # header missing
+        ):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/api/cancel",
+                data=body, headers=headers, method="POST",
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                raise AssertionError("CSRF-shaped POST was accepted")
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+    finally:
+        server.stop()

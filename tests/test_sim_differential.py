@@ -87,7 +87,9 @@ def run(backend, seed, mesh=None, snapshot_mode="auto"):
     }
 
 
-@pytest.mark.parametrize("seed", [0, 7])
+@pytest.mark.parametrize(
+    "seed", [0, pytest.param(7, marks=pytest.mark.slow)]
+)
 def test_full_simulation_differential(seed):
     oracle = run("oracle", seed)
     kernel = run("kernel", seed)
