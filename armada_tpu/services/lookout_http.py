@@ -16,6 +16,7 @@ view, scheduling report.
   GET /api/fairshare             (per-pool queue shares, latest round)
   GET /api/report
   GET /api/errors
+  GET /api/logs/<job_id>?tail=N   (binoculars log fetch, when wired)
   GET /api/runs/<run_id>/error|debug|termination
   GET /api/details/<job_id>      (row + runs incl. debug)
   GET /api/job/<id>              (spec + runs)
@@ -60,10 +61,13 @@ def _parse_filters(params: dict) -> list[JobFilter]:
 class LookoutHttpServer:
     def __init__(self, query, scheduler, submit, port: int = 0,
                  bind: str = "127.0.0.1", tls: tuple | None = None,
-                 auth=None, authorizer=None):
+                 auth=None, authorizer=None, binoculars=None):
         self.query = query
         self.scheduler = scheduler
         self.submit = submit
+        # Optional log access (services/binoculars.py): the reference UI
+        # fetches container logs through the binoculars service.
+        self.binoculars = binoculars
         # Optional auth chain for the mutation endpoints (reads stay
         # open, like the reference's lookout deployment posture).
         self.auth = auth
@@ -294,6 +298,26 @@ class LookoutHttpServer:
                         self._json({"error": f"unknown drilldown {kind}"}, 404)
                     else:
                         self._json({"run_id": run_id, "message": fn(run_id)})
+                elif parsed.path.startswith("/api/logs/"):
+                    if outer.binoculars is None:
+                        self._json({"error": "logs unavailable"}, 503)
+                        return
+                    job_id = parsed.path.rsplit("/", 1)[1]
+                    try:
+                        tail = int(params.get("tail", 100))
+                        if tail < 0:
+                            raise ValueError
+                    except ValueError:
+                        self._json({"error": "tail must be a non-negative "
+                                    "integer"}, 400)
+                        return
+                    try:
+                        lines = outer.binoculars.get_logs(job_id, tail)
+                    except KeyError as e:
+                        self._json({"error": e.args[0] if e.args else str(e)},
+                                   404)
+                        return
+                    self._json({"job_id": job_id, "lines": lines})
                 elif parsed.path.startswith("/api/details/"):
                     job_id = parsed.path.rsplit("/", 1)[1]
                     details = outer.query.job_details(job_id)

@@ -335,6 +335,15 @@ async function openJob(id){
     JSON.stringify({requests:d.requests,annotations:d.annotations},null,2);
   document.getElementById('d-drill').style.display='none';
   const act=document.getElementById('d-actions');act.innerHTML='';
+  {const l=document.createElement('button');l.textContent='logs';
+   l.onclick=async()=>{const el=document.getElementById('d-drill');
+     try{const data=await jget('/api/logs/'+encodeURIComponent(d.job_id)+
+       '?tail=200');
+       el.textContent='logs for '+d.job_id+'\n\n'+
+         ((data.lines||[]).join('\n')||'(empty)');}
+     catch(e){el.textContent='logs: '+e.message}
+     el.style.display=''};
+   act.append(l,' ');}
   if(['queued','leased','pending','running'].includes(d.state)){
     const c=document.createElement('button');c.className='pri';
     c.textContent='cancel';

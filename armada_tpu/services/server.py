@@ -168,6 +168,7 @@ class ControlPlane:
                 self.scheduler,
                 self.submit,
                 lookout_port,
+                binoculars=self.binoculars,
             )
         # Health surface (common/health; schedulerapp.go:71-75).
         from .health import (

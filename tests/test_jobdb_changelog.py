@@ -21,8 +21,7 @@ def test_changed_since_dedup_and_order():
     _put(db, "b")
     _put(db, "a")  # a changes again: deduped, still reported once
     changed = db.changed_since(base)
-    assert changed == ["a", "b"] or changed == ["b", "a"]
-    # Oldest-first with dedup keeps first occurrence order: a, b.
+    # Oldest-first with dedup keeps first-occurrence order: a, b.
     assert changed == ["a", "b"]
     mid = db.serial
     _put(db, "c")

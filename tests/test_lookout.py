@@ -433,3 +433,56 @@ def test_ui_mutations_reject_csrf_shapes():
                 assert e.code == 403
     finally:
         server.stop()
+
+
+def test_ui_logs_endpoint():
+    """GET /api/logs/<job_id> routes through binoculars to the executor
+    (the reference UI's container-log fetch)."""
+    import json as _json
+    import urllib.request
+
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.core.types import JobSpec, QueueSpec
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.binoculars import BinocularsService
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.lookout_http import LookoutHttpServer
+    from armada_tpu.services.lookout_ingester import LookoutStore
+    from armada_tpu.services.queryapi import QueryApi
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    config = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log)
+    submit = SubmitService(config, log, scheduler=sched)
+    executor = FakeExecutor(
+        "lg", log, sched, nodes=make_nodes("lg", count=1, cpu="8",
+                                           memory="32Gi"),
+        runtime_for=lambda job_id: 60.0,
+    )
+    store = LookoutStore(log)
+    server = LookoutHttpServer(
+        QueryApi(lookout=store), sched, submit, port=0,
+        binoculars=BinocularsService(sched, [executor]),
+    )
+    try:
+        submit.create_queue(QueueSpec("lg-q"))
+        submit.submit("lg-q", "s1",
+                      [JobSpec(id="lg-0", queue="lg-q",
+                               requests={"cpu": "1"})], now=0.0)
+        executor.tick(0.0)
+        sched.cycle(now=1.0)
+        executor.tick(1.5)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/api/logs/lg-0?tail=10",
+            timeout=5,
+        ) as resp:
+            data = _json.loads(resp.read())
+        assert data["job_id"] == "lg-0"
+        assert isinstance(data["lines"], list) and data["lines"]
+    finally:
+        server.stop()
