@@ -463,6 +463,17 @@ def main(argv=None):
         help="pod runtime: timed sleeps, or real OS processes running "
         "each job's command with rlimit enforcement",
     )
+    ap.add_argument(
+        "--wire",
+        choices=["json", "proto"],
+        default="json",
+        help="lease-exchange encoding: JSON, or the binary protobuf wire "
+        "(proto/armada.proto LeaseRequest/LeaseResponse)",
+    )
+    ap.add_argument("--ca-cert", default="",
+                    help="CA bundle: connect with TLS")
+    ap.add_argument("--token", default="",
+                    help="Bearer token for the server's auth chain")
     args = ap.parse_args(argv)
     nodes = [
         {
@@ -476,8 +487,20 @@ def main(argv=None):
         if args.backend == "subprocess"
         else _PodRuntime(runtime_s=args.runtime)
     )
+    if args.wire == "proto":
+        from .grpc_api import ProtoExecutorClient
+
+        client = ProtoExecutorClient(
+            args.server, token=args.token or None,
+            ca_cert=args.ca_cert or None,
+        )
+    else:
+        client = ApiClient(
+            args.server, token=args.token or None,
+            ca_cert=args.ca_cert or None,
+        )
     agent = ExecutorAgent(
-        ApiClient(args.server),
+        client,
         args.name,
         nodes,
         pool=args.pool,

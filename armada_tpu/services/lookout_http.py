@@ -305,10 +305,11 @@ class LookoutHttpServer:
                     job_id = parsed.path.rsplit("/", 1)[1]
                     try:
                         tail = int(params.get("tail", 100))
-                        if tail < 0:
+                        # 0 is rejected too: lines[-0:] would mean "all".
+                        if tail <= 0:
                             raise ValueError
                     except ValueError:
-                        self._json({"error": "tail must be a non-negative "
+                        self._json({"error": "tail must be a positive "
                                     "integer"}, 400)
                         return
                     try:
