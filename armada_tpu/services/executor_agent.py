@@ -488,17 +488,12 @@ def main(argv=None):
         else _PodRuntime(runtime_s=args.runtime)
     )
     if args.wire == "proto":
-        from .grpc_api import ProtoExecutorClient
-
-        client = ProtoExecutorClient(
-            args.server, token=args.token or None,
-            ca_cert=args.ca_cert or None,
-        )
+        from .grpc_api import ProtoExecutorClient as client_cls
     else:
-        client = ApiClient(
-            args.server, token=args.token or None,
-            ca_cert=args.ca_cert or None,
-        )
+        client_cls = ApiClient
+    client = client_cls(
+        args.server, token=args.token or None, ca_cert=args.ca_cert or None
+    )
     agent = ExecutorAgent(
         client,
         args.name,

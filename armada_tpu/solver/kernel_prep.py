@@ -651,7 +651,13 @@ def prep_device_round(
             keep = slot_is_running[:n_cand] | (
                 slot_jobs_before[:n_cand] < lookback
             )
-            if not keep.all():
+            # The kernel masks past-lookback slots itself (kernel.py:599
+            # stopYieldingNewJobsIfLimitHit); this shrink only exists to
+            # reduce S. Re-padding ~10 S-sized arrays to drop a tail
+            # sliver costs more than it saves, so shrink only when it
+            # changes the padded program shape.
+            n_keep = int(keep.sum())
+            if n_keep < n_cand and _pow2(max(1, n_keep)) < _pow2(S):
                 kept = np.flatnonzero(keep)
                 n_new = len(kept)
                 S = max(1, n_new)
@@ -687,6 +693,12 @@ def prep_device_round(
             & (snap.job_excluded_nodes[j0] < 0).all(axis=1)
             & (snap.job_affinity_group[j0] < 0)
         )
+        if cfg.max_queue_lookback:
+            # Batched fill runs place whole prefixes without per-slot
+            # lookback validity checks; past-lookback slots must never be
+            # batchable (they used to be shrunk away unconditionally —
+            # the shrink is now gated on padded-shape reduction).
+            elig &= slot_jobs_before[:n_live] < cfg.max_queue_lookback
         slot_batchable[:n_live] = elig
         same = (
             elig[1:]

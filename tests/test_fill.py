@@ -367,3 +367,49 @@ def test_fast_fill_evicted_rebind_capacity_cut():
         np.asarray(serial["preempted_mask"])
         == np.asarray(fast["preempted_mask"])
     ).all()
+
+
+def test_lookback_bounds_batched_fill_runs():
+    """Past-lookback slots are never batchable: the fill fast path places
+    whole run prefixes without per-slot lookback checks, so eligibility
+    must stop at the horizon even when the size-shrink is skipped
+    (stopYieldingNewJobsIfLimitHit semantics on every path)."""
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.core.types import JobSpec, NodeSpec, QueueSpec
+    from armada_tpu.snapshot.round import build_round_snapshot
+    from armada_tpu.solver.kernel import solve_round
+    from armada_tpu.solver.kernel_prep import (
+        pad_device_round,
+        prep_device_round,
+    )
+    from armada_tpu.solver.reference import ReferenceSolver
+
+    cfg = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+        max_queue_lookback=5,
+        batch_fill_window=512,  # plain batched fill (fast_fill off)
+    )
+    nodes = [
+        NodeSpec(id=f"n{i}", pool="default",
+                 total_resources={"cpu": "64", "memory": "256Gi"})
+        for i in range(2)
+    ]
+    # 8 identical batchable jobs: _pow2(5) == _pow2(8), so the shrink is
+    # skipped and the lookback bound must come from run eligibility.
+    queued = [
+        JobSpec(id=f"lb-{i}", queue="q", requests={"cpu": "1", "memory": "1Gi"},
+                submitted_ts=float(i))
+        for i in range(8)
+    ]
+    snap = build_round_snapshot(cfg, "default", nodes, [QueueSpec("q")], [],
+                                queued)
+    dev = prep_device_round(snap)
+    assert not dev.slot_batchable[5:8].any()
+    out = solve_round(pad_device_round(dev))
+    J = snap.num_jobs
+    assert int(out["scheduled_mask"][:J].sum()) == 5  # horizon enforced
+    oracle = ReferenceSolver(snap).solve()
+    import numpy as np
+
+    assert np.array_equal(oracle.scheduled_mask, out["scheduled_mask"][:J])
