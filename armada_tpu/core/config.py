@@ -173,6 +173,17 @@ class SchedulingConfig:
     fill_group_max: int = 8
     executor_timeout_s: float = 600.0
     max_unacknowledged_jobs_per_executor: int = 2500
+    # Round-deadline guardrail (the reference's maxSchedulingDuration,
+    # config/scheduler/config.yaml:105): wall-clock budget for one
+    # scheduling round. The solver checkpoints between fill loops and
+    # stops yielding new loops once the budget is spent; the cycle
+    # commits the partial placement (a prefix of the full round's
+    # decisions) and reports `round_truncated`. 0 disables.
+    max_scheduling_duration_s: float = 0.0
+    # Consecutive truncated rounds in one pool before per-pool
+    # backpressure trips (services/backpressure.RoundDeadlinePressure)
+    # and the health surface turns unhealthy.
+    truncated_rounds_backpressure: int = 3
     # Store backpressure (common/etcdhealth re-targeted at the event log;
     # services/backpressure.py): reject submissions and pause executor pod
     # creation when the log's disk footprint exceeds this fraction of the
@@ -390,6 +401,12 @@ class SchedulingConfig:
             ("spotPriceCutoff", "spot_price_cutoff", float),
             ("shortJobPenaltySeconds", "short_job_penalty_s", float),
             ("executorTimeout", "executor_timeout_s", float),
+            ("maxSchedulingDuration", "max_scheduling_duration_s", float),
+            (
+                "truncatedRoundsBackpressure",
+                "truncated_rounds_backpressure",
+                int,
+            ),
             (
                 "maxUnacknowledgedJobsPerExecutor",
                 "max_unacknowledged_jobs_per_executor",
@@ -480,6 +497,10 @@ def validate_config(config: SchedulingConfig):
         problems.append("batchFillWindow must be >= 0")
     if config.fill_group_max < 1:
         problems.append("fillGroupMax must be >= 1")
+    if config.max_scheduling_duration_s < 0:
+        problems.append("maxSchedulingDuration must be >= 0")
+    if config.truncated_rounds_backpressure < 1:
+        problems.append("truncatedRoundsBackpressure must be >= 1")
     for name, frac in config.maximum_resource_fraction_to_schedule.items():
         if frac < 0:
             problems.append(f"maximumResourceFractionToSchedule[{name}] < 0")

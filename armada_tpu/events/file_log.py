@@ -48,6 +48,12 @@ class CorruptLogError(RuntimeError):
     """Mid-log corruption: refuse to start rather than drop records."""
 
 
+class InjectedFault(RuntimeError):
+    """A fault-injection hook fired (services/chaos.py): the append was
+    deliberately torn mid-record to simulate a crash. The partial bytes
+    are on disk; recovery truncates them on the next open."""
+
+
 class CompactedLogError(RuntimeError):
     """Read below the compaction point: the caller must bootstrap from a
     view checkpoint instead of replaying from offset 0 (the reference's
@@ -117,10 +123,23 @@ class FileEventLog(EventLog):
     reference tolerates unacked Pulsar messages.
     """
 
-    def __init__(self, directory: str, segment_size: int = 50_000, sync_every: int = 64):
+    def __init__(
+        self,
+        directory: str,
+        segment_size: int = 50_000,
+        sync_every: int = 64,
+        fault_injector=None,
+    ):
         self.dir = directory
         self.segment_size = segment_size
         self.sync_every = sync_every
+        # Chaos hook (services/chaos.py): called with the encoded record
+        # length before each append; a non-None return is the number of
+        # bytes to write before raising InjectedFault (a simulated crash
+        # mid-write, leaving a torn tail for recovery to truncate). The
+        # instance is poisoned afterwards — reopen to recover.
+        self.fault_injector = fault_injector
+        self._poisoned = False
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         self._watchers: list[threading.Condition] = []
@@ -259,6 +278,13 @@ class FileEventLog(EventLog):
 
     def publish(self, sequence: EventSequence) -> int:
         with self._lock:
+            if self._poisoned:
+                # Before touching any file handle: a poisoned instance
+                # must never reopen the torn segment in append mode.
+                raise InjectedFault(
+                    "log instance crashed on an injected torn write; "
+                    "reopen the directory to recover"
+                )
             offset = self._base + len(self._entries)
             if self._fh is None or self._seg_count >= self.segment_size:
                 self._open_segment()
@@ -273,12 +299,33 @@ class FileEventLog(EventLog):
                 "c": zlib.crc32(json.dumps(payload).encode()),
                 "s": payload,
             }
+            data = json.dumps(rec).encode() + b"\n"
+            if self.fault_injector is not None:
+                torn = self.fault_injector(len(data))
+                if torn is not None:
+                    # Simulated crash mid-write: the torn bytes STAY on
+                    # disk (unlike the OSError rollback below) — exactly
+                    # what a killed process leaves for recovery. The
+                    # instance is dead from here (a real crash kills the
+                    # process): further appends on it would concatenate
+                    # onto the torn fragment and corrupt the log mid-file,
+                    # so they fail loudly instead.
+                    self._fh.write(data[:torn])
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                    self._fh.close()
+                    self._fh = None
+                    self._poisoned = True
+                    raise InjectedFault(
+                        f"torn write: {torn}/{len(data)} bytes of record "
+                        f"{offset}"
+                    )
             # On a partial write (e.g. ENOSPC) roll the file back to the
             # record boundary so a later append can't concatenate onto torn
             # bytes mid-file.
             pos = self._fh.tell()
             try:
-                self._fh.write(json.dumps(rec).encode() + b"\n")
+                self._fh.write(data)
             except OSError:
                 self._fh.truncate(pos)
                 raise

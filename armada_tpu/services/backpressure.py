@@ -95,3 +95,95 @@ class StoreHealthMonitor:
 
     def __call__(self) -> bool:
         return self.check()[0]
+
+
+class CompositeGate:
+    """Combine monitors exposing check() -> (healthy, reason); the first
+    unhealthy one wins. Lets submit-side shedding consume store capacity
+    AND round-deadline pressure through one gate."""
+
+    def __init__(self, *monitors):
+        self.monitors = [m for m in monitors if m is not None]
+
+    def check(self) -> tuple[bool, str]:
+        for monitor in self.monitors:
+            healthy, reason = monitor.check()
+            if not healthy:
+                return False, reason
+        return True, ""
+
+    def __call__(self) -> bool:
+        return self.check()[0]
+
+
+class RoundDeadlinePressure:
+    """Per-pool round-truncation backpressure.
+
+    A round that hits the scheduling budget (maxSchedulingDuration) commits
+    a partial placement and reports `round_truncated`; that is graceful
+    degradation, not failure. But a pool truncating round after round is a
+    sustained-overload signal: intake should shed before the backlog (and
+    per-round latency) grows without bound. This tracker counts CONSECUTIVE
+    truncated rounds per pool; at `threshold` the pool trips, and one full
+    (untruncated) round clears it. A pool that stops running rounds
+    entirely (its executors expired) decays after `stale_after_s` instead
+    of holding the gate tripped forever. Same check()/__call__ surface as
+    StoreHealthMonitor so it composes into the health multi-checker and
+    submit-side shedding."""
+
+    def __init__(self, threshold: int = 3, stale_after_s: float = 600.0):
+        import threading
+
+        self.threshold = max(1, int(threshold))
+        self.stale_after_s = stale_after_s
+        self._streaks: dict[str, tuple[int, float]] = {}  # pool -> (n, ts)
+        # Written by the scheduler cycle thread, read from gRPC submit
+        # and health worker threads.
+        self._lock = threading.Lock()
+
+    def note_round(
+        self, pool: str, truncated: bool, now: float | None = None
+    ) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if truncated:
+                n, _ = self._streaks.get(pool, (0, now))
+                self._streaks[pool] = (n + 1, now)
+            else:
+                self._streaks.pop(pool, None)
+
+    def streak(self, pool: str) -> int:
+        with self._lock:
+            return self._streaks.get(pool, (0, 0.0))[0]
+
+    def tripped_pools(self, now: float | None = None) -> dict[str, int]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            stale = [
+                pool
+                for pool, (_, ts) in self._streaks.items()
+                if now - ts > self.stale_after_s
+            ]
+            for pool in stale:
+                # No rounds for a long time: the overload signal is gone
+                # with the pool; a dead pool must not shed the whole
+                # fleet's intake.
+                self._streaks.pop(pool, None)
+            return {
+                pool: n
+                for pool, (n, _) in self._streaks.items()
+                if n >= self.threshold
+            }
+
+    def check(self, now: float | None = None) -> tuple[bool, str]:
+        tripped = self.tripped_pools(now)
+        if not tripped:
+            return True, ""
+        detail = ", ".join(
+            f"{pool}: {n} consecutive truncated rounds"
+            for pool, n in sorted(tripped.items())
+        )
+        return False, f"roundDeadlinePressure: {detail}"
+
+    def __call__(self) -> bool:
+        return self.check()[0]

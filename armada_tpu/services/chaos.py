@@ -1,0 +1,367 @@
+"""Deterministic fault injection (chaos) for the control plane.
+
+The reference tests failure behavior ad hoc (killed executors, Pulsar
+outages, leader churn in integration environments); here fault injection is
+a first-class, SEEDED artifact so failure behavior is reproducible and
+assertable. A `FaultPlan` is a declarative schedule of faults on the same
+clock its components run on (virtual time in the simulator, wall clock in
+live agents); the same seed always yields the same plan, and every
+injection decision is a pure function of (plan state, query), so two runs
+of one seed produce identical histories — the property the chaos soak
+(tools/chaos_soak.py) asserts.
+
+Fault kinds:
+
+  executor_crash   the executor loses all local pod state and stops
+                   reporting for the window; on recovery it reports its
+                   leased runs as lost (missing-pod reconciliation)
+  executor_hang    the executor stops reporting but keeps state
+  lease_slow       lease exchanges are delayed (`param` seconds; the
+                   simulator models this by deferring lease pickup)
+  lease_timeout    lease RPCs fail with a timeout
+  torn_log_write   an event-log append "crashes" mid-record, leaving a
+                   torn tail for recovery to truncate
+  leader_flap      leadership is lost for the window
+
+Alongside the plan live the degradation primitives injected faults are
+met with: seeded exponential backoff with jitter (agent retry loop) and a
+per-executor circuit breaker (the server's lease path), so a faulty
+executor degrades its own lease flow instead of wedging a cycle.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass
+
+FAULT_KINDS = (
+    "executor_crash",
+    "executor_hang",
+    "lease_slow",
+    "lease_timeout",
+    "torn_log_write",
+    "leader_flap",
+)
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault: a window [start, start+duration) on a target
+    ("*" matches any). `count` bounds point-fault firings inside the
+    window (-1 = unlimited); `param` is kind-specific (delay seconds for
+    lease_slow, torn-byte fraction for torn_log_write)."""
+
+    kind: str
+    target: str = "*"
+    start: float = 0.0
+    duration: float = float("inf")
+    count: int = -1
+    param: float = 0.0
+
+    def matches(self, kind: str, target: str, now: float) -> bool:
+        return (
+            self.kind == kind
+            and (self.target == "*" or self.target == target)
+            and self.start <= now < self.start + self.duration
+        )
+
+
+class FaultPlan:
+    """A seeded, declarative schedule of faults.
+
+    Window queries (`active`) are pure; point-fault queries (`fire`)
+    consume from the spec's count — still deterministic for a fixed
+    sequence of queries, which a seeded run guarantees."""
+
+    def __init__(self, faults=(), seed: int = 0):
+        self.faults = tuple(faults)
+        self.seed = seed
+        for f in self.faults:
+            if f.kind not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {f.kind!r}")
+        self._fired = [0] * len(self.faults)
+        self._observed: set[int] = set()
+
+    def active(self, kind: str, target: str, now: float) -> FaultSpec | None:
+        """The first matching window fault, ignoring counts."""
+        for i, f in enumerate(self.faults):
+            if f.matches(kind, target, now):
+                self._observed.add(i)
+                return f
+        return None
+
+    def fire(self, kind: str, target: str, now: float) -> FaultSpec | None:
+        """Consume one firing of the first matching fault with budget
+        left; None when nothing fires."""
+        for i, f in enumerate(self.faults):
+            if not f.matches(kind, target, now):
+                continue
+            if f.count >= 0 and self._fired[i] >= f.count:
+                continue
+            self._fired[i] += 1
+            return f
+        return None
+
+    def fired(self) -> int:
+        """Point-fault firings plus window faults a component actually
+        hit — "how much chaos really landed" for soak reporting."""
+        return sum(self._fired) + len(self._observed)
+
+    @staticmethod
+    def generate(
+        seed: int,
+        duration: float,
+        executors=(),
+        kinds=None,
+        events_per_kind: int = 2,
+    ) -> "FaultPlan":
+        """A random-but-reproducible plan over [0, duration): same seed,
+        same plan. Executor faults pick targets from `executors`."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        kinds = tuple(kinds) if kinds is not None else FAULT_KINDS
+        executors = list(executors)
+        faults = []
+        for kind in kinds:
+            for _ in range(events_per_kind):
+                start = float(rng.uniform(0.0, duration * 0.7))
+                window = float(rng.uniform(duration * 0.05, duration * 0.2))
+                if kind.startswith(("executor", "lease")) and executors:
+                    target = str(executors[int(rng.integers(len(executors)))])
+                else:
+                    target = "*"
+                count = 2 if kind == "torn_log_write" else -1
+                param = float(rng.uniform(0.1, 0.9))
+                faults.append(
+                    FaultSpec(kind, target, start, window, count, param)
+                )
+        faults.sort(key=lambda f: (f.start, f.kind, f.target))
+        return FaultPlan(faults, seed=seed)
+
+
+class VirtualClock:
+    """Mutable clock shared between the simulator and chaos-aware
+    components (ChaosLeader, CrashRecoveringLog): the sim advances `now`,
+    everyone else reads it."""
+
+    def __init__(self, now: float = 0.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+
+class ChaosLeader:
+    """Leader-election wrapper honoring `leader_flap` windows: while a
+    flap is active this instance is not the leader and previously issued
+    tokens fail validation — exactly the mid-cycle-deposed-leader path
+    the token protocol guards (scheduler.cycle drops the publish)."""
+
+    def __init__(self, inner, plan: FaultPlan, clock=None):
+        self.inner = inner
+        self.plan = plan
+        self.clock = clock if clock is not None else _time.time
+
+    def _flapping(self) -> bool:
+        return self.plan.active("leader_flap", "leader", self.clock()) is not None
+
+    def get_token(self):
+        from .leader import LeaderToken
+
+        if self._flapping():
+            return LeaderToken(leader=False)
+        return self.inner.get_token()
+
+    def validate(self, token) -> bool:
+        if self._flapping():
+            return False
+        return self.inner.validate(token)
+
+    def __call__(self) -> bool:
+        return not self._flapping() and self.inner()
+
+    def is_holder(self) -> bool:
+        return not self._flapping() and self.inner.is_holder()
+
+    def leader_address(self) -> str:
+        return self.inner.leader_address()
+
+
+class ExponentialBackoff:
+    """Exponential backoff with seeded full jitter: delay_k ~ U(0,
+    min(cap, base * 2^k)). Seeded so retry schedules are reproducible in
+    chaos runs."""
+
+    def __init__(self, base_s: float = 0.5, cap_s: float = 30.0, seed: int = 0):
+        import numpy as np
+
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self._seed = seed
+        self._rng = np.random.default_rng(seed)
+        self.attempt = 0
+
+    def next_delay(self) -> float:
+        ceiling = min(self.cap_s, self.base_s * (2.0 ** self.attempt))
+        self.attempt += 1
+        return float(self._rng.uniform(0.0, ceiling))
+
+    def reset(self) -> None:
+        import numpy as np
+
+        self.attempt = 0
+        self._rng = np.random.default_rng(self._seed)
+
+
+class CircuitOpenError(RuntimeError):
+    """Raised by a guarded path while its circuit is open: the RPC
+    fast-fails (UNAVAILABLE on the wire, identically on both the JSON and
+    proto executor wires) and the caller's backoff loop absorbs it."""
+
+
+class CircuitBreaker:
+    """Per-key circuit breaker (the server's lease path keys by executor
+    name): closed -> open after `failure_threshold` consecutive failures;
+    after `cooldown_s` one probe is allowed (half-open) — success closes,
+    failure re-opens."""
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 30.0):
+        import threading
+
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown_s = cooldown_s
+        self._failures: dict[str, int] = {}
+        self._opened_at: dict[str, float] = {}
+        self._probing: set[str] = set()
+        # Touched from concurrent gRPC worker threads (one per in-flight
+        # lease RPC): check-then-act on the probe set and the failure
+        # counters must be atomic.
+        self._lock = threading.Lock()
+
+    def _state_locked(self, key: str, now: float) -> str:
+        if key not in self._opened_at:
+            return "closed"
+        if now - self._opened_at[key] >= self.cooldown_s:
+            return "half-open"
+        return "open"
+
+    def state(self, key: str, now: float | None = None) -> str:
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            return self._state_locked(key, now)
+
+    def allow(self, key: str, now: float | None = None) -> bool:
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            state = self._state_locked(key, now)
+            if state == "closed":
+                return True
+            if state == "half-open" and key not in self._probing:
+                self._probing.add(key)  # exactly one probe per cooldown
+                return True
+            return False
+
+    def record_success(self, key: str) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+            self._opened_at.pop(key, None)
+            self._probing.discard(key)
+
+    def record_failure(self, key: str, now: float | None = None) -> None:
+        now = _time.monotonic() if now is None else now
+        with self._lock:
+            count = self._failures.get(key, 0) + 1
+            self._failures[key] = count
+            self._probing.discard(key)
+            if count >= self.failure_threshold:
+                self._opened_at[key] = now
+
+
+class CrashRecoveringLog:
+    """A FileEventLog whose torn-write faults behave like process crashes.
+
+    Wraps a FileEventLog built with a FaultPlan-driven injector and
+    sync_every=1 (so the only record at risk is the one being torn). When
+    an append tears, the wrapper reopens the log — recovery truncates the
+    torn tail — and retries the publish: the at-least-once redelivery a
+    restarted publisher performs. Views keep their reference to the
+    wrapper across "crashes"."""
+
+    def __init__(self, directory: str, plan: FaultPlan | None = None,
+                 clock=None, **kwargs):
+        self.directory = directory
+        self.plan = plan
+        self.clock = clock if clock is not None else _time.time
+        self.crashes = 0
+        self._suppress_once = False
+        kwargs["sync_every"] = 1
+        self._kwargs = kwargs
+        self._open()
+
+    def _injector(self, data_len: int) -> int | None:
+        if self.plan is None or self._suppress_once:
+            # The retry immediately after a "crash" must succeed — an
+            # unlimited-count torn_log_write spec would otherwise re-fire
+            # on every retry and publish() would never terminate (the
+            # virtual clock cannot advance inside one publish).
+            self._suppress_once = False
+            return None
+        spec = self.plan.fire("torn_log_write", "log", self.clock())
+        if spec is None:
+            return None
+        frac = spec.param if 0.0 < spec.param < 1.0 else 0.5
+        return max(0, min(data_len - 1, int(data_len * frac)))
+
+    def _open(self):
+        from ..events.file_log import FileEventLog
+
+        self._inner = FileEventLog(
+            self.directory, fault_injector=self._injector, **self._kwargs
+        )
+
+    def publish(self, sequence) -> int:
+        from ..events.file_log import InjectedFault
+
+        while True:
+            try:
+                return self._inner.publish(sequence)
+            except InjectedFault:
+                self.crashes += 1
+                self._suppress_once = True  # the restarted retry lands
+                self._open()  # recovery truncates the torn tail
+
+    # -- delegation (the EventLog read surface) --
+
+    def read(self, cursor, limit: int = 1000):
+        return self._inner.read(cursor, limit)
+
+    def read_jobset(self, queue, jobset, cursor: int = 0):
+        return self._inner.read_jobset(queue, jobset, cursor)
+
+    @property
+    def end_offset(self) -> int:
+        return self._inner.end_offset
+
+    @property
+    def start_offset(self) -> int:
+        return self._inner.start_offset
+
+    @property
+    def dir(self):
+        return self._inner.dir
+
+    def compact(self, up_to: int) -> int:
+        return self._inner.compact(up_to)
+
+    def watcher(self):
+        return self._inner.watcher()
+
+    def remove_watcher(self, cond):
+        return self._inner.remove_watcher(cond)
+
+    def flush(self):
+        return self._inner.flush()
+
+    def close(self):
+        return self._inner.close()

@@ -308,10 +308,17 @@ class ExecutorAgent:
         pool: str = "default",
         runtime: _PodRuntime | None = None,
         node_info=None,
+        fault_plan=None,
+        backoff=None,
     ):
         self.client = client
         self.name = name
         self.pool = pool
+        # Deterministic fault injection (services/chaos.py) + the retry
+        # backoff the injected faults are met with in run().
+        self.fault_plan = fault_plan
+        self.backoff = backoff
+        self._crashed = False
         # Node classification (executor/node/node_group.go): derive each
         # node's pool (label + reserved suffix) and node type up front so
         # heartbeats carry them.
@@ -333,8 +340,32 @@ class ExecutorAgent:
         # that would overwrite the real terminal reason.
         self._reported_terminal: set[str] = set()
 
+    def _inject_faults(self, now: float) -> None:
+        """Apply the fault plan before the lease exchange; raises to
+        simulate the failure (run()'s backoff loop absorbs it)."""
+        plan = self.fault_plan
+        if plan is None:
+            return
+        if plan.active("executor_crash", self.name, now) is not None:
+            if not self._crashed:
+                for run_id in list(self.runtime.pods):
+                    self.runtime.kill(run_id)
+                self.acked.clear()
+                self._reported_terminal.clear()
+                self._crashed = True
+            raise RuntimeError("executor crashed (injected fault)")
+        self._crashed = False
+        if plan.active("executor_hang", self.name, now) is not None:
+            raise RuntimeError("executor hung (injected fault)")
+        if plan.active("lease_timeout", self.name, now) is not None:
+            raise TimeoutError("lease RPC timed out (injected fault)")
+        slow = plan.active("lease_slow", self.name, now)
+        if slow is not None and slow.param > 0:
+            time.sleep(min(slow.param, 5.0))
+
     def tick(self, now: float | None = None) -> dict:
         now = time.time() if now is None else now
+        self._inject_faults(now)
         self.utilisation.sample(self.runtime.pods)
         reply = self.client._call(
             "ExecutorLease",
@@ -406,7 +437,8 @@ class ExecutorAgent:
         # terminal reports join only AFTER ReportEvents succeeds below —
         # a failed send must leave the run eligible for missing-pod
         # reconciliation (the event was lost; reconciliation is the
-        # retry path).
+        # retry path). (A server with an open lease circuit fails the RPC
+        # above — a degraded reply can never reach this bookkeeping.)
         self._reported_terminal &= active_ids
         for run in reply.get("active_runs", []):
             if (
@@ -438,11 +470,33 @@ class ExecutorAgent:
         return reply
 
     def run(self, interval: float = 1.0):
+        """The agent loop: retry with exponential backoff + jitter on any
+        tick failure (control-plane hiccup, injected fault), reset on the
+        first success — transient faults cost one delayed tick, sustained
+        ones back off toward the cap instead of hammering the server."""
+        import zlib
+
+        from .chaos import ExponentialBackoff
+
+        # Seeded per executor: a fleet-wide outage must NOT synchronize
+        # every agent's retry instants (decorrelated jitter).
+        backoff = self.backoff or ExponentialBackoff(
+            base_s=max(interval, 0.1),
+            cap_s=60.0,
+            seed=zlib.crc32(self.name.encode()),
+        )
         while True:
             try:
                 self.tick()
-            except Exception as e:  # control plane hiccup: retry next tick
-                print(f"executor {self.name}: tick failed: {e!r}")
+            except Exception as e:  # control plane hiccup: back off + retry
+                delay = backoff.next_delay()
+                print(
+                    f"executor {self.name}: tick failed: {e!r}; "
+                    f"retrying in {delay:.1f}s (attempt {backoff.attempt})"
+                )
+                time.sleep(delay)
+                continue
+            backoff.reset()
             time.sleep(interval)
 
 

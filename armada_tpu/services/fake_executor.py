@@ -92,11 +92,16 @@ class FakeExecutor:
         issue_for=None,
         non_framework_usage: dict | None = None,
         usage_fn=None,
+        fault_plan=None,
     ):
         self.name = name
         self.log = log
         self.scheduler = scheduler
         self.pool = pool
+        # Deterministic fault injection (services/chaos.py): crash/hang
+        # windows silence the executor; lease faults defer lease pickup.
+        self.fault_plan = fault_plan
+        self._crashed = False
         self.nodes = nodes if nodes is not None else make_nodes(name, pool=pool)
         self.runtime_for = runtime_for
         self.startup_delay = startup_delay
@@ -198,10 +203,64 @@ class FakeExecutor:
                 return True
         return False
 
+    def _chaos_gate(self, now: float) -> bool:
+        """Apply the fault plan; returns True when this tick is silenced
+        (crash or hang window active)."""
+        plan = self.fault_plan
+        if plan is None:
+            return False
+        if plan.active("executor_crash", self.name, now) is not None:
+            if not self._crashed:
+                # Crash start: all local pod state is lost; leases must be
+                # re-accepted (or re-leased) after recovery.
+                self.active.clear()
+                self._issues.clear()
+                self._seen_runs.clear()
+                self._crashed = True
+            return True
+        if self._crashed:
+            # First tick after the crash window: the agent's missing-pod
+            # reconciliation — runs the jobdb still shows on this executor
+            # have no pod here; report them lost so the scheduler retries.
+            self._crashed = False
+            txn = self.scheduler.jobdb.read_txn()
+            for job in txn.leased_jobs():
+                run = job.latest_run
+                if run is None or run.executor != self.name:
+                    continue
+                self._seen_runs.add(run.id)  # never re-adopt a dead run
+                self.log.publish(
+                    EventSequence.of(
+                        job.queue,
+                        job.jobset,
+                        JobRunErrors(
+                            created=now,
+                            job_id=job.id,
+                            run_id=run.id,
+                            error=(
+                                "pod missing on executor "
+                                "(crash recovery reconciliation)"
+                            ),
+                            retryable=True,
+                        ),
+                    )
+                )
+        return plan.active("executor_hang", self.name, now) is not None
+
     def tick(self, now: float):
         """Advance pod lifecycle; emit state-transition events."""
+        if self._chaos_gate(now):
+            return
         self.heartbeat(now)
-        self.accept_leases(now)
+        lease_fault = self.fault_plan is not None and (
+            self.fault_plan.active("lease_slow", self.name, now) is not None
+            or self.fault_plan.active("lease_timeout", self.name, now)
+            is not None
+        )
+        if not lease_fault:
+            # Slow/timed-out lease exchanges defer pickup to a later tick
+            # (leases stay unacked; the server re-sends — at-least-once).
+            self.accept_leases(now)
         self._check_pod_issues(now)
         txn = self.scheduler.jobdb.read_txn()
         for run in list(self.active.values()):

@@ -279,6 +279,16 @@ class ApiServer:
         # trusted in-process deployments).
         self.auth = auth
         self.authorizer = authorizer
+        # Per-executor circuit breaker on the lease path: an executor whose
+        # exchanges keep failing (malformed payloads, injected faults) gets
+        # fast-failed with UNAVAILABLE for a cooldown — absorbed by the
+        # agent's backoff loop — instead of repeatedly erroring a worker
+        # thread mid-cycle (services/chaos.py).
+        from .chaos import CircuitBreaker
+
+        self.lease_breaker = CircuitBreaker(
+            failure_threshold=3, cooldown_s=30.0
+        )
 
     def _authorize(self, method: str, principal, req: dict):
         """Per-method permission gate (the reference's auth interceptors +
@@ -472,6 +482,28 @@ class ApiServer:
     # pkg/executorapi/executorapi.proto:106-115) ----
 
     def _executor_lease(self, req):
+        """One heartbeat exchange behind the per-executor circuit breaker:
+        open circuits fast-fail the RPC (UNAVAILABLE — wire-agnostic: a
+        reply-payload flag would be dropped by the proto LeaseResponse
+        schema) and the agent's backoff loop absorbs it; failures count
+        toward opening; a success closes the circuit."""
+        from .chaos import CircuitOpenError
+
+        name = req.get("executor", "")
+        if not self.lease_breaker.allow(name):
+            raise CircuitOpenError(
+                f"lease circuit open for executor {name!r}; retry after "
+                f"{self.lease_breaker.cooldown_s:.0f}s cooldown"
+            )
+        try:
+            reply = self._executor_lease_inner(req)
+        except Exception:
+            self.lease_breaker.record_failure(name)
+            raise
+        self.lease_breaker.record_success(name)
+        return reply
+
+    def _executor_lease_inner(self, req):
         """One heartbeat exchange: the executor reports its nodes and acked
         run ids; the reply carries new leases and runs to cancel/preempt."""
         from ..core.types import NodeSpec, Taint
@@ -822,12 +854,16 @@ class ApiServer:
             if req_tf is not None:
                 req = req_tf(req)
             gate(method, req, context)
+            from .chaos import CircuitOpenError
+
             try:
                 out = fn(req) or {}
             except KeyError as e:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except CircuitOpenError as e:
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             resp_tf = resp_transforms.get(method)
             if resp_tf is not None:
                 out = resp_tf(out)
@@ -951,6 +987,8 @@ class ApiServer:
                     return None
 
                 def unary(request, context):
+                    from .chaos import CircuitOpenError
+
                     req = _decode(request)
                     gate(method, req, context)
                     try:
@@ -959,6 +997,8 @@ class ApiServer:
                         context.abort(grpc.StatusCode.NOT_FOUND, str(e))
                     except ValueError as e:
                         context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                    except CircuitOpenError as e:
+                        context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
 
                 return grpc.unary_unary_rpc_method_handler(
                     unary, request_deserializer=bytes, response_serializer=bytes

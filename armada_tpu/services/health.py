@@ -64,6 +64,34 @@ class HeartbeatChecker:
         return ok, f"last beat {age:.1f}s ago (timeout {self.timeout_s}s)"
 
 
+class BackpressureChecker:
+    """Adapts a backpressure monitor (check() -> (healthy, reason); see
+    services/backpressure.py — StoreHealthMonitor, RoundDeadlinePressure)
+    into a named health checker with the monitor's reason attached.
+
+    advisory=True reports the tripped reason in the /health payload
+    WITHOUT failing the aggregate: round-deadline pressure means the
+    scheduler is degrading as designed (committing partial rounds, still
+    making progress) — failing the liveness probe for it would invite an
+    orchestrator restart loop that helps nothing. Intake shedding for
+    such signals belongs on the submit gate (CompositeGate), not
+    liveness."""
+
+    def __init__(self, name: str, monitor, advisory: bool = False):
+        self.name = name
+        self.monitor = monitor
+        self.advisory = advisory
+
+    def check(self) -> tuple[bool, str]:
+        try:
+            healthy, reason = self.monitor.check()
+        except Exception as e:  # a crashing monitor is unhealthy
+            return False, f"monitor raised: {e!r}"
+        if not healthy and self.advisory:
+            return True, f"advisory (degraded but live): {reason}"
+        return bool(healthy), reason or "ok"
+
+
 class MultiChecker:
     """health/multi_checker.go: all registered checkers must pass."""
 

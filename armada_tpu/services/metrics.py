@@ -163,6 +163,21 @@ class SchedulerMetrics:
             ["pool", "shape"],
             registry=r,
         )
+        # Round-deadline guardrail (maxSchedulingDuration): rounds cut by
+        # the budget, and the consecutive-truncation streak that trips
+        # per-pool backpressure (backpressure.RoundDeadlinePressure).
+        self.truncated_rounds = Counter(
+            "scheduler_rounds_truncated_total",
+            "Scheduling rounds truncated by maxSchedulingDuration",
+            ["pool"],
+            registry=r,
+        )
+        self.round_truncation_streak = Gauge(
+            "scheduler_round_truncation_streak",
+            "Consecutive truncated rounds per pool",
+            ["pool"],
+            registry=r,
+        )
         self.executor_heartbeat_age = Gauge(
             "scheduler_executor_heartbeat_age_seconds",
             "Seconds since each executor's last heartbeat",

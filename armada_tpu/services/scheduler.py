@@ -115,6 +115,17 @@ class SchedulerService:
 
         self.reports = SchedulingReportsRepository()
         self.metrics = None  # set via attach_metrics
+        # Round-deadline guardrail (maxSchedulingDuration): wall-clock
+        # deadline for the current cycle's rounds, armed per cycle in
+        # _schedule_all_pools; pools share the budget in round order.
+        self._round_deadline: float | None = None
+        from .backpressure import RoundDeadlinePressure
+
+        # Repeated truncation trips per-pool backpressure; surfaced via
+        # the health multi-checker and submit-side shedding (server.py).
+        self.round_pressure = RoundDeadlinePressure(
+            config.truncated_rounds_backpressure
+        )
         # Market mode: bid-price provider + last applied snapshot
         # (scheduler.go:540-585 updateBidPrices; bids are not event-sourced,
         # a restarted leader re-fetches).
@@ -413,6 +424,13 @@ class SchedulerService:
         All shared mutable inputs are snapshotted up front: this may run on
         the async runner's background thread while gRPC/cycle threads mutate
         the originals."""
+        # Arm the round deadline: every pool's round this cycle draws from
+        # one budget (the reference's maxSchedulingDuration bounds the whole
+        # scheduling round, config.yaml:105).
+        budget = self.config.max_scheduling_duration_s
+        self._round_deadline = (
+            _time.monotonic() + budget if budget > 0 else None
+        )
         executors = dict(self.executors)
         cordoned = set(self.cordoned_queues)
         overrides = dict(self.priority_overrides)
@@ -901,6 +919,31 @@ class SchedulerService:
             )
         solve_started = _time.time()
         result = self._solve(snap, inc=inc)
+        # Round-deadline guardrail: a truncated round still commits the
+        # partial placement below (queued placements are a prefix of the
+        # full round's decisions; evicted running jobs got their pinned
+        # rebind via the solver's rescue pass, so no extra preemptions);
+        # unplaced jobs stay QUEUED and the next cycle resumes from the
+        # truncation point via the jobdb. Repeated truncation trips
+        # per-pool backpressure.
+        truncated = bool(result.get("truncated", False))
+        self.round_pressure.note_round(pool, truncated)
+        if truncated:
+            self.log_.with_fields(
+                cycle=self.cycle_count,
+                pool=pool,
+                streak=self.round_pressure.streak(pool),
+                loops=result.get("num_loops", 0),
+            ).warning(
+                "scheduling round truncated by maxSchedulingDuration; "
+                "committing partial placement"
+            )
+        if self.metrics is not None and self.metrics.registry is not None:
+            if truncated:
+                self.metrics.truncated_rounds.labels(pool=pool).inc()
+            self.metrics.round_truncation_streak.labels(pool=pool).set(
+                self.round_pressure.streak(pool)
+            )
         # Spend rate-limit tokens on newly scheduled jobs (ReserveN in the
         # reference, gang_scheduler.go:118-123); rescheduled evictees are
         # free (scheduled_mask covers new work only).
@@ -918,7 +961,11 @@ class SchedulerService:
             self._queue_rate_tokens[(pool, qn)] = max(
                 0.0, tokens - by_queue.get(qn, 0)
             )
-        if self.config.optimiser is not None and self.config.optimiser.enabled:
+        if (
+            self.config.optimiser is not None
+            and self.config.optimiser.enabled
+            and not truncated  # budget already spent: skip the post-pass
+        ):
             # Experimental fairness-improvement pass over the solved round
             # (scheduling/optimiser/, preempting_queue_scheduler.go:659-702);
             # mutates the result arrays with its extra decisions.
@@ -1314,7 +1361,18 @@ class SchedulerService:
         inc.bind(binds)
         st["serial"] = self.jobdb.serial
 
+    def _remaining_budget(self) -> float | None:
+        """Wall-clock left of this cycle's scheduling budget (None when no
+        deadline is configured). Floored just above zero so a later pool's
+        round still starts — the solvers' forward-progress floor then runs
+        one loop and truncates, committing evicted rebinds instead of
+        skipping the pool silently."""
+        if self._round_deadline is None:
+            return None
+        return max(1e-9, self._round_deadline - _time.monotonic())
+
     def _solve(self, snap, inc=None):
+        budget_s = self._remaining_budget()
         if self.backend == "kernel":
             from ..solver.kernel import solve_round
             from ..solver.kernel_prep import pad_device_round, prep_device_round
@@ -1326,12 +1384,17 @@ class SchedulerService:
             else:
                 dev = pad_device_round(prep_device_round(snap))
             if self.mesh is not None:
+                # The sharded solve is one fused program; the budget is
+                # enforced between pools only (chunked pass 1 is
+                # single-device for now).
                 from ..parallel.mesh import pad_nodes
 
                 run = self._resolve_sharded_run()
                 out = run(pad_nodes(dev, self._mesh_size))
+                out["truncated"] = False
             else:
-                out = solve_round(dev)
+                out = solve_round(dev, budget_s=budget_s)
+            truncated = bool(out.get("truncated", False))
             J, Q = snap.num_jobs, snap.num_queues
             return {
                 "assigned_node": out["assigned_node"][:J],
@@ -1341,7 +1404,9 @@ class SchedulerService:
                 "fair_share": out["fair_share"][:Q],
                 "demand_capped_fair_share": out["demand_capped_fair_share"][:Q],
                 "unschedulable_reason": None,
-                "termination_reason": "",
+                "termination_reason": "round_truncated" if truncated else "",
+                "truncated": truncated,
+                "num_loops": int(out["num_loops"]),
                 "spot_price": (
                     None
                     if np.isnan(float(out["spot_price"]))
@@ -1350,7 +1415,7 @@ class SchedulerService:
             }
         from ..solver.reference import ReferenceSolver
 
-        res = ReferenceSolver(snap).solve()
+        res = ReferenceSolver(snap).solve(budget_s=budget_s)
         return {
             "spot_price": res.spot_price,
             "assigned_node": res.assigned_node,
@@ -1361,6 +1426,8 @@ class SchedulerService:
             "demand_capped_fair_share": res.demand_capped_fair_share,
             "unschedulable_reason": res.unschedulable_reason,
             "termination_reason": res.termination_reason,
+            "truncated": res.truncated,
+            "num_loops": res.num_loops,
         }
 
     def _record_round(self, pool, snap, result, started, indicative=None,

@@ -78,9 +78,18 @@ class ControlPlane:
             self.config, self.log, backend=backend, is_leader=self.leader,
             checkpoint=_ckpt("scheduler"),
         )
+        # Submit-side shedding consumes store capacity AND round-deadline
+        # pressure (repeated maxSchedulingDuration truncations) through one
+        # gate: sustained overload sheds intake instead of growing the
+        # backlog unboundedly.
+        from .backpressure import CompositeGate
+
+        self.submit_gate = CompositeGate(
+            self.store_health, self.scheduler.round_pressure
+        )
         self.submit = SubmitService(
             self.config, self.log, scheduler=self.scheduler,
-            checkpoint=_ckpt("submit"), store_health=self.store_health,
+            checkpoint=_ckpt("submit"), store_health=self.submit_gate,
         )
         if self.store_health is not None:
             self.store_health.add_lag_source(
@@ -172,6 +181,7 @@ class ControlPlane:
             )
         # Health surface (common/health; schedulerapp.go:71-75).
         from .health import (
+            BackpressureChecker,
             FuncChecker,
             HeartbeatChecker,
             MultiChecker,
@@ -198,7 +208,20 @@ class ControlPlane:
             self.store_health.add_lag_source(
                 "lookout", lambda: self.lookout_store.lag_events
             )
-            checkers.append(FuncChecker("store", self.store_health.check))
+            checkers.append(
+                BackpressureChecker("store", self.store_health)
+            )
+        # Round-deadline pressure surfaces in /health as ADVISORY detail:
+        # a pool truncating round after round is degraded (and sheds
+        # intake via the submit gate above), but it is live and making
+        # bounded progress — it must not trip the liveness probe into a
+        # restart loop (services/backpressure.RoundDeadlinePressure).
+        checkers.append(
+            BackpressureChecker(
+                "round-deadline", self.scheduler.round_pressure,
+                advisory=True,
+            )
+        )
         self.health = MultiChecker(*checkers)
         self.health_server = None
         if health_port is not None:

@@ -114,16 +114,42 @@ class Simulator:
         seed: int = 0,
         cycle_interval: float = 10.0,
         max_time: float = 7 * 24 * 3600.0,
+        fault_plan=None,
+        data_dir: str | None = None,
     ):
         self.config = config or SchedulingConfig()
         self.rng = np.random.default_rng(seed)
         self.cycle_interval = cycle_interval
         self.max_time = max_time
 
-        self.log = InMemoryEventLog()
+        # Deterministic chaos (services/chaos.py): the plan runs on the
+        # sim's VIRTUAL clock, so injected faults land at the same instants
+        # every run of a seed. With data_dir the event log is file-backed
+        # and torn-write faults exercise real crash recovery.
+        self.fault_plan = fault_plan
+        self.chaos_clock = None
+        is_leader = lambda: True  # noqa: E731
+        if fault_plan is not None:
+            from ..services.chaos import ChaosLeader, VirtualClock
+            from ..services.leader import StandaloneLeader
+
+            self.chaos_clock = VirtualClock()
+            is_leader = ChaosLeader(
+                StandaloneLeader(), fault_plan, clock=self.chaos_clock
+            )
+        if data_dir is not None:
+            from ..services.chaos import CrashRecoveringLog, VirtualClock
+
+            if self.chaos_clock is None:
+                self.chaos_clock = VirtualClock()
+            self.log = CrashRecoveringLog(
+                data_dir, fault_plan, clock=self.chaos_clock
+            )
+        else:
+            self.log = InMemoryEventLog()
         self.scheduler = SchedulerService(
             self.config, self.log, backend=backend, mesh=mesh,
-            snapshot_mode=snapshot_mode,
+            snapshot_mode=snapshot_mode, is_leader=is_leader,
         )
         self.submit = SubmitService(self.config, self.log, scheduler=self.scheduler)
 
@@ -155,6 +181,7 @@ class Simulator:
                     nodes=nodes,
                     pool=spec.pool,
                     runtime_for=lambda job_id: self._runtimes.get(job_id, 60.0),
+                    fault_plan=fault_plan,
                 )
             )
 
@@ -204,6 +231,8 @@ class Simulator:
         finished = 0
 
         while t <= self.max_time:
+            if self.chaos_clock is not None:
+                self.chaos_clock.now = t
             # Submit everything due by t.
             while (
                 sub_idx < len(self._pending_submissions)
@@ -231,15 +260,23 @@ class Simulator:
             if all_submitted and states and finished == len(states):
                 break
 
-            # Advance virtual time: next interesting instant.
+            # Advance virtual time: next interesting instant. Only FUTURE
+            # instants count — a hung/crashed executor (chaos) can hold
+            # runs whose finish time already passed; pinning on those
+            # would freeze the clock.
             nxt = t + self.cycle_interval
             for ex in self.executors:
                 for run in ex.active.values():
                     if not run.running_reported:
-                        nxt = min(nxt, run.started + ex.startup_delay)
-                    nxt = min(nxt, run.finishes_at)
+                        started = run.started + ex.startup_delay
+                        if started > t:
+                            nxt = min(nxt, started)
+                    if run.finishes_at > t:
+                        nxt = min(nxt, run.finishes_at)
             if sub_idx < len(self._pending_submissions):
-                nxt = min(nxt, self._pending_submissions[sub_idx][0])
+                due = self._pending_submissions[sub_idx][0]
+                if due > t:
+                    nxt = min(nxt, due)
             t = max(nxt, t + 1e-9)
 
         txn = self.scheduler.jobdb.read_txn()

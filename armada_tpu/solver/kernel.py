@@ -648,11 +648,14 @@ def _slot_min_prio(dev, carry, s):
     return jnp.min(jnp.where(mask, carry.job_prio[safe], jnp.int32(2**31 - 1)))
 
 
-def _schedule_pass(
+def _pass_segment(
     dev,
     dist,
     carry: Carry,
+    ptr0,
+    fs0,
     budgets,
+    loop_cap,
     *,
     include_queued: bool,
     use_key_skip: bool,
@@ -670,7 +673,16 @@ def _schedule_pass(
     newly registered unfeasible key — rare), because those can invalidate
     other queues' heads; everything else that validity depends on is either
     static within the pass (all-evicted membership: evictions happen between
-    passes) or behind the pointers already (consumed slots)."""
+    passes) or behind the pointers already (consumed slots).
+
+    This is one resumable SEGMENT of the pass: it continues from
+    (carry, ptr0, fs0) and stops once `carry.loops` reaches `loop_cap`
+    (or the pass completes, carry.stop). The round-deadline path runs the
+    pass as a sequence of segments with a wall-clock check between them;
+    the segment boundary is a while-iteration boundary, where gang
+    attempts are complete, so per-chunk recomputation of the all-evicted
+    flags and the fair-preemption order is value-identical for every slot
+    still PENDING."""
     Q = dev.queue_slot_start.shape[0]
     S = dev.slot_members.shape[0]
     # Fill fast path is statically compiled in only for the queued pass of a
@@ -687,12 +699,13 @@ def _schedule_pass(
         c, ptr, _ = state
         # Every iteration either consumes >=1 slot, flips a validity flag,
         # or arms force-serial for the next one: 2S+4 bounds the loop even
-        # with fill-miss/serial-retry pairs.
-        return ~c.stop & (c.loops < 2 * S + 4)
+        # with fill-miss/serial-retry pairs. loop_cap cuts earlier when a
+        # round budget is in force (solve_round's chunked driver).
+        return ~c.stop & (c.loops < loop_cap) & (c.loops < 2 * S + 4)
 
     # all-evicted flags are stable within a pass: evictions happen between
     # passes, and a rescheduled member's slot is the one being consumed.
-    valid0, all_ev_flags = _slot_validity(dev, carry, include_queued, use_key_skip)
+    _, all_ev_flags = _slot_validity(dev, carry, include_queued, use_key_skip)
     # Fair-preemption walk order: one sort per pass, not per member select.
     fp_order = fair_preemption_order(carry)
 
@@ -1495,13 +1508,46 @@ def _schedule_pass(
             fs = jnp.zeros((), bool)
         return c._replace(loops=c.loops + 1), ptr, fs
 
+    carry, ptr, fs = jax.lax.while_loop(cond, body, (carry, ptr0, fs0))
+    return carry, ptr, fs
+
+
+def _pass_init_ptrs(dev, carry, include_queued, use_key_skip):
+    """Initial head pointers for a pass: first valid slot per queue."""
+    valid0, _ = _slot_validity(dev, carry, include_queued, use_key_skip)
+    heads0, has0 = _queue_heads(dev, valid0)
+    return jnp.where(has0, heads0, dev.queue_slot_end)
+
+
+def _schedule_pass(
+    dev,
+    dist,
+    carry: Carry,
+    budgets,
+    *,
+    include_queued: bool,
+    use_key_skip: bool,
+    consider_priority: bool,
+    prefer_large: bool,
+):
+    """One full (un-budgeted) pass: init pointers, run to completion."""
+    S = dev.slot_members.shape[0]
+    ptr0 = _pass_init_ptrs(dev, carry, include_queued, use_key_skip)
     # The counter restarts per pass (the reference's loopNumber is also
     # per-QueueScheduler, queue_scheduler.go:99).
-    heads0, has0 = _queue_heads(dev, valid0)
-    ptr0 = jnp.where(has0, heads0, dev.queue_slot_end)
     carry = carry._replace(stop=jnp.zeros((), bool), loops=jnp.zeros((), jnp.int32))
-    carry, _, _ = jax.lax.while_loop(
-        cond, body, (carry, ptr0, jnp.zeros((), bool))
+    carry, _, _ = _pass_segment(
+        dev,
+        dist,
+        carry,
+        ptr0,
+        jnp.zeros((), bool),
+        budgets,
+        2 * S + 4,
+        include_queued=include_queued,
+        use_key_skip=use_key_skip,
+        consider_priority=consider_priority,
+        prefer_large=prefer_large,
     )
     return carry
 
@@ -1652,7 +1698,10 @@ def _gang_complete_mask(dev, carry: Carry, evict_mask):
     return evict_mask | (add & bound)
 
 
-def solve_impl(dev: DeviceRound, dist=LOCAL):
+def _round_setup(dev: DeviceRound, dist=LOCAL):
+    """Fair shares, initial carry, balance eviction, eviction ranks —
+    everything before pass 1. Returns
+    (carry, budgets, fair_share, demand_capped, uncapped)."""
     J = dev.job_req.shape[0]
     Q = dev.queue_weight.shape[0]
     S = dev.slot_members.shape[0]
@@ -1743,18 +1792,16 @@ def solve_impl(dev: DeviceRound, dist=LOCAL):
     evict0 = _gang_complete_mask(dev, carry, evict0)
     carry = _apply_evictions(dev, dist, carry, evict0)
     carry = _assign_evict_ranks(dev, carry, budgets, dev.prefer_large)
+    return carry, budgets, fair_share, demand_capped, uncapped
 
-    # 2. Pass 1: evicted + queued.
-    carry = _schedule_pass(
-        dev,
-        dist,
-        carry,
-        budgets,
-        include_queued=True,
-        use_key_skip=True,
-        consider_priority=False,
-        prefer_large=dev.prefer_large,
-    )
+
+def _round_finish(
+    dev: DeviceRound, dist, carry, budgets, fair_share, demand_capped, uncapped
+):
+    """Steps 3-5 after pass 1: oversubscription eviction, pass 2 and
+    finalization into the result dict."""
+    J = dev.job_req.shape[0]
+    Q = dev.queue_weight.shape[0]
 
     # 3. Oversubscription eviction.
     over = _oversubscribed_mask(dev, dist, carry)
@@ -1821,10 +1868,163 @@ def solve_impl(dev: DeviceRound, dist=LOCAL):
     }
 
 
+def solve_impl(dev: DeviceRound, dist=LOCAL):
+    carry, budgets, fair_share, demand_capped, uncapped = _round_setup(dev, dist)
+
+    # 2. Pass 1: evicted + queued.
+    carry = _schedule_pass(
+        dev,
+        dist,
+        carry,
+        budgets,
+        include_queued=True,
+        use_key_skip=True,
+        consider_priority=False,
+        prefer_large=dev.prefer_large,
+    )
+    return _round_finish(
+        dev, dist, carry, budgets, fair_share, demand_capped, uncapped
+    )
+
+
 _solve = jax.jit(solve_impl)
 
 
-def solve_round(dev: DeviceRound):
-    """Run the jitted round solve; returns numpy outputs."""
-    out = _solve(dev)
-    return {k: np.asarray(v) for k, v in out.items()}
+# ---------------------------------------------------------------------------
+# Budget-aware (round-deadline) driver: the pass-1 while_loop runs as a
+# sequence of jitted SEGMENTS with a host-side wall-clock check between
+# them. The decision stream is identical to the fused program's (segment
+# boundaries are while-iteration boundaries), so a truncated round's
+# QUEUED placements are a strict prefix of the full round's; evicted
+# running jobs get their pinned rebind attempt in the finish's rescue
+# pass, so truncation also never preempts a running job the full round
+# would have kept (truncated preemptions ⊆ full preemptions).
+# ---------------------------------------------------------------------------
+
+
+def _pass1_begin_impl(dev: DeviceRound):
+    carry, budgets, fair_share, demand_capped, uncapped = _round_setup(dev)
+    ptr0 = _pass_init_ptrs(dev, carry, True, True)
+    carry = carry._replace(
+        stop=jnp.zeros((), bool), loops=jnp.zeros((), jnp.int32)
+    )
+    return carry, ptr0, budgets, fair_share, demand_capped, uncapped
+
+
+def _pass1_chunk_impl(dev: DeviceRound, carry, ptr, fs, budgets, loop_cap):
+    return _pass_segment(
+        dev,
+        LOCAL,
+        carry,
+        ptr,
+        fs,
+        budgets,
+        loop_cap,
+        include_queued=True,
+        use_key_skip=True,
+        consider_priority=False,
+        prefer_large=dev.prefer_large,
+    )
+
+
+def _finish_impl(dev: DeviceRound, carry, budgets, fair_share, demand_capped,
+                 uncapped):
+    # Rescue pass for truncated rounds: pass 1 evicts running jobs up
+    # front, so stopping it early would finalize evicted-but-never-
+    # attempted jobs as PREEMPTED — mass preemption, not degradation. An
+    # evicted-only pass gives every still-pending evicted slot its pinned
+    # rebind attempt (evicted jobs only ever return to their own node,
+    # _select_node). After a COMPLETE pass 1 no pending evicted slots
+    # remain and this is a structural no-op. Rebind capacity at the
+    # truncation point is a superset of what the full round's later
+    # attempts would see, so truncated preemptions are a subset of the
+    # full round's.
+    loops0 = carry.loops
+    carry = _schedule_pass(
+        dev,
+        LOCAL,
+        carry,
+        budgets,
+        include_queued=False,
+        use_key_skip=False,
+        consider_priority=False,
+        prefer_large=dev.prefer_large,
+    )
+    carry = carry._replace(loops=loops0 + carry.loops)
+    return _round_finish(
+        dev, LOCAL, carry, budgets, fair_share, demand_capped, uncapped
+    )
+
+
+_pass1_begin = jax.jit(_pass1_begin_impl)
+_pass1_chunk = jax.jit(_pass1_chunk_impl)
+_round_finish_jit = jax.jit(_finish_impl)
+
+
+def solve_round(
+    dev: DeviceRound,
+    *,
+    budget_s: float | None = None,
+    chunk_loops: int = 1,
+):
+    """Run the round solve; returns numpy outputs plus a `truncated` flag.
+
+    budget_s=None (default) runs the single fused XLA program exactly as
+    before. With a budget, pass 1 runs in chunks of while-loop iterations
+    (fill loops) with the wall clock checkpointed between chunks; once the
+    budget is spent the pass stops yielding new loops, the oversubscription
+    repair + pass 2 + finalize still run (they only rebind evicted running
+    jobs — cheap, and required for a committable result), and the caller
+    gets `truncated=True`. The chunk size starts at `chunk_loops` (default
+    1: at most one fill loop of slack past the deadline) and adapts upward
+    only while per-loop time is far below the budget, so fast serial
+    regimes don't pay a host sync per iteration.
+    """
+    if not budget_s or budget_s <= 0:
+        # No budget: the single fused program, and no `truncated` key —
+        # existing consumers iterate the result's array-valued keys.
+        out = _solve(dev)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    import time as _time
+
+    deadline = _time.monotonic() + float(budget_s)
+    # One upload: every chunk reuses the resident round tensors instead of
+    # re-transferring the host arrays per segment.
+    dev = jax.device_put(dev)
+    carry, ptr, budgets, fair_share, demand_capped, uncapped = _pass1_begin(dev)
+    fs = jnp.zeros((), bool)
+    S = int(dev.slot_members.shape[0])
+    hard_cap = 2 * S + 4
+    chunk = max(1, int(chunk_loops))
+    truncated = False
+    while True:
+        jax.block_until_ready(carry.loops)
+        loops = int(np.asarray(carry.loops))
+        if bool(np.asarray(carry.stop)) or loops >= hard_cap:
+            break
+        # Forward-progress floor: even a budget spent before the first
+        # loop (snapshot build ate it) runs ONE loop, so a persistently
+        # tiny budget drains the backlog instead of starving it.
+        if loops > 0 and _time.monotonic() >= deadline:
+            truncated = True
+            break
+        t0 = _time.monotonic()
+        carry, ptr, fs = _pass1_chunk(
+            dev, carry, ptr, fs, budgets,
+            jnp.int32(min(loops + chunk, hard_cap)),
+        )
+        jax.block_until_ready(carry.loops)
+        executed = max(1, int(np.asarray(carry.loops)) - loops)
+        per_loop = (_time.monotonic() - t0) / executed
+        # Re-check the clock roughly every budget/8 while never batching
+        # more than one loop when a single loop exceeds that interval
+        # (the burst regime), keeping overshoot to one fill loop.
+        target = max(float(budget_s) / 8.0, 0.02)
+        chunk = max(1, min(int(target / max(per_loop, 1e-7)), 4096))
+    out = _round_finish_jit(
+        dev, carry, budgets, fair_share, demand_capped, uncapped
+    )
+    out = {k: np.asarray(v) for k, v in out.items()}
+    out["truncated"] = truncated
+    return out

@@ -33,6 +33,7 @@ Known deliberate deviations from the Go reference (documented, small):
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -194,6 +195,11 @@ class ReferenceSolver:
         self.termination_reason = ""
         self.num_loops = 0
         self.spot_price: float | None = None
+        # Round-deadline guardrail (maxSchedulingDuration): set by solve()
+        # when a budget is passed; checked between candidate-loop
+        # iterations of the queued pass.
+        self._deadline: float | None = None
+        self.truncated = False
         self.sched_cost_accum = np.zeros(snap.factory.num_resources, dtype=np.int64)
 
     def _checkpoint(self):
@@ -758,7 +764,23 @@ class ReferenceSolver:
         only_evicted_global = False
         only_evicted_queues: set[int] = set()
 
+        pass_loops = 0
         while True:
+            # Round budget (maxSchedulingDuration): stop yielding new
+            # candidate loops once spent — only in the queued pass;
+            # evicted-only passes rebind running jobs and must complete
+            # for a committable result. The first loop always runs
+            # (forward-progress floor: a budget spent before the solve
+            # still drains >=1 gang per round).
+            if (
+                include_queued
+                and pass_loops > 0
+                and self._deadline is not None
+                and _time.monotonic() >= self._deadline
+            ):
+                self.truncated = True
+                break
+            pass_loops += 1
             # Peek every queue, pick the best per the PQ comparator.
             best = None  # (q, members, all_ev, proposed, current, size, pcp)
             for q in range(snap.num_queues):
@@ -1007,9 +1029,11 @@ class ReferenceSolver:
 
     # ---------------------------------------------------------------- solve
 
-    def solve(self) -> RoundResult:
+    def solve(self, budget_s: float | None = None) -> RoundResult:
         snap = self.snap
         self._init_state()
+        if budget_s and budget_s > 0:
+            self._deadline = _time.monotonic() + float(budget_s)
         fair_share, demand_capped, uncapped = self._compute_fair_shares()
         budgets = np.where(
             snap.queue_weight > 0, demand_capped / snap.queue_weight, np.inf
@@ -1033,6 +1057,17 @@ class ReferenceSolver:
             consider_priority=False,
             budgets=budgets,
         )
+        if self.truncated:
+            # Rescue pass (round deadline): evicted jobs whose rebind
+            # attempt the truncation cut off get it now — truncation must
+            # shed NEW placements, not preempt running work that still
+            # fits its own node. Evicted-only passes ignore the deadline.
+            self._queue_schedule(
+                include_queued=False,
+                skip_key_check=False,
+                consider_priority=False,
+                budgets=budgets,
+            )
         for j in list(self.rescheduled):
             preempted.discard(j)
 
@@ -1092,8 +1127,13 @@ class ReferenceSolver:
             fair_share=fair_share,
             demand_capped_fair_share=demand_capped,
             uncapped_fair_share=uncapped,
-            termination_reason=self.termination_reason or "no remaining candidate jobs",
+            termination_reason=(
+                "round_truncated"
+                if self.truncated
+                else (self.termination_reason or "no remaining candidate jobs")
+            ),
             unschedulable_reason=self.job_reason,
             num_loops=self.num_loops,
             spot_price=self.spot_price,
+            truncated=self.truncated,
         )

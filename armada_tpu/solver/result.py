@@ -34,6 +34,10 @@ class RoundResult:
     num_loops: int = 0
     # Market mode: spot price set this round (None if not crossed/off).
     spot_price: float | None = None
+    # Round-deadline guardrail: the scheduling budget expired before the
+    # candidate stream was exhausted; the masks hold the partial placement
+    # (a prefix of the full round's decisions).
+    truncated: bool = False
 
     def placements(self, snap) -> dict:
         """{job_id: node_id} for jobs scheduled this round."""

@@ -70,15 +70,39 @@ def _probe_once(timeout: float) -> tuple[str | None, str]:
     return None, f"probe exited rc={proc.returncode}: {' | '.join(tail)}"
 
 
-def enable_persistent_compile_cache(path: str | None = None):
-    """Cache compiled XLA executables on disk: the solver kernel compiles
-    in minutes per padded shape on TPU, and every fresh process (bench,
-    services, driver runs) would otherwise pay it again. Safe to call
-    before or after backend selection; idempotent."""
-    import jax
+def host_cpu_signature() -> str:
+    """Stable hash of the host's CPU ISA features.
 
-    if path is None:
-        path = os.environ.get(
+    XLA:CPU AOT-compiles to the build host's feature set; loading cached
+    executables compiled on a machine with different features is exactly
+    the cpu_aot_loader.cc "could lead to ... SIGILL" hazard (its warnings
+    flooded the round-5 bench tails when one shared cache dir served
+    heterogeneous hosts). Keying the cache directory by this signature
+    means a foreign host gets a MISS, never an incompatible load."""
+    import hashlib
+    import platform as _platform
+
+    parts = [_platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    # One core's feature list identifies the ISA surface.
+                    parts.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        # Non-Linux: fall back to coarser identifiers.
+        parts.append(_platform.processor())
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def compile_cache_dir(base: str | None = None) -> str:
+    """The persistent-compile-cache directory for THIS host: the base
+    (ARMADA_TPU_COMPILE_CACHE or <repo>/.jax_cache) extended with the
+    host-CPU-feature hash, so AOT code compiled on one machine is never
+    loaded on an incompatible one."""
+    if base is None:
+        base = os.environ.get(
             "ARMADA_TPU_COMPILE_CACHE",
             os.path.join(
                 os.environ.get(
@@ -89,6 +113,19 @@ def enable_persistent_compile_cache(path: str | None = None):
                 ".jax_cache",
             ),
         )
+    return os.path.join(base, f"cpu-{host_cpu_signature()}")
+
+
+def enable_persistent_compile_cache(path: str | None = None):
+    """Cache compiled XLA executables on disk: the solver kernel compiles
+    in minutes per padded shape on TPU, and every fresh process (bench,
+    services, driver runs) would otherwise pay it again. Safe to call
+    before or after backend selection; idempotent. The directory is keyed
+    by the host's CPU-feature hash (see host_cpu_signature)."""
+    import jax
+
+    if path is None:
+        path = compile_cache_dir()
     try:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

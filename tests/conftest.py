@@ -39,10 +39,11 @@ jax.config.update("jax_enable_x64", True)
 # compilations). Caching executables on disk makes repeat runs load
 # instead of compile; clearing the in-process caches at module boundaries
 # bounds the live JITed-code footprint that appears to trigger the crash.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.environ["REPO_ROOT"], ".jax_cache"),
-)
+from armada_tpu.utils.platform import compile_cache_dir  # noqa: E402
+
+# Keyed by host-CPU-feature hash: AOT executables cached by one machine
+# are never loaded on an incompatible host (cpu_aot_loader SIGILL hazard).
+jax.config.update("jax_compilation_cache_dir", compile_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import pytest  # noqa: E402

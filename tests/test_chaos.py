@@ -1,0 +1,205 @@
+"""Deterministic fault injection: plan semantics, degradation primitives,
+and a small tier-1 soak slice (tools/chaos_soak.py runs the full 20-plan
+version)."""
+
+import pytest
+
+from armada_tpu.services.chaos import (
+    ChaosLeader,
+    CircuitBreaker,
+    ExponentialBackoff,
+    FaultPlan,
+    FaultSpec,
+    VirtualClock,
+)
+
+
+# ---------------------------------------------------------------- FaultPlan
+
+
+def test_fault_plan_windows_and_counts():
+    plan = FaultPlan(
+        [
+            FaultSpec("executor_crash", "c0", start=10.0, duration=5.0),
+            FaultSpec("torn_log_write", "*", start=0.0, count=2),
+        ]
+    )
+    assert plan.active("executor_crash", "c0", 9.9) is None
+    assert plan.active("executor_crash", "c0", 10.0) is not None
+    assert plan.active("executor_crash", "c0", 14.9) is not None
+    assert plan.active("executor_crash", "c0", 15.0) is None
+    assert plan.active("executor_crash", "c1", 12.0) is None  # wrong target
+    # Point faults consume their count.
+    assert plan.fire("torn_log_write", "log", 1.0) is not None
+    assert plan.fire("torn_log_write", "log", 2.0) is not None
+    assert plan.fire("torn_log_write", "log", 3.0) is None
+
+
+def test_fault_plan_generate_deterministic():
+    a = FaultPlan.generate(7, 1000.0, executors=["e0", "e1"])
+    b = FaultPlan.generate(7, 1000.0, executors=["e0", "e1"])
+    assert a.faults == b.faults
+    c = FaultPlan.generate(8, 1000.0, executors=["e0", "e1"])
+    assert a.faults != c.faults
+    assert all(f.kind in set("""executor_crash executor_hang lease_slow
+        lease_timeout torn_log_write leader_flap""".split()) for f in a.faults)
+
+
+def test_fault_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        FaultPlan([FaultSpec("split_brain")])
+
+
+# ------------------------------------------------- degradation primitives
+
+
+def test_exponential_backoff_jitter_and_cap():
+    b = ExponentialBackoff(base_s=1.0, cap_s=8.0, seed=3)
+    delays = [b.next_delay() for _ in range(6)]
+    assert all(0.0 <= d <= 8.0 for d in delays)
+    assert delays[0] <= 1.0 and delays[1] <= 2.0 and delays[2] <= 4.0
+    # Seeded: the schedule replays exactly after reset.
+    b.reset()
+    assert [b.next_delay() for _ in range(6)] == delays
+
+
+def test_circuit_breaker_state_machine():
+    cb = CircuitBreaker(failure_threshold=2, cooldown_s=10.0)
+    assert cb.allow("e0", now=0.0)
+    cb.record_failure("e0", now=0.0)
+    assert cb.allow("e0", now=0.0)  # one failure: still closed
+    cb.record_failure("e0", now=1.0)
+    assert cb.state("e0", 1.0) == "open"
+    assert not cb.allow("e0", now=2.0)
+    # Half-open after cooldown: exactly one probe allowed.
+    assert cb.allow("e0", now=11.5)
+    assert not cb.allow("e0", now=11.6)
+    cb.record_failure("e0", now=11.7)  # probe failed: re-open
+    assert not cb.allow("e0", now=12.0)
+    assert cb.allow("e0", now=22.0)  # next cooldown, next probe
+    cb.record_success("e0")
+    assert cb.state("e0") == "closed"
+    assert cb.allow("e0")
+    # Keys are independent.
+    assert cb.allow("e1")
+
+
+def test_chaos_leader_flap_gates_token_and_validate():
+    from armada_tpu.services.leader import StandaloneLeader
+
+    clock = VirtualClock()
+    plan = FaultPlan([FaultSpec("leader_flap", "leader", 100.0, 50.0)])
+    leader = ChaosLeader(StandaloneLeader(), plan, clock=clock)
+    clock.now = 10.0
+    token = leader.get_token()
+    assert token.leader and leader.validate(token)
+    clock.now = 120.0  # mid-flap: deposed, and the old token is invalid
+    assert not leader.get_token().leader
+    assert not leader.validate(token)
+    clock.now = 160.0  # flap over
+    assert leader.get_token().leader
+
+
+def test_lease_breaker_on_server_lease_path():
+    """Repeated failing exchanges open the per-executor circuit; an open
+    circuit fast-fails the RPC (wire-agnostic, the agent's backoff
+    absorbs it); a later success closes it."""
+    from armada_tpu.services.chaos import CircuitOpenError
+    from armada_tpu.services.grpc_api import ApiServer
+
+    api = ApiServer(None, None, None, None)
+    api.lease_breaker.cooldown_s = 60.0
+
+    calls = {"n": 0}
+
+    def boom(req):
+        calls["n"] += 1
+        raise RuntimeError("malformed heartbeat")
+
+    api._executor_lease_inner = boom
+    for _ in range(3):
+        with pytest.raises(RuntimeError):
+            api._executor_lease({"executor": "bad"})
+    # Circuit open: the handler is never reached.
+    with pytest.raises(CircuitOpenError):
+        api._executor_lease({"executor": "bad"})
+    assert calls["n"] == 3
+    # Half-open probe after cooldown: a success closes the circuit.
+    api.lease_breaker.cooldown_s = 0.0
+    api._executor_lease_inner = lambda req: {"leases": []}
+    assert api._executor_lease({"executor": "bad"}) == {"leases": []}
+    assert api.lease_breaker.state("bad") == "closed"
+    # Other executors were never affected.
+    assert api.lease_breaker.allow("good")
+
+
+# ----------------------------------------------------- simulator integration
+
+
+@pytest.mark.chaos
+def test_sim_executor_crash_recovers_all_jobs():
+    """A crash window mid-run loses the executor's pods; recovery
+    reconciliation + retries still finish every job, deterministically."""
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.sim.simulator import (
+        ClusterSpec,
+        JobTemplate,
+        NodeTemplate,
+        QueueSpecSim,
+        ShiftedExponential,
+        Simulator,
+        WorkloadSpec,
+    )
+
+    def build():
+        plan = FaultPlan(
+            [FaultSpec("executor_crash", "cl0", start=50.0, duration=100.0)]
+        )
+        return Simulator(
+            [ClusterSpec(name="cl0", node_templates=(NodeTemplate(count=5),))],
+            WorkloadSpec(
+                queues=(
+                    QueueSpecSim(
+                        name="q0",
+                        job_templates=(
+                            JobTemplate(
+                                id="t",
+                                number=8,
+                                cpu="2",
+                                memory="4Gi",
+                                runtime=ShiftedExponential(minimum=60.0),
+                            ),
+                        ),
+                    ),
+                )
+            ),
+            SchedulingConfig(
+                enable_assertions=True, executor_timeout_s=60.0, max_retries=6
+            ),
+            backend="oracle",
+            seed=5,
+            cycle_interval=10.0,
+            max_time=4000.0,
+            fault_plan=plan,
+        )
+
+    r1 = build().run()
+    assert r1.finished_jobs == r1.total_jobs == 8
+    r2 = build().run()
+    assert r2.events_by_job == r1.events_by_job
+    assert r2.placements == r1.placements
+
+
+@pytest.mark.chaos
+def test_soak_subset_deterministic():
+    """Two full soak plans (crashes, hangs, lease faults, leader flaps,
+    torn log tails on a real file-backed log) with the determinism
+    check — the tier-1 slice of tools/chaos_soak.py."""
+    from tools.chaos_soak import run_plan
+
+    for seed in (0, 3):
+        first = run_plan(seed, "oracle", 24)
+        second = run_plan(seed, "oracle", 24)
+        assert first["digest"] == second["digest"]
+        assert first["finished"] == first["total"]
+        assert first["faults_fired"] > 0  # chaos actually landed

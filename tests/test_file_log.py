@@ -232,3 +232,73 @@ def test_restart_does_not_grow_full_segment(tmp_path):
         sum(1 for _ in open(os.path.join(d, s))) for s in segs
     ]
     assert counts[0] == 4 and counts[1] == 1
+
+
+def test_torn_tail_fuzz_every_byte_offset(tmp_path):
+    """Fuzz torn-write recovery: truncate the final record at EVERY byte
+    offset (including losing just the trailing newline) and assert clean
+    recovery — all prior records intact, the torn record dropped, and the
+    log appendable again."""
+    d = str(tmp_path / "log")
+    log = FileEventLog(d)
+    for i in range(4):
+        log.publish(
+            EventSequence.of("q", "s", SubmitJob(created=float(i), job=job(i)))
+        )
+    log.close()
+    seg = os.path.join(d, sorted(os.listdir(d))[0])
+    with open(seg, "rb") as f:
+        data = f.read()
+    # Byte offset where the final record starts.
+    body = data[:-1]  # strip the final newline to find the prior one
+    last_start = body.rfind(b"\n") + 1
+    prior = data[:last_start]
+
+    for cut in range(last_start, len(data)):
+        with open(seg, "wb") as f:
+            f.write(data[:cut])
+        recovered = FileEventLog(d)
+        kept = 3 if cut < len(data) else 4
+        assert recovered.end_offset == kept, f"cut at byte {cut}"
+        entries = recovered.read(0, 100)
+        assert [e.sequence.events[0].job.id for e in entries] == [
+            f"j{i:03d}" for i in range(kept)
+        ], f"prior records damaged at cut {cut}"
+        # The tail is clean: appends land at the recovered offset.
+        recovered.publish(
+            EventSequence.of("q", "s", SubmitJob(created=9.0, job=job(9)))
+        )
+        assert recovered.end_offset == kept + 1
+        recovered.close()
+        # Restore the pristine file for the next offset.
+        with open(seg, "wb") as f:
+            f.write(data)
+    # Sanity: the intact file still recovers all 4 records.
+    assert prior  # the fuzz actually covered a non-empty prefix
+    final = FileEventLog(d)
+    assert final.end_offset == 4
+    final.close()
+
+
+def test_injected_torn_write_crash_recovery(tmp_path):
+    """The chaos injector's torn write behaves like a crash: partial bytes
+    stay on disk, recovery truncates them, and the retried publish lands
+    at the same offset (services/chaos.CrashRecoveringLog)."""
+    from armada_tpu.services.chaos import CrashRecoveringLog, FaultPlan, FaultSpec
+
+    plan = FaultPlan(
+        [FaultSpec("torn_log_write", "*", start=0.0, count=3, param=0.4)]
+    )
+    log = CrashRecoveringLog(str(tmp_path / "log"), plan, clock=lambda: 1.0)
+    for i in range(6):
+        log.publish(
+            EventSequence.of("q", "s", SubmitJob(created=float(i), job=job(i)))
+        )
+    assert log.crashes == 3  # every budgeted tear fired and was recovered
+    assert log.end_offset == 6
+    log.close()
+    clean = FileEventLog(str(tmp_path / "log"))
+    assert clean.end_offset == 6
+    ids = [e.sequence.events[0].job.id for e in clean.read(0, 100)]
+    assert ids == [f"j{i:03d}" for i in range(6)]
+    clean.close()

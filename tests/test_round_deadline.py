@@ -1,0 +1,301 @@
+"""Round-deadline guardrails (maxSchedulingDuration): budget-aware fill
+loops, partial-placement commit, oracle parity on the placed subset,
+resume across cycles, and truncation backpressure."""
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, QueueSpec
+from armada_tpu.snapshot.round import build_round_snapshot
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round, prep_device_round
+from armada_tpu.solver.reference import ReferenceSolver
+
+
+def _inputs(n_jobs=96, n_nodes=8, n_queues=3):
+    cfg = SchedulingConfig(
+        # Serial fill: every placement is its own while-loop iteration, so
+        # a tiny budget truncates mid-stream deterministically.
+        batch_fill_window=0,
+    )
+    nodes = [
+        NodeSpec(
+            id=f"n{i:03d}",
+            pool="default",
+            total_resources={"cpu": "16", "memory": "64Gi"},
+        )
+        for i in range(n_nodes)
+    ]
+    queues = [QueueSpec(f"q{i}", 1.0) for i in range(n_queues)]
+    queued = [
+        JobSpec(
+            id=f"j{i:04d}",
+            queue=f"q{i % n_queues}",
+            requests={"cpu": "1", "memory": "1Gi"},
+            submitted_ts=float(i),
+        )
+        for i in range(n_jobs)
+    ]
+    return cfg, nodes, queues, queued
+
+
+def _solve_snap(cfg, nodes, queues, queued, budget_s=None):
+    snap = build_round_snapshot(cfg, "default", nodes, queues, [], queued)
+    dev = pad_device_round(prep_device_round(snap))
+    out = solve_round(dev, budget_s=budget_s)
+    J = snap.num_jobs
+    return snap, {
+        "assigned_node": np.asarray(out["assigned_node"])[:J],
+        "scheduled_mask": np.asarray(out["scheduled_mask"])[:J],
+        "truncated": out.get("truncated", False),
+        "num_loops": int(out["num_loops"]),
+    }
+
+
+def test_config_round_deadline_keys():
+    cfg = SchedulingConfig.from_dict(
+        {"maxSchedulingDuration": 5.0, "truncatedRoundsBackpressure": 4}
+    )
+    assert cfg.max_scheduling_duration_s == 5.0
+    assert cfg.truncated_rounds_backpressure == 4
+    from armada_tpu.core.config import validate_config
+
+    with pytest.raises(ValueError):
+        validate_config(
+            SchedulingConfig(max_scheduling_duration_s=-1.0)
+        )
+    with pytest.raises(ValueError):
+        validate_config(
+            SchedulingConfig(truncated_rounds_backpressure=0)
+        )
+
+
+def test_kernel_truncated_round_is_prefix_of_full_round():
+    """A budgeted round commits a subset of the full round's placements
+    with IDENTICAL node assignments (the decision stream is a prefix),
+    and the full round stays oracle-parity."""
+    cfg, nodes, queues, queued = _inputs()
+    snap, full = _solve_snap(cfg, nodes, queues, queued, budget_s=None)
+    oracle = ReferenceSolver(snap).solve()
+    assert (oracle.assigned_node == full["assigned_node"]).all()
+
+    _, cut = _solve_snap(cfg, nodes, queues, queued, budget_s=1e-6)
+    assert cut["truncated"]
+    placed = np.flatnonzero(cut["scheduled_mask"])
+    assert 1 <= len(placed) < int(full["scheduled_mask"].sum())
+    # Placed subset: scheduled by the full round too, on the same node —
+    # hence oracle-parity on the placed subset.
+    assert full["scheduled_mask"][placed].all()
+    assert (
+        cut["assigned_node"][placed] == full["assigned_node"][placed]
+    ).all()
+    assert (
+        cut["assigned_node"][placed] == oracle.assigned_node[placed]
+    ).all()
+    assert cut["num_loops"] < full["num_loops"]
+
+
+def test_kernel_generous_budget_matches_unbudgeted():
+    # Same shape as the truncation test: shares its compiled programs.
+    cfg, nodes, queues, queued = _inputs()
+    _, full = _solve_snap(cfg, nodes, queues, queued, budget_s=None)
+    _, budgeted = _solve_snap(cfg, nodes, queues, queued, budget_s=120.0)
+    assert not budgeted["truncated"]
+    assert (budgeted["scheduled_mask"] == full["scheduled_mask"]).all()
+    assert (budgeted["assigned_node"] == full["assigned_node"]).all()
+
+
+def test_oracle_deadline_truncates_and_is_prefix():
+    cfg, nodes, queues, queued = _inputs()
+    snap = build_round_snapshot(cfg, "default", nodes, queues, [], queued)
+    full = ReferenceSolver(snap).solve()
+    cut = ReferenceSolver(snap).solve(budget_s=1e-6)
+    assert cut.truncated and cut.termination_reason == "round_truncated"
+    placed = np.flatnonzero(cut.scheduled_mask)
+    assert 1 <= len(placed) < int(full.scheduled_mask.sum())
+    assert full.scheduled_mask[placed].all()
+    assert (cut.assigned_node[placed] == full.assigned_node[placed]).all()
+
+
+def _scheduler_with_jobs(n_jobs, budget_s):
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.fake_executor import make_nodes
+    from armada_tpu.services.scheduler import (
+        ExecutorHeartbeat,
+        SchedulerService,
+    )
+    from armada_tpu.services.submit import SubmitService
+
+    config = SchedulingConfig(
+        max_scheduling_duration_s=budget_s,
+        truncated_rounds_backpressure=2,
+        batch_fill_window=0,
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    submit = SubmitService(config, log, scheduler=sched)
+    submit.create_queue(QueueSpec("q0", 1.0))
+    jobs = [
+        JobSpec(
+            id=f"d{i:04d}",
+            queue="q0",
+            jobset="s",
+            requests={"cpu": "1", "memory": "1Gi"},
+            submitted_ts=float(i),
+        )
+        for i in range(n_jobs)
+    ]
+    submit.submit("q0", "s", jobs, now=0.0)
+    sched.report_executor(
+        ExecutorHeartbeat(
+            name="e0",
+            pool="default",
+            nodes=make_nodes("e0", count=4, cpu="32", memory="256Gi"),
+            last_seen=0.0,
+        )
+    )
+    return sched
+
+
+def test_scheduler_truncated_rounds_resume_and_trip_backpressure():
+    """End to end on the service: every budget-starved round commits a
+    partial placement and reports round_truncated; successive cycles
+    resume from the truncation point until the backlog drains; repeated
+    truncation trips per-pool backpressure, and a clean round clears it."""
+    from armada_tpu.jobdb import JobState
+
+    sched = _scheduler_with_jobs(24, budget_s=1e-6)
+    leased_counts = []
+    truncated_rounds = 0
+    for cycle in range(200):
+        sched.cycle(now=float(cycle))
+        report = sched.reports.latest_reports().get("default")
+        if report is not None and report.termination_reason == "round_truncated":
+            truncated_rounds += 1
+        txn = sched.jobdb.read_txn()
+        queued = len(txn.queued_jobs(sort=False))
+        leased_counts.append(24 - queued)
+        if queued == 0:
+            break
+    assert leased_counts[-1] == 24, "backlog never drained"
+    # Starved rounds each made partial progress (resume across cycles).
+    assert truncated_rounds >= 2
+    assert len(leased_counts) > 2
+    # Backpressure tripped during the truncation streak...
+    assert truncated_rounds >= sched.round_pressure.threshold
+    # ...and one clean (fully drained) round afterwards clears it. (`now`
+    # stays inside the executor timeout so the heartbeat is still live.)
+    sched.cycle(now=float(len(leased_counts) + 1))
+    ok, reason = sched.round_pressure.check()
+    assert ok, reason
+    # All leases are real jobdb state.
+    txn = sched.jobdb.read_txn()
+    assert sum(1 for j in txn.all_jobs() if j.state == JobState.LEASED) == 24
+
+
+def test_scheduler_no_budget_reports_untruncated():
+    sched = _scheduler_with_jobs(6, budget_s=0.0)
+    sched.cycle(now=0.0)
+    report = sched.reports.latest_reports().get("default")
+    assert report is not None
+    assert report.termination_reason != "round_truncated"
+    ok, _ = sched.round_pressure.check()
+    assert ok
+
+
+def _evicting_inputs(n_queued=64, n_running=24, n_nodes=8):
+    """Running preemptible jobs in one hog queue over its fair share plus
+    queued work from others: pass 1 starts by evicting the hog's jobs, so
+    truncation mid-pass exercises the evicted-rebind rescue."""
+    from armada_tpu.core.config import PriorityClass
+    from armada_tpu.core.types import RunningJob
+
+    cfg = SchedulingConfig(
+        priority_classes={
+            "high": PriorityClass("high", 30000, preemptible=False),
+            "low": PriorityClass("low", 1000, preemptible=True),
+        },
+        default_priority_class="low",
+        protected_fraction_of_fair_share=0.5,
+        batch_fill_window=0,
+    )
+    nodes = [
+        NodeSpec(
+            id=f"n{i:03d}",
+            pool="default",
+            total_resources={"cpu": "16", "memory": "64Gi"},
+        )
+        for i in range(n_nodes)
+    ]
+    queues = [QueueSpec(f"q{i}", 1.0) for i in range(3)]
+    running = [
+        RunningJob(
+            job=JobSpec(
+                id=f"r{i:04d}",
+                queue="q0",
+                priority_class="low",
+                requests={"cpu": "2", "memory": "4Gi"},
+                submitted_ts=float(-n_running + i),
+            ),
+            node_id=f"n{i % n_nodes:03d}",
+            scheduled_at_priority=1000,
+        )
+        for i in range(n_running)
+    ]
+    queued = [
+        JobSpec(
+            id=f"j{i:04d}",
+            queue=f"q{1 + i % 2}",
+            priority_class="low",
+            requests={"cpu": "1", "memory": "1Gi"},
+            submitted_ts=float(i),
+        )
+        for i in range(n_queued)
+    ]
+    return cfg, nodes, queues, running, queued
+
+
+def test_oracle_truncation_with_evictions_never_over_preempts():
+    """Truncating a round that evicted running jobs must not preempt work
+    the full round would have kept: the rescue pass rebinds every evicted
+    job whose pinned node still fits it (truncated preemptions are a
+    subset of the full round's)."""
+    cfg, nodes, queues, running, queued = _evicting_inputs()
+    snap = build_round_snapshot(cfg, "default", nodes, queues, running, queued)
+    full = ReferenceSolver(snap).solve()
+    cut = ReferenceSolver(snap).solve(budget_s=1e-6)
+    assert cut.truncated
+    cut_preempted = set(np.flatnonzero(cut.preempted_mask))
+    full_preempted = set(np.flatnonzero(full.preempted_mask))
+    assert cut_preempted <= full_preempted
+    # Queued placements remain a prefix with identical assignments.
+    placed = np.flatnonzero(cut.scheduled_mask)
+    assert full.scheduled_mask[placed].all()
+    assert (cut.assigned_node[placed] == full.assigned_node[placed]).all()
+    # And evicted jobs that rebound really are still on their own node.
+    for j in np.flatnonzero(snap.job_is_running):
+        if j not in cut_preempted:
+            assert cut.assigned_node[j] == snap.job_node[j]
+
+
+@pytest.mark.slow
+def test_kernel_truncation_with_evictions_never_over_preempts():
+    """Kernel variant of the rescue-pass contract (slow: compiles the
+    chunked programs for the eviction-shaped round)."""
+    cfg, nodes, queues, running, queued = _evicting_inputs()
+    snap = build_round_snapshot(cfg, "default", nodes, queues, running, queued)
+    dev = pad_device_round(prep_device_round(snap))
+    J = snap.num_jobs
+    full = solve_round(dev)
+    cut = solve_round(dev, budget_s=1e-6)
+    assert cut["truncated"]
+    cut_pre = set(np.flatnonzero(np.asarray(cut["preempted_mask"])[:J]))
+    full_pre = set(np.flatnonzero(np.asarray(full["preempted_mask"])[:J]))
+    assert cut_pre <= full_pre
+    placed = np.flatnonzero(np.asarray(cut["scheduled_mask"])[:J])
+    assert np.asarray(full["scheduled_mask"])[:J][placed].all()
+    assert (
+        np.asarray(cut["assigned_node"])[:J][placed]
+        == np.asarray(full["assigned_node"])[:J][placed]
+    ).all()

@@ -1,0 +1,191 @@
+"""Chaos soak: seeded FaultPlans through the simulator, invariants asserted.
+
+Runs N seeded fault plans (executor crashes/hangs, lease faults, leader
+flaps, torn event-log writes) through whole-fleet simulator runs on the
+REAL control-plane code path, asserting after each:
+
+  - zero jobdb invariant violations (enable_assertions runs
+    txn.assert_valid() after every cycle);
+  - every job reached a terminal state (faults delay work, never lose it);
+  - determinism: the same seed run twice produces the IDENTICAL final
+    jobdb digest (state + final placement per job) — the property that
+    makes chaos failures reproducible from a one-line seed.
+
+Usage:
+  python tools/chaos_soak.py [--plans 20] [--backend oracle]
+                             [--jobs 40] [--no-determinism-check]
+
+Exit code 0 = clean soak; prints one JSON line per plan and a summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_sim(seed: int, backend: str, n_jobs: int, data_dir: str | None):
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.services.chaos import FaultPlan
+    from armada_tpu.sim.simulator import (
+        ClusterSpec,
+        JobTemplate,
+        NodeTemplate,
+        QueueSpecSim,
+        ShiftedExponential,
+        Simulator,
+        WorkloadSpec,
+    )
+
+    executors = ["chaos-c0", "chaos-c1"]
+    # The workload spans the same horizon the fault windows are drawn
+    # over (waves of submissions through [0, 0.75*duration)), so crash /
+    # flap / torn-write windows actually intersect live work.
+    duration = 1200.0
+    plan = FaultPlan.generate(
+        seed, duration, executors=executors, events_per_kind=2
+    )
+    config = SchedulingConfig(
+        enable_assertions=True,  # jobdb invariants checked every cycle
+        # Crashed executors must expire well inside the sim horizon.
+        executor_timeout_s=120.0,
+        max_retries=10,
+    )
+    clusters = [
+        ClusterSpec(name=name, node_templates=(NodeTemplate(count=10),))
+        for name in executors
+    ]
+    waves = 4
+    per_wave = max(1, n_jobs // (2 * waves))
+    workload = WorkloadSpec(
+        queues=tuple(
+            QueueSpecSim(
+                name=f"q{i}",
+                job_templates=tuple(
+                    JobTemplate(
+                        id=f"t{i}w{w}",
+                        number=per_wave,
+                        cpu="2",
+                        memory="4Gi",
+                        runtime=ShiftedExponential(minimum=60.0, tail_mean=60.0),
+                        submit_time=w * duration * 0.75 / waves + i * 20.0,
+                    )
+                    for w in range(waves)
+                ),
+            )
+            for i in range(2)
+        )
+    )
+    return Simulator(
+        clusters,
+        workload,
+        config,
+        backend=backend,
+        seed=seed,
+        cycle_interval=10.0,
+        max_time=6 * 3600.0,
+        fault_plan=plan,
+        data_dir=data_dir,
+    ), plan
+
+
+def jobdb_digest(sim) -> str:
+    """Stable digest of final per-job state + placement (run ids excluded:
+    they are fresh uuids every run by design)."""
+    txn = sim.scheduler.jobdb.read_txn()
+    rows = []
+    for job in sorted(txn.all_jobs(), key=lambda j: j.id):
+        run = job.latest_run
+        rows.append(
+            (
+                job.id,
+                job.state.value,
+                job.num_attempts,
+                run.node_id if run is not None else "",
+            )
+        )
+    return hashlib.sha256(json.dumps(rows).encode()).hexdigest()
+
+
+def run_plan(seed: int, backend: str = "oracle", n_jobs: int = 40,
+             use_file_log: bool = True) -> dict:
+    """One soak iteration; raises on any invariant violation."""
+    tmp = None
+    data_dir = None
+    if use_file_log:
+        tmp = tempfile.TemporaryDirectory(prefix=f"chaos-soak-{seed}-")
+        data_dir = tmp.name
+    try:
+        sim, plan = build_sim(seed, backend, n_jobs, data_dir)
+        result = sim.run()
+        # Final invariant sweep on top of the per-cycle assertions.
+        sim.scheduler.jobdb.read_txn().assert_valid()
+        unfinished = result.total_jobs - sum(
+            1 for s in result.events_by_job.values() if s.terminal
+        )
+        if unfinished:
+            raise AssertionError(
+                f"seed {seed}: {unfinished}/{result.total_jobs} jobs never "
+                "reached a terminal state under chaos"
+            )
+        crashes = getattr(sim.log, "crashes", 0)
+        return {
+            "seed": seed,
+            "digest": jobdb_digest(sim),
+            "finished": result.finished_jobs,
+            "total": result.total_jobs,
+            "preemptions": result.preemptions,
+            "cycles": result.cycles,
+            "makespan": round(result.makespan, 1),
+            "faults_fired": plan.fired(),
+            "log_crashes": crashes,
+        }
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="chaos-soak")
+    ap.add_argument("--plans", type=int, default=20)
+    ap.add_argument("--backend", default="oracle",
+                    choices=["oracle", "kernel"])
+    ap.add_argument("--jobs", type=int, default=40)
+    ap.add_argument("--no-determinism-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    failures = 0
+    for seed in range(args.plans):
+        try:
+            first = run_plan(seed, args.backend, args.jobs)
+            if not args.no_determinism_check:
+                second = run_plan(seed, args.backend, args.jobs)
+                if first["digest"] != second["digest"]:
+                    raise AssertionError(
+                        f"seed {seed}: nondeterministic final jobdb "
+                        f"({first['digest'][:12]} != {second['digest'][:12]})"
+                    )
+            print(json.dumps(first))
+        except Exception as e:
+            failures += 1
+            print(json.dumps({"seed": seed, "error": repr(e)}))
+    print(
+        json.dumps(
+            {
+                "plans": args.plans,
+                "failures": failures,
+                "determinism_checked": not args.no_determinism_check,
+            }
+        )
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
