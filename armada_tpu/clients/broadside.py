@@ -151,6 +151,7 @@ class InprocBackend:
             JobErrors,
             JobRunLeased,
             JobRunRunning,
+            JobRunSucceeded,
             JobSucceeded,
             SubmitJob,
         )
@@ -209,9 +210,16 @@ class InprocBackend:
             )
         )
         terminal = []
-        terminal += [
-            JobSucceeded(created=now, job_id=ids[i]) for i in range(n_succeed)
-        ]
+        # Success is run-anchored (jobdb/ingest.py drops a JobSucceeded
+        # whose latest run did not report SUCCEEDED — partition fencing):
+        # emit the run's success alongside, like the real executor wire.
+        for i in range(n_succeed):
+            terminal.append(
+                JobRunSucceeded(
+                    created=now, job_id=ids[i], run_id=leases[i].run_id
+                )
+            )
+            terminal.append(JobSucceeded(created=now, job_id=ids[i]))
         terminal += [
             JobErrors(created=now, job_id=ids[n_succeed + i], error="oom killed")
             for i in range(n_fail)

@@ -172,6 +172,15 @@ class SchedulingConfig:
     # (the cut entry batches next iteration instead).
     fill_group_max: int = 8
     executor_timeout_s: float = 600.0
+    # Lease TTL advertised to executor agents in every lease reply: an
+    # agent that cannot complete a lease exchange for this long must
+    # stop accepting new work and treat its running pods as orphan
+    # candidates until an anti-entropy ExecutorSync (partition safety;
+    # see the split-brain model in docs/architecture.md). Also caps the
+    # agent's cumulative retry-backoff budget so a retrying exchange can
+    # never outlive the lease it renews. Should be <= executor_timeout_s:
+    # the agent must notice the partition no later than the server does.
+    executor_lease_ttl_s: float = 60.0
     max_unacknowledged_jobs_per_executor: int = 2500
     # Round-deadline guardrail (the reference's maxSchedulingDuration,
     # config/scheduler/config.yaml:105): wall-clock budget for one
@@ -401,6 +410,7 @@ class SchedulingConfig:
             ("spotPriceCutoff", "spot_price_cutoff", float),
             ("shortJobPenaltySeconds", "short_job_penalty_s", float),
             ("executorTimeout", "executor_timeout_s", float),
+            ("executorLeaseTTL", "executor_lease_ttl_s", float),
             ("maxSchedulingDuration", "max_scheduling_duration_s", float),
             (
                 "truncatedRoundsBackpressure",
@@ -499,6 +509,8 @@ def validate_config(config: SchedulingConfig):
         problems.append("fillGroupMax must be >= 1")
     if config.max_scheduling_duration_s < 0:
         problems.append("maxSchedulingDuration must be >= 0")
+    if config.executor_lease_ttl_s < 0:
+        problems.append("executorLeaseTTL must be >= 0")
     if config.truncated_rounds_backpressure < 1:
         problems.append("truncatedRoundsBackpressure must be >= 1")
     for name, frac in config.maximum_resource_fraction_to_schedule.items():

@@ -162,6 +162,27 @@ class ExecutorCordon(Event):
 
 
 @dataclass(frozen=True)
+class ExecutorFenced(Event):
+    """Control-plane event: the scheduler reassigned an executor's runs
+    (partition/outage expiry) and bumped its monotonic fencing token.
+    Lease/report RPCs carrying an older token are rejected with
+    FAILED_PRECONDITION until the executor completes an anti-entropy
+    ExecutorSync — so a healed partition cannot resurrect zombie runs.
+    Event-sourced so fences survive restarts and leader failover (a
+    fence that reset to zero would re-admit stale reports).
+
+    `synced=True` records the OTHER half of the lifecycle: the executor
+    completed its ExecutorSync at this fence, clearing the advisory
+    health breach. Also event-sourced, so a restarted scheduler's log
+    replay does not resurrect 'awaiting post-fence sync' alarms for
+    executors that healed long ago."""
+
+    name: str = ""
+    fence: int = 0
+    synced: bool = False
+
+
+@dataclass(frozen=True)
 class PriorityOverride(Event):
     """Control-plane event: external queue priority override set/cleared
     (internal/scheduler/priorityoverride). cleared=True removes it."""

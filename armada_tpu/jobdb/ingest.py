@@ -18,6 +18,16 @@ from dataclasses import replace
 from .. import events as ev
 from .jobdb import Job, JobDb, JobRun, JobState, RunState
 
+# Run states an executor-side lifecycle event may still act on. Events
+# addressing a run OUTSIDE these states are stale echoes — typically a
+# partitioned executor's report landing after _expire_stale_executors
+# already failed the run and requeued the job — and must be dropped:
+# applying them would resurrect a zombie run or hand one job two
+# terminal outcomes (the split-brain model in docs/architecture.md).
+# RPC fencing rejects such reports at the API for remote agents; this
+# guard is the defense for in-process publishers and log replays.
+_LIVE_RUN = (RunState.LEASED, RunState.PENDING, RunState.RUNNING)
+
 
 def apply_entry(txn, entry, error_rules=()) -> None:
     seq: ev.EventSequence = entry.sequence
@@ -54,6 +64,20 @@ def _apply_event(txn, seq: ev.EventSequence, event, error_rules=()) -> None:
     elif isinstance(event, ev.ReprioritiseJob):
         txn.upsert(job.with_(priority=event.priority))
     elif isinstance(event, ev.JobRunLeased):
+        runs = job.runs
+        prev = job.latest_run
+        if prev is not None and prev.state in _LIVE_RUN:
+            # A new lease supersedes a still-live attempt (a raced or
+            # replayed history; normal flow fails the run before the
+            # requeue). Close it out so no job ever holds two active
+            # runs — the terminal outcome belongs to the NEW run.
+            runs = runs[:-1] + (
+                replace(
+                    prev,
+                    state=RunState.FAILED,
+                    finished=event.created,
+                ),
+            )
         run = JobRun(
             id=event.run_id,
             job_id=job.id,
@@ -65,7 +89,7 @@ def _apply_event(txn, seq: ev.EventSequence, event, error_rules=()) -> None:
             attempt=job.num_attempts,
             leased=event.created,
         )
-        txn.upsert(job.with_(state=JobState.LEASED, runs=job.runs + (run,)))
+        txn.upsert(job.with_(state=JobState.LEASED, runs=runs + (run,)))
     elif isinstance(event, ev.JobRunPending):
         run = job.latest_run
         if run and run.id == event.run_id and run.state == RunState.LEASED:
@@ -73,26 +97,35 @@ def _apply_event(txn, seq: ev.EventSequence, event, error_rules=()) -> None:
             txn.upsert(job.with_(state=JobState.PENDING, runs=job.runs[:-1] + (run,)))
     elif isinstance(event, ev.JobRunRunning):
         run = job.latest_run
-        if run and run.id == event.run_id:
+        if run and run.id == event.run_id and run.state in _LIVE_RUN:
             run = replace(run, state=RunState.RUNNING, started=event.created)
             txn.upsert(job.with_(state=JobState.RUNNING, runs=job.runs[:-1] + (run,)))
     elif isinstance(event, ev.JobRunSucceeded):
         run = job.latest_run
-        if run and run.id == event.run_id:
+        if run and run.id == event.run_id and run.state in _LIVE_RUN:
             run = replace(run, state=RunState.SUCCEEDED, finished=event.created)
             txn.upsert(job.with_(runs=job.runs[:-1] + (run,)))
     elif isinstance(event, ev.JobSucceeded):
-        txn.upsert(job.with_(state=JobState.SUCCEEDED))
+        # Success is run-anchored: it lands only when the LATEST run
+        # actually reported SUCCEEDED. A partitioned executor's stale
+        # [JobRunSucceeded(run-old), JobSucceeded] batch drops its run
+        # event (run-old is FAILED from the expiry) and this guard then
+        # drops the job event too — whether the job is still QUEUED or
+        # already re-leased to a new run. Exactly one terminal outcome,
+        # decided by the scheduler's expiry.
+        run = job.latest_run
+        if run is not None and run.state == RunState.SUCCEEDED:
+            txn.upsert(job.with_(state=JobState.SUCCEEDED))
     elif isinstance(event, ev.JobRunPreempted):
         run = job.latest_run
-        if run and run.id == event.run_id:
+        if run and run.id == event.run_id and run.state in _LIVE_RUN:
             run = replace(run, state=RunState.PREEMPTED, finished=event.created)
             txn.upsert(
                 job.with_(state=JobState.PREEMPTED, runs=job.runs[:-1] + (run,))
             )
     elif isinstance(event, ev.JobRunErrors):
         run = job.latest_run
-        if run and run.id == event.run_id:
+        if run and run.id == event.run_id and run.state in _LIVE_RUN:
             run = replace(
                 run,
                 state=RunState.FAILED,

@@ -242,6 +242,22 @@ class JobDbTxn:
             and j.latest_run.finished >= cutoff,
         )
 
+    def job_for_any_run(self, run_id: str) -> Job | None:
+        """The job owning this run id at ANY attempt (latest or
+        superseded) — the anti-entropy sync classifies a healed
+        executor's pods with it: a superseded run resolves to its job
+        (duplicate) instead of reading as unknown (zombie)."""
+        db = self._db
+        for j in self._writes.values():
+            if j is not None and any(r.id == run_id for r in j.runs):
+                return j
+        with db._state_lock:
+            jid = db._by_any_run.get(run_id)
+            base = db._jobs.get(jid) if jid is not None else None
+        if base is not None and base.id in self._writes:
+            return self._writes[base.id]
+        return base
+
     def job_for_run(self, run_id: str) -> Job | None:
         """The job whose LATEST run has this id."""
         db = self._db
@@ -280,6 +296,8 @@ class JobDbTxn:
 
     def assert_valid(self):
         """Invariant checks, the jobdb.Assert equivalent (jobdb.go:475)."""
+        _live = (RunState.LEASED, RunState.PENDING, RunState.RUNNING)
+        seen_runs: dict[str, str] = {}
         for job in self.all_jobs():
             if job.state == JobState.QUEUED:
                 assert not job.runs or job.runs[-1].state in (
@@ -288,6 +306,22 @@ class JobDbTxn:
                 ), f"queued job {job.id} has live run"
             if job.state in _LIVE_RUN_STATES:
                 assert job.runs, f"{job.state} job {job.id} has no runs"
+            # Split-brain invariant: at most ONE live run per job — every
+            # superseded attempt must be terminal before a new lease (a
+            # healed partition resurrecting a zombie run would trip this).
+            live = [r for r in job.runs if r.state in _live]
+            assert len(live) <= 1, (
+                f"job {job.id} holds {len(live)} active runs: "
+                f"{[r.id for r in live]}"
+            )
+            assert all(
+                r.state not in _live for r in job.runs[:-1]
+            ), f"job {job.id} has a live superseded run"
+            for r in job.runs:
+                assert r.id not in seen_runs, (
+                    f"run {r.id} owned by both {seen_runs[r.id]} and {job.id}"
+                )
+                seen_runs[r.id] = job.id
         self._db._assert_indexes()
 
 
@@ -308,6 +342,11 @@ class JobDb:
         self._terminal: dict[str, Job] = {}
         self._gangs: dict[tuple, dict[str, Job]] = {}
         self._by_run: dict[str, str] = {}  # latest run id -> job id
+        # EVERY run id (superseded attempts included) -> job id: the
+        # anti-entropy sync resolves a healed executor's pods through it.
+        # Bounded by max_retries attempts per job; entries die with the
+        # job (terminal pruning).
+        self._by_any_run: dict[str, str] = {}
         # Append-only (serial, job_id) changelog for delta consumers
         # (the incremental snapshot path; the reference delta-syncs by
         # serial, scheduler.go:441). Compacted when oversized; consumers
@@ -355,6 +394,8 @@ class JobDb:
         run = job.latest_run
         if run is not None:
             self._by_run.pop(run.id, None)
+        for r in job.runs:
+            self._by_any_run.pop(r.id, None)
         if job.state == JobState.QUEUED:
             self._pop2(self._queued_by_queue, job.queue, jid)
         if job.state in _LIVE_RUN_STATES:
@@ -376,6 +417,8 @@ class JobDb:
         jid = job.id
         if job.latest_run is not None:
             self._by_run[job.latest_run.id] = jid
+        for r in job.runs:
+            self._by_any_run[r.id] = jid
         if job.state == JobState.QUEUED:
             self._queued_by_queue.setdefault(job.queue, {})[jid] = job
         if job.state in _LIVE_RUN_STATES:

@@ -23,6 +23,19 @@ Fault kinds:
                    torn tail for recovery to truncate
   leader_flap      leadership is lost for the window
 
+Network fault kinds — consumed by the TCP chaos proxy
+(services/netchaos.py) between real processes, and by the simulator /
+FakeExecutor as virtual-clock partitions of the lease wire:
+
+  network_partition  the wire is severed: live connections are killed and
+                     new ones refused for the window (both directions)
+  network_blackhole  bytes are silently swallowed; connections stay open
+                     so callers hang until their own deadline fires
+  network_delay      each forwarded chunk is delayed by `param` seconds
+  network_throttle   forwarding is rate-limited (`param` scales the
+                     byte rate; see netchaos.THROTTLE_BYTES_PER_SEC)
+  network_rst        connections are reset (RST, not FIN) mid-stream
+
 Alongside the plan live the degradation primitives injected faults are
 met with: seeded exponential backoff with jitter (agent retry loop) and a
 per-executor circuit breaker (the server's lease path), so a faulty
@@ -34,6 +47,14 @@ from __future__ import annotations
 import time as _time
 from dataclasses import dataclass
 
+NETWORK_FAULT_KINDS = (
+    "network_partition",
+    "network_blackhole",
+    "network_delay",
+    "network_throttle",
+    "network_rst",
+)
+
 FAULT_KINDS = (
     "executor_crash",
     "executor_hang",
@@ -41,6 +62,13 @@ FAULT_KINDS = (
     "lease_timeout",
     "torn_log_write",
     "leader_flap",
+) + NETWORK_FAULT_KINDS
+
+# Process-lifecycle kinds only: FaultPlan.generate defaults to these so
+# pre-existing seeded soaks keep their schedules; network kinds are opted
+# into explicitly (tools/chaos_soak.py partition plans, netchaos tests).
+PROCESS_FAULT_KINDS = tuple(
+    k for k in FAULT_KINDS if k not in NETWORK_FAULT_KINDS
 )
 
 
@@ -116,18 +144,26 @@ class FaultPlan:
         events_per_kind: int = 2,
     ) -> "FaultPlan":
         """A random-but-reproducible plan over [0, duration): same seed,
-        same plan. Executor faults pick targets from `executors`."""
+        same plan. Executor faults pick targets from `executors`.
+
+        Defaults to the process-lifecycle kinds so pre-existing seeded
+        schedules are stable; pass kinds including NETWORK_FAULT_KINDS
+        entries to draw partition windows (network faults target
+        executors too — the severed wire is per executor↔server link)."""
         import numpy as np
 
         rng = np.random.default_rng(seed)
-        kinds = tuple(kinds) if kinds is not None else FAULT_KINDS
+        kinds = tuple(kinds) if kinds is not None else PROCESS_FAULT_KINDS
         executors = list(executors)
         faults = []
         for kind in kinds:
             for _ in range(events_per_kind):
                 start = float(rng.uniform(0.0, duration * 0.7))
                 window = float(rng.uniform(duration * 0.05, duration * 0.2))
-                if kind.startswith(("executor", "lease")) and executors:
+                if (
+                    kind.startswith(("executor", "lease", "network"))
+                    and executors
+                ):
                     target = str(executors[int(rng.integers(len(executors)))])
                 else:
                     target = "*"
@@ -191,26 +227,49 @@ class ChaosLeader:
 class ExponentialBackoff:
     """Exponential backoff with seeded full jitter: delay_k ~ U(0,
     min(cap, base * 2^k)). Seeded so retry schedules are reproducible in
-    chaos runs."""
+    chaos runs.
 
-    def __init__(self, base_s: float = 0.5, cap_s: float = 30.0, seed: int = 0):
+    `budget_s` bounds the CUMULATIVE sleep of one retry streak (reset()
+    to reset() / success to success): a retrying lease exchange must
+    never sleep past the lease it is renewing (lease_ttl), so the last
+    delay is clamped to the remaining budget and, once it is spent,
+    `exhausted` flips and further delays poll flat at base_s — the lease
+    is already dead, so the caller wants prompt reconnection plus
+    anti-entropy, not longer sleeps."""
+
+    def __init__(self, base_s: float = 0.5, cap_s: float = 30.0, seed: int = 0,
+                 budget_s: float | None = None):
         import numpy as np
 
         self.base_s = base_s
         self.cap_s = cap_s
+        self.budget_s = budget_s
         self._seed = seed
         self._rng = np.random.default_rng(seed)
         self.attempt = 0
+        self.spent_s = 0.0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.budget_s is not None and self.spent_s >= self.budget_s
 
     def next_delay(self) -> float:
         ceiling = min(self.cap_s, self.base_s * (2.0 ** self.attempt))
         self.attempt += 1
-        return float(self._rng.uniform(0.0, ceiling))
+        delay = float(self._rng.uniform(0.0, ceiling))
+        if self.budget_s is not None:
+            remaining = self.budget_s - self.spent_s
+            if remaining <= 0.0:
+                return min(self.base_s, self.cap_s)
+            delay = min(delay, remaining)
+        self.spent_s += delay
+        return delay
 
     def reset(self) -> None:
         import numpy as np
 
         self.attempt = 0
+        self.spent_s = 0.0
         self._rng = np.random.default_rng(self._seed)
 
 

@@ -310,6 +310,7 @@ class ExecutorAgent:
         node_info=None,
         fault_plan=None,
         backoff=None,
+        lease_ttl_s: float | None = None,
     ):
         self.client = client
         self.name = name
@@ -319,6 +320,19 @@ class ExecutorAgent:
         self.fault_plan = fault_plan
         self.backoff = backoff
         self._crashed = False
+        # Partition safety (split-brain model, docs/architecture.md):
+        # lease TTL (None = adopt the server-advertised value from the
+        # first lease reply; 0 disables), the monotonic fencing token
+        # echoed on every exchange, the instant of the last SUCCESSFUL
+        # exchange, and the pods flagged as orphan candidates once the
+        # lease expired — kept running (the server may not have expired
+        # us yet) but reconciled through ExecutorSync before this agent
+        # accepts new work.
+        self.lease_ttl_s = lease_ttl_s
+        self.fence_token = 0
+        self.last_exchange_ok: float | None = None
+        self.orphan_candidates: set[str] = set()
+        self.syncs = 0  # completed anti-entropy syncs (observability)
         # Node classification (executor/node/node_group.go): derive each
         # node's pool (label + reserved suffix) and node type up front so
         # heartbeats carry them.
@@ -357,34 +371,118 @@ class ExecutorAgent:
         self._crashed = False
         if plan.active("executor_hang", self.name, now) is not None:
             raise RuntimeError("executor hung (injected fault)")
+        if plan.active("network_partition", self.name, now) is not None:
+            # Socketless image of the netchaos sever: the exchange fails
+            # exactly like a proxied connection torn mid-RPC. Pods keep
+            # running — only the wire is gone.
+            raise ConnectionError("network partitioned (injected fault)")
         if plan.active("lease_timeout", self.name, now) is not None:
             raise TimeoutError("lease RPC timed out (injected fault)")
         slow = plan.active("lease_slow", self.name, now)
         if slow is not None and slow.param > 0:
             time.sleep(min(slow.param, 5.0))
 
+    def lease_expired(self, now: float) -> bool:
+        """True once no lease exchange has completed within lease_ttl:
+        this agent must assume the scheduler has (or soon will have)
+        reassigned its runs."""
+        ttl = self.lease_ttl_s
+        if not ttl or self.last_exchange_ok is None:
+            return False
+        return now - self.last_exchange_ok > ttl
+
+    def mark_orphan_candidates(self) -> None:
+        """Lease expired mid-partition: every running pod may already
+        have been requeued server-side. They keep running (killing work
+        the server may still own would waste it) but are flagged for the
+        anti-entropy sync, and no NEW leases are accepted until it
+        completes."""
+        if not self.orphan_candidates:
+            self.orphan_candidates = set(self.runtime.pods)
+
+    def resync(self, now: float) -> dict:
+        """Anti-entropy full-state sync (ExecutorSync): report every pod
+        actually held, tear down the ones the server classified zombie or
+        duplicate, adopt the current fence token. The one way back into
+        the lease flow after a fence bump or an expired lease."""
+        runs = [
+            {
+                "run_id": rid,
+                "job_id": pod.get("job_id", ""),
+                "phase": pod.get("phase", ""),
+            }
+            for rid, pod in self.runtime.pods.items()
+        ]
+        reply = self.client._call(
+            "ExecutorSync", {"executor": self.name, "runs": runs}
+        )
+        for kill in reply.get("kill_runs", []):
+            self.issue_handler.note_kill(kill["run_id"], now)
+            self.runtime.kill(kill["run_id"])
+            self.issue_handler.note_gone(kill["run_id"])
+        self.fence_token = int(reply.get("fence_token", 0) or 0)
+        self.orphan_candidates.clear()
+        self.acked &= set(self.runtime.pods)
+        # Runs the sync's orphan sweep already failed server-side must
+        # not be re-reported by the missing-pod reconciliation below.
+        self._reported_terminal |= set(reply.get("orphaned_run_ids", ()))
+        self.syncs += 1
+        return reply
+
     def tick(self, now: float | None = None) -> dict:
         now = time.time() if now is None else now
         self._inject_faults(now)
+        was_expired = self.lease_expired(now)
+        if was_expired:
+            self.mark_orphan_candidates()
         self.utilisation.sample(self.runtime.pods)
-        reply = self.client._call(
-            "ExecutorLease",
-            {
-                "executor": self.name,
-                "pool": self.pool,
-                "nodes": node_reports(
-                    self.nodes,
-                    self.utilisation.by_node(),
-                    self.non_framework_usage,
-                ),
-                "acked_run_ids": sorted(self.acked),
-            },
+        lease_req = {
+            "executor": self.name,
+            "pool": self.pool,
+            "nodes": node_reports(
+                self.nodes,
+                self.utilisation.by_node(),
+                self.non_framework_usage,
+            ),
+            "acked_run_ids": sorted(self.acked),
+            "fence_token": self.fence_token,
+        }
+        from .grpc_api import is_fenced_error
+
+        synced_this_tick = False
+        try:
+            reply = self.client._call("ExecutorLease", lease_req)
+        except Exception as e:
+            if not is_fenced_error(e):
+                raise
+            # The scheduler reassigned our runs while we were gone: run
+            # the anti-entropy sync, then retry the exchange once with
+            # the fresh token.
+            self.resync(now)
+            synced_this_tick = True
+            was_expired = True  # stale state: defer new leases this tick
+            lease_req["fence_token"] = self.fence_token
+            reply = self.client._call("ExecutorLease", lease_req)
+        if was_expired and not synced_this_tick:
+            # Healed before the server expired us (no fence rejection):
+            # reconcile anyway — the lease outlived its TTL, so local and
+            # server state may have diverged.
+            self.resync(now)
+        # Monotonic: never step a fresher token (e.g. one just adopted
+        # from a sync) back to an older reply's view.
+        self.fence_token = max(
+            self.fence_token, int(reply.get("fence_token", 0) or 0)
         )
+        if self.lease_ttl_s is None:
+            self.lease_ttl_s = float(reply.get("lease_ttl_s", 0.0) or 0.0)
+        self.last_exchange_ok = now
         # Store backpressure (the reference pauses pod creation while etcd
         # is over capacity, executor/application.go:63-101): defer NEW
         # leases while the server reports the store unhealthy — they stay
         # unacked and are re-sent once it recovers. Running pods continue.
-        if reply.get("store_healthy", True):
+        # An expired lease defers identically: new work waits for the
+        # anti-entropy sync to finish and the next clean exchange.
+        if reply.get("store_healthy", True) and not was_expired:
             for lease in reply.get("leases", []):
                 if lease["run_id"] not in self.acked:
                     from ..utils.compress import decompress_obj
@@ -459,7 +557,18 @@ class ExecutorAgent:
                     }
                 )
         if events:
-            self.client._call("ReportEvents", {"events": events})
+            self.client._call(
+                "ReportEvents",
+                {
+                    "events": events,
+                    # Fenced like the lease path: if the scheduler bumped
+                    # our fence between the exchange above and this send,
+                    # the report fails FAILED_PRECONDITION and the next
+                    # tick's sync resolves the runs instead.
+                    "executor": self.name,
+                    "fence_token": self.fence_token,
+                },
+            )
             # The send landed: suppress reconciliation for these runs
             # until the server's view catches up.
             self._reported_terminal |= reported
@@ -473,7 +582,14 @@ class ExecutorAgent:
         """The agent loop: retry with exponential backoff + jitter on any
         tick failure (control-plane hiccup, injected fault), reset on the
         first success — transient faults cost one delayed tick, sustained
-        ones back off toward the cap instead of hammering the server."""
+        ones back off toward the cap instead of hammering the server.
+
+        The backoff's cumulative sleep is budgeted at lease_ttl: a
+        retrying exchange must never sleep past the lease it is renewing.
+        Once the budget is spent the lease is presumed dead — running
+        pods become orphan candidates, new work is refused, and retries
+        poll flat so the heal is noticed promptly and resolved through
+        the anti-entropy sync."""
         import zlib
 
         from .chaos import ExponentialBackoff
@@ -484,11 +600,19 @@ class ExecutorAgent:
             base_s=max(interval, 0.1),
             cap_s=60.0,
             seed=zlib.crc32(self.name.encode()),
+            budget_s=self.lease_ttl_s,
         )
         while True:
             try:
                 self.tick()
             except Exception as e:  # control plane hiccup: back off + retry
+                if backoff.budget_s is None and self.lease_ttl_s:
+                    # TTL adopted from the server after the backoff was
+                    # built: arm the budget now.
+                    backoff.budget_s = self.lease_ttl_s
+                now = time.time()
+                if backoff.exhausted or self.lease_expired(now):
+                    self.mark_orphan_candidates()
                 delay = backoff.next_delay()
                 print(
                     f"executor {self.name}: tick failed: {e!r}; "
@@ -510,6 +634,15 @@ def main(argv=None):
     ap.add_argument("--memory", default="128Gi")
     ap.add_argument("--runtime", type=float, default=30.0)
     ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument(
+        "--lease-ttl",
+        type=float,
+        default=-1.0,
+        help="lease TTL seconds: no successful exchange for this long "
+        "marks running pods orphan candidates and defers new work until "
+        "an anti-entropy sync; -1 adopts the server-advertised value, "
+        "0 disables",
+    )
     ap.add_argument(
         "--backend",
         choices=["simulated", "subprocess"],
@@ -554,6 +687,7 @@ def main(argv=None):
         nodes,
         pool=args.pool,
         runtime=runtime,
+        lease_ttl_s=None if args.lease_ttl < 0 else args.lease_ttl,
     )
     agent.run(args.interval)
 

@@ -102,6 +102,10 @@ class FakeExecutor:
         # windows silence the executor; lease faults defer lease pickup.
         self.fault_plan = fault_plan
         self._crashed = False
+        self._partitioned = False
+        # Anti-entropy resolution counts from healed partitions
+        # (zombie/duplicate/orphaned), for soak observability.
+        self.anti_entropy: dict[str, int] = {}
         self.nodes = nodes if nodes is not None else make_nodes(name, pool=pool)
         self.runtime_for = runtime_for
         self.startup_delay = startup_delay
@@ -205,7 +209,7 @@ class FakeExecutor:
 
     def _chaos_gate(self, now: float) -> bool:
         """Apply the fault plan; returns True when this tick is silenced
-        (crash or hang window active)."""
+        (crash, hang, or partition window active)."""
         plan = self.fault_plan
         if plan is None:
             return False
@@ -218,6 +222,23 @@ class FakeExecutor:
                 self._seen_runs.clear()
                 self._crashed = True
             return True
+        if plan.active("network_partition", self.name, now) is not None:
+            # Severed wire, virtual-clock edition: no heartbeat, no lease
+            # pickup, no reports — but unlike a crash, pods keep running
+            # locally. Runs finishing inside the window hold their
+            # terminal report until the heal (the simulator's clock never
+            # pins on past-due finish times, so time still advances).
+            self._partitioned = True
+            return True
+        if self._partitioned:
+            # Heal: anti-entropy BEFORE any report leaves this executor —
+            # the in-process image of the agent's ExecutorSync. Zombie
+            # and duplicate pods (runs the scheduler expired/reassigned
+            # while we were dark) are torn down silently; their outcomes
+            # must never land. Server-live runs we no longer hold are
+            # reported missing (the orphan side).
+            self._partitioned = False
+            self._anti_entropy(now)
         if self._crashed:
             # First tick after the crash window: the agent's missing-pod
             # reconciliation — runs the jobdb still shows on this executor
@@ -246,6 +267,68 @@ class FakeExecutor:
                     )
                 )
         return plan.active("executor_hang", self.name, now) is not None
+
+    def _anti_entropy(self, now: float):
+        """Post-partition full-state reconciliation against the jobdb
+        (services/grpc_api.py _executor_sync semantics, in-process):
+
+          zombie     job terminal, or requeued after lease expiry — the
+                     local pod dies silently; its outcome must not land
+          duplicate  the run was superseded by a newer run (requeue +
+                     re-lease won) — the old pod dies; one attempt lives
+          orphaned   the jobdb holds a live run here that this executor
+                     lost — reported failed-retryable (requeue path)
+        """
+        from ..jobdb import JobState
+
+        txn = self.scheduler.jobdb.read_txn()
+        for run in list(self.active.values()):
+            job = txn.get(run.job_id)
+            latest = job.latest_run if job is not None else None
+            if job is None or job.state.terminal or job.state == JobState.QUEUED:
+                kind = "zombie"
+            elif (
+                latest is None
+                or latest.id != run.run_id
+                or latest.executor != self.name
+            ):
+                kind = "duplicate"
+            else:
+                continue  # still ours: keep running, report late events
+            self.active.pop(run.run_id, None)
+            self._issues.pop(run.run_id, None)
+            self.anti_entropy[kind] = self.anti_entropy.get(kind, 0) + 1
+        for job in txn.jobs_for_executor(self.name):
+            run = job.latest_run
+            if (
+                run is None
+                or run.id in self.active
+                or job.state not in (JobState.PENDING, JobState.RUNNING)
+            ):
+                # LEASED runs re-send through accept_leases; only runs
+                # the server believes STARTED here and we lost are
+                # orphans.
+                continue
+            self._seen_runs.add(run.id)  # never re-adopt a dead run
+            self.anti_entropy["orphaned"] = (
+                self.anti_entropy.get("orphaned", 0) + 1
+            )
+            self.log.publish(
+                EventSequence.of(
+                    job.queue,
+                    job.jobset,
+                    JobRunErrors(
+                        created=now,
+                        job_id=job.id,
+                        run_id=run.id,
+                        error=(
+                            "pod missing on executor after partition "
+                            "(anti-entropy reconciliation)"
+                        ),
+                        retryable=True,
+                    ),
+                )
+            )
 
     def tick(self, now: float):
         """Advance pod lifecycle; emit state-transition events."""

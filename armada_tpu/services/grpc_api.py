@@ -37,6 +37,27 @@ SERVICE = "armada_tpu.Api"
 PROTO_SERVICE = "armada_tpu.ProtoApi"
 
 
+class FencedError(RuntimeError):
+    """A lease/report call carried a fencing token older than the
+    executor's current fence: the scheduler already reassigned that
+    executor's runs (partition expiry), so the stale exchange must not
+    land. Mapped to FAILED_PRECONDITION on both wire encodings; the
+    agent's recovery is an anti-entropy ExecutorSync, which returns the
+    current token."""
+
+
+def is_fenced_error(exc) -> bool:
+    """True for a FencedError raised in-process OR its FAILED_PRECONDITION
+    image on the wire (what ApiClient/ProtoExecutorClient callers see)."""
+    if isinstance(exc, FencedError):
+        return True
+    code = getattr(exc, "code", None)
+    try:
+        return callable(code) and code() == grpc.StatusCode.FAILED_PRECONDITION
+    except Exception:
+        return False
+
+
 def _encode(obj) -> bytes:
     def default(o):
         if dataclasses.is_dataclass(o) and not isinstance(o, type):
@@ -183,6 +204,7 @@ class ProtoExecutorClient:
                 executor=req["executor"],
                 pool=req.get("pool", "default"),
                 acked_run_ids=list(req.get("acked_run_ids", ())),
+                fence_token=int(req.get("fence_token", 0) or 0),
             )
             for n in req.get("nodes", ()):
                 node = msg.nodes.add(
@@ -227,7 +249,10 @@ class ProtoExecutorClient:
                 lease["spec"] = {"__zlib__": lease.pop("spec_zlib", "")}
             return out
         if method == "ReportEvents":
-            msg = pb.ReportEventsRequest()
+            msg = pb.ReportEventsRequest(
+                executor=str(req.get("executor", "")),
+                fence_token=int(req.get("fence_token", 0) or 0),
+            )
             for e in req.get("events", ()):
                 msg.events.add(
                     type=e.get("type", ""),
@@ -242,6 +267,22 @@ class ProtoExecutorClient:
                 )
             self._proto._unary("ReportEvents", msg, pb.ReportEventsResponse)
             return {}
+        if method == "ExecutorSync":
+            msg = pb.ExecutorSyncRequest(executor=req["executor"])
+            for r in req.get("runs", ()):
+                msg.runs.add(
+                    run_id=r.get("run_id", ""),
+                    job_id=r.get("job_id", ""),
+                    phase=r.get("phase", ""),
+                )
+            resp = self._proto._unary(
+                "ExecutorSync", msg, pb.ExecutorSyncResponse
+            )
+            return json_format.MessageToDict(
+                resp,
+                preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True,
+            )
         raise ValueError(f"ProtoExecutorClient does not speak {method!r}")
 
 
@@ -495,6 +536,12 @@ class ApiServer:
                 f"lease circuit open for executor {name!r}; retry after "
                 f"{self.lease_breaker.cooldown_s:.0f}s cooldown"
             )
+        # Fence gate BEFORE the exchange touches scheduler state: a
+        # stale-fenced executor heartbeating would otherwise re-enter the
+        # heartbeat map and receive leases the anti-entropy sync hasn't
+        # validated. A fence rejection is protocol, not a server fault —
+        # it must not open the circuit.
+        self._check_fence("ExecutorLease", name, req.get("fence_token"))
         try:
             reply = self._executor_lease_inner(req)
         except Exception:
@@ -502,6 +549,29 @@ class ApiServer:
             raise
         self.lease_breaker.record_success(name)
         return reply
+
+    def _check_fence(self, method: str, name: str, token) -> None:
+        """Reject tokens older than the executor's current fence. Tokens
+        are optional (None/absent skips the check) so pre-fencing clients
+        and in-process callers keep working; an executor that was fenced
+        while holding no token (agent restart) sends 0 and is routed
+        through ExecutorSync like any stale holder."""
+        fence_of = getattr(self.scheduler, "executor_fence", None)
+        if not name or token is None or fence_of is None:
+            return
+        current = fence_of(name)
+        if int(token) < current:
+            metrics = getattr(self.scheduler, "metrics", None)
+            if metrics is not None and metrics.registry is not None:
+                metrics.fence_rejections.labels(
+                    executor=name, method=method
+                ).inc()
+            raise FencedError(
+                f"executor {name!r} holds fence token {int(token)} but the "
+                f"scheduler is at {current} (runs were reassigned after a "
+                "partition); complete an ExecutorSync before leasing or "
+                "reporting"
+            )
 
     def _executor_lease_inner(self, req):
         """One heartbeat exchange: the executor reports its nodes and acked
@@ -626,6 +696,8 @@ class ApiServer:
                 and job.latest_run.executor == name
             ):
                 cancels.append({"run_id": rid, "job_id": job.id})
+        fence_of = getattr(self.scheduler, "executor_fence", None)
+        config = getattr(self.scheduler, "config", None)
         return {
             "leases": leases,
             "cancel_runs": cancels,
@@ -633,11 +705,26 @@ class ApiServer:
             # Agents defer creating pods for NEW leases while false;
             # unacked leases are simply re-sent after recovery.
             "store_healthy": store_healthy,
+            # Fencing token to echo on the next exchange, and the
+            # server-advertised lease TTL the agent arms its partition
+            # detector with (see executor_agent.ExecutorAgent).
+            "fence_token": fence_of(name) if fence_of is not None else 0,
+            "lease_ttl_s": (
+                float(config.executor_lease_ttl_s)
+                if config is not None
+                else 0.0
+            ),
         }
 
     def _report_events(self, req):
         """Executor-side state transitions republished to the log
-        (ExecutorApi.ReportEvents, api.go:347)."""
+        (ExecutorApi.ReportEvents, api.go:347). Fenced like the lease
+        path: a partitioned executor whose runs were reassigned must not
+        land stale terminal reports — the requeued job's NEW run is the
+        only one allowed a terminal outcome."""
+        self._check_fence(
+            "ReportEvents", req.get("executor", ""), req.get("fence_token")
+        )
         from ..events import (
             EventSequence,
             JobRunErrors,
@@ -684,6 +771,109 @@ class ApiServer:
                 EventSequence.of(item["queue"], item["jobset"], *events)
             )
         return {}
+
+    def _executor_sync(self, req):
+        """Anti-entropy full-state sync (post-partition reconciliation).
+
+        The executor reports EVERY pod it actually holds; the server
+        diffs that set against the jobdb and classifies each side's
+        surplus deterministically:
+
+          zombie     the pod's run is unknown, its job already terminal,
+                     or its job was requeued after lease expiry — tear
+                     the pod down; its outcome must never land
+          duplicate  the run was superseded by a newer run of the same
+                     job (requeue + re-lease won the race) — tear the
+                     old pod down so exactly one attempt survives
+          kept       still this executor's latest live run — re-adopted
+          orphaned   the jobdb holds a live run here that the executor
+                     no longer has — failed retryable (requeue path),
+                     the missing-pod reconciliation made explicit
+
+        The reply carries the executor's CURRENT fence token: completing
+        a sync is the one way a fenced executor rejoins the lease flow.
+        """
+        from ..events import EventSequence, JobRunErrors
+
+        name = req["executor"]
+        runs = req.get("runs", [])
+        txn = self.scheduler.jobdb.read_txn()
+        agent_runs = {r["run_id"] for r in runs}
+        kill, kept, orphaned = [], [], []
+        resolutions = {"zombie": 0, "duplicate": 0, "kept": 0, "orphaned": 0}
+
+        def _kill(rid, job_id, reason, kind):
+            kill.append({"run_id": rid, "job_id": job_id, "reason": reason})
+            resolutions[kind] += 1
+
+        for r in runs:
+            rid = r["run_id"]
+            job = txn.job_for_any_run(rid)
+            if job is None:
+                _kill(rid, r.get("job_id", ""), "unknown run", "zombie")
+            elif job.state == JobState.QUEUED:
+                # Requeued after expiry, new run not yet leased: the old
+                # pod is fenced out — the re-lease must start clean.
+                _kill(rid, job.id, "job requeued after lease expiry",
+                      "zombie")
+            elif job.state.terminal:
+                _kill(rid, job.id, f"job already {job.state.value}",
+                      "zombie")
+            elif (
+                job.latest_run is None
+                or job.latest_run.id != rid
+                or job.latest_run.executor != name
+            ):
+                _kill(rid, job.id, "superseded by a newer run", "duplicate")
+            else:
+                kept.append(rid)
+                resolutions["kept"] += 1
+        import time as _t
+
+        now = _t.time()
+        for job in txn.jobs_for_executor(name):
+            run = job.latest_run
+            if run is None or run.id in agent_runs:
+                continue
+            if job.state not in (JobState.PENDING, JobState.RUNNING):
+                # LEASED runs re-send through the normal lease path.
+                continue
+            orphaned.append(run.id)
+            resolutions["orphaned"] += 1
+            self.log.publish(
+                EventSequence.of(
+                    job.queue,
+                    job.jobset,
+                    JobRunErrors(
+                        created=now,
+                        job_id=job.id,
+                        run_id=run.id,
+                        error=(
+                            "pod missing on executor after partition "
+                            "(anti-entropy sync)"
+                        ),
+                        retryable=True,
+                    ),
+                )
+            )
+        fence_of = getattr(self.scheduler, "executor_fence", None)
+        fence = fence_of(name) if fence_of is not None else 0
+        synced = getattr(self.scheduler, "note_executor_synced", None)
+        if synced is not None:
+            synced(name)
+        metrics = getattr(self.scheduler, "metrics", None)
+        if metrics is not None and metrics.registry is not None:
+            for kind, count in resolutions.items():
+                if count:
+                    metrics.anti_entropy_resolutions.labels(
+                        resolution=kind
+                    ).inc(count)
+        return {
+            "fence_token": fence,
+            "kill_runs": kill,
+            "kept_run_ids": kept,
+            "orphaned_run_ids": orphaned,
+        }
 
     def _get_logs(self, req):
         if self.binoculars is None:
@@ -805,6 +995,10 @@ class ApiServer:
             # nested proto map/bytes shapes to the JSON handler's layout.
             "ExecutorLease": (pb.LeaseRequest, pb.LeaseResponse),
             "ReportEvents": (pb.ReportEventsRequest, pb.ReportEventsResponse),
+            "ExecutorSync": (
+                pb.ExecutorSyncRequest,
+                pb.ExecutorSyncResponse,
+            ),
         }
         req_transforms = {"ExecutorLease": _lease_req_from_proto_dict}
         resp_transforms = {"ExecutorLease": _lease_resp_to_proto_dict}
@@ -864,6 +1058,8 @@ class ApiServer:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             except CircuitOpenError as e:
                 context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+            except FencedError as e:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
             resp_tf = resp_transforms.get(method)
             if resp_tf is not None:
                 out = resp_tf(out)
@@ -909,6 +1105,7 @@ class ApiServer:
             "ListPriorityOverrides": self._list_priority_overrides,
             "ExecutorLease": self._executor_lease,
             "ReportEvents": self._report_events,
+            "ExecutorSync": self._executor_sync,
             "CordonExecutor": self._cordon_executor,
         }
 
@@ -999,6 +1196,10 @@ class ApiServer:
                         context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                     except CircuitOpenError as e:
                         context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                    except FencedError as e:
+                        context.abort(
+                            grpc.StatusCode.FAILED_PRECONDITION, str(e)
+                        )
 
                 return grpc.unary_unary_rpc_method_handler(
                     unary, request_deserializer=bytes, response_serializer=bytes
@@ -1020,6 +1221,21 @@ class ApiServer:
         return server, bound_port
 
 
+# Channel options for clients that must notice a healed partition
+# promptly. gRPC's default reconnect backoff grows to 120s: a few severed
+# connection attempts during a short partition push the next connect out
+# by minutes, during which every RPC fails fast on the cached error while
+# the wire is actually fine (found by the netchaos drive). An executor
+# agent's whole partition protocol (lease TTL, fence recovery) assumes
+# reconnection is attempted within seconds of the heal.
+CHANNEL_OPTIONS = (
+    ("grpc.min_reconnect_backoff_ms", 200),
+    ("grpc.max_reconnect_backoff_ms", 5000),
+    ("grpc.keepalive_time_ms", 30000),
+    ("grpc.keepalive_timeout_ms", 10000),
+)
+
+
 class ApiClient:
     """Python client for the gRPC API (pkg/client + client/python analogue).
 
@@ -1029,12 +1245,13 @@ class ApiClient:
 
     def __init__(self, target: str, token: str | None = None, basic=None,
                  ca_cert: str | None = None):
+        options = list(CHANNEL_OPTIONS)
         if ca_cert:
             with open(ca_cert, "rb") as f:
                 creds = grpc.ssl_channel_credentials(root_certificates=f.read())
-            self.channel = grpc.secure_channel(target, creds)
+            self.channel = grpc.secure_channel(target, creds, options=options)
         else:
-            self.channel = grpc.insecure_channel(target)
+            self.channel = grpc.insecure_channel(target, options=options)
         self._metadata: list = []
         if token:
             self._metadata = [("authorization", f"Bearer {token}")]
@@ -1178,12 +1395,13 @@ class ProtoApiClient:
 
     def __init__(self, target: str, token: str | None = None, basic=None,
                  ca_cert: str | None = None):
+        options = list(CHANNEL_OPTIONS)
         if ca_cert:
             with open(ca_cert, "rb") as f:
                 creds = grpc.ssl_channel_credentials(root_certificates=f.read())
-            self.channel = grpc.secure_channel(target, creds)
+            self.channel = grpc.secure_channel(target, creds, options=options)
         else:
-            self.channel = grpc.insecure_channel(target)
+            self.channel = grpc.insecure_channel(target, options=options)
         # Same credential surface as ApiClient: Bearer or Basic metadata
         # for the server's auth chain.
         self._metadata: list = []

@@ -92,6 +92,33 @@ class BackpressureChecker:
         return bool(healthy), reason or "ok"
 
 
+class FencedExecutorChecker:
+    """Advisory surface for lease fencing (services/grpc_api.py): names
+    executors that were fenced — their runs reassigned after a partition
+    — and have not yet completed an anti-entropy ExecutorSync. Always
+    healthy: a fenced executor is the PROTOCOL working (stale exchanges
+    rejected FAILED_PRECONDITION until the sync lands); failing liveness
+    for it would restart a perfectly good scheduler. The detail string is
+    the operator's cue that a partition healed badly or an agent is not
+    running the sync."""
+
+    def __init__(self, scheduler, name: str = "fenced-executors"):
+        self.name = name
+        self.scheduler = scheduler
+
+    def check(self) -> tuple[bool, str]:
+        breached = sorted(getattr(self.scheduler, "fence_breached", ()))
+        if not breached:
+            return True, "no fenced executors"
+        fences = {
+            name: self.scheduler.executor_fence(name) for name in breached
+        }
+        return True, (
+            "advisory (degraded but live): executors awaiting "
+            f"post-fence sync: {fences}"
+        )
+
+
 class MultiChecker:
     """health/multi_checker.go: all registered checkers must pass."""
 

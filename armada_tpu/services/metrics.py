@@ -184,6 +184,42 @@ class SchedulerMetrics:
             ["executor"],
             registry=r,
         )
+        # ---- partition / lease-fencing surface (services/netchaos.py
+        # chaos + the split-brain protocol in docs/architecture.md) ----
+        self.fence_rejections = Counter(
+            "scheduler_fence_rejections_total",
+            "Lease/report RPCs rejected FAILED_PRECONDITION for carrying "
+            "a stale fencing token",
+            ["executor", "method"],
+            registry=r,
+        )
+        self.executor_fence = Gauge(
+            "scheduler_executor_fence",
+            "Current monotonic fencing token per executor (bumped when "
+            "its runs are reassigned after a partition)",
+            ["executor"],
+            registry=r,
+        )
+        self.executor_reconnects = Counter(
+            "scheduler_executor_reconnects_total",
+            "Heartbeats that healed a disconnected executor",
+            ["executor"],
+            registry=r,
+        )
+        self.reconnect_latency = Histogram(
+            "scheduler_executor_reconnect_seconds",
+            "Outage length: executor drop (heartbeat expiry) to the "
+            "first heartbeat after the heal",
+            buckets=(1, 5, 15, 60, 300, 900, 3600, 14400),
+            registry=r,
+        )
+        self.anti_entropy_resolutions = Counter(
+            "scheduler_anti_entropy_resolutions_total",
+            "Run resolutions produced by post-partition ExecutorSync "
+            "(zombie / duplicate / orphaned / kept)",
+            ["resolution"],
+            registry=r,
+        )
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS:

@@ -89,6 +89,20 @@ class SchedulerService:
         self.cordoned_queues: set[str] = set()
         self.cordoned_executors: set[str] = set()
         self.executors: dict[str, ExecutorHeartbeat] = {}
+        # Lease fencing (split-brain safety, docs/architecture.md): a
+        # monotonic token per executor, bumped (event-sourced via
+        # ExecutorFenced) whenever _expire_stale_executors reassigns that
+        # executor's runs. The gRPC layer rejects lease/report calls
+        # carrying an older token with FAILED_PRECONDITION, so a healed
+        # partition cannot resurrect zombie runs. `fence_breached` holds
+        # executors fenced since their last anti-entropy sync — surfaced
+        # as advisory health detail (health.FencedExecutorChecker).
+        self.executor_fences: dict[str, int] = {}
+        self.fence_breached: set[str] = set()
+        # Reconnect-latency bookkeeping: executor -> instant it was
+        # dropped from the heartbeat map; observed into metrics on the
+        # first heartbeat after the heal.
+        self._disconnected_at: dict[str, float] = {}
         self.is_leader = is_leader
         self.cycle_count = 0
         # Leadership-acquisition timestamp (same clock as cycle(now) —
@@ -143,6 +157,9 @@ class SchedulerService:
             self.priority_overrides.update(state["priority_overrides"])
             self.cordoned_queues.update(state["cordoned_queues"])
             self.cordoned_executors.update(state["cordoned_executors"])
+            # Older checkpoints predate fencing: absent means no fences.
+            self.executor_fences.update(state.get("executor_fences", {}))
+            self.fence_breached.update(state.get("fence_breached", ()))
             self.ingester.cursor = cursor
         self.ingester.sync()  # restore jobdb + event-sourced settings
         from ..utils.logging import get_logger
@@ -162,6 +179,8 @@ class SchedulerService:
             "priority_overrides": dict(self.priority_overrides),
             "cordoned_queues": set(self.cordoned_queues),
             "cordoned_executors": set(self.cordoned_executors),
+            "executor_fences": dict(self.executor_fences),
+            "fence_breached": set(self.fence_breached),
         }
 
     def attach_metrics(self, metrics):
@@ -268,7 +287,44 @@ class SchedulerService:
         return spec
 
     def report_executor(self, hb: ExecutorHeartbeat):
+        dropped_at = self._disconnected_at.pop(hb.name, None)
+        if dropped_at is not None:
+            m = self.metrics
+            if m is not None and m.registry is not None:
+                m.executor_reconnects.labels(executor=hb.name).inc()
+                m.reconnect_latency.observe(
+                    max(0.0, hb.last_seen - dropped_at)
+                )
         self.executors[hb.name] = hb
+
+    # ---- lease fencing (split-brain safety) ----
+
+    def executor_fence(self, name: str) -> int:
+        """Current fencing token for an executor (0 = never fenced)."""
+        return self.executor_fences.get(name, 0)
+
+    def note_executor_synced(self, name: str) -> None:
+        """An anti-entropy ExecutorSync completed: the executor holds the
+        current fence again; clear the advisory health breach.
+        Event-sourced (ExecutorFenced with synced=True) so a restarted
+        scheduler's log replay does not resurrect the breach alarm for
+        executors that healed long ago. Idempotent: repeated syncs of an
+        unbreached executor publish nothing."""
+        if name not in self.fence_breached:
+            return
+        from ..events.model import CONTROL_PLANE_JOBSET, ExecutorFenced
+
+        self.fence_breached.discard(name)
+        self.log.publish(EventSequence.of(
+            "",
+            CONTROL_PLANE_JOBSET,
+            ExecutorFenced(
+                created=_time.time(),
+                name=name,
+                fence=self.executor_fence(name),
+                synced=True,
+            ),
+        ))
 
     def set_executor_cordon(self, name: str, cordoned: bool):
         """Cordon a whole executor cluster: no new placements there
@@ -293,9 +349,30 @@ class SchedulerService:
         executor-settings and override tables from controlplaneevents).
         Runs inside ingester.sync(), so a standby's first post-failover
         cycle catches up settings on the same cursor as the jobdb."""
-        from ..events.model import ExecutorCordon, PriorityOverride
+        from ..events.model import (
+            ExecutorCordon,
+            ExecutorFenced,
+            PriorityOverride,
+        )
 
-        if isinstance(event, ExecutorCordon):
+        if isinstance(event, ExecutorFenced):
+            # Monotonic: replays and out-of-order application never lower
+            # a fence (lowering would re-admit stale-fenced reports).
+            current = self.executor_fences.get(event.name, 0)
+            self.executor_fences[event.name] = max(current, event.fence)
+            if event.synced:
+                # ExecutorSync completed at this fence: clear the breach
+                # unless a LATER fence bump already superseded the sync.
+                if event.fence >= self.executor_fences[event.name]:
+                    self.fence_breached.discard(event.name)
+            else:
+                self.fence_breached.add(event.name)
+            m = self.metrics
+            if m is not None and m.registry is not None:
+                m.executor_fence.labels(executor=event.name).set(
+                    self.executor_fences[event.name]
+                )
+        elif isinstance(event, ExecutorCordon):
             if event.cordoned:
                 self.cordoned_executors.add(event.name)
             else:
@@ -519,6 +596,8 @@ class SchedulerService:
         }
         for name in stale:
             self.executors.pop(name, None)
+            # Reconnect latency anchors at the FIRST drop of an outage.
+            self._disconnected_at.setdefault(name, now)
         if stale:
             # Leases published onto a just-dropped executor by an in-flight
             # background solve surface shortly after: keep re-checking for
@@ -532,6 +611,7 @@ class SchedulerService:
         if not stale and not expire_orphans:
             return []
         sequences = []
+        expired_executors: set[str] = set()
         txn = self.jobdb.read_txn()
         for job in txn.leased_jobs():
             run = job.latest_run
@@ -546,6 +626,7 @@ class SchedulerService:
                 )
             else:
                 continue
+            expired_executors.add(run.executor)
             events = [
                 JobRunErrors(
                     created=now,
@@ -563,6 +644,30 @@ class SchedulerService:
                 events.append(JobRequeued(created=now, job_id=job.id))
             sequences.append(
                 EventSequence.of(job.queue, job.jobset, *events)
+            )
+        # Fence every executor whose runs were just reassigned: its view
+        # of those leases is now void, and a lease/report exchange still
+        # carrying the old token must fail FAILED_PRECONDITION until it
+        # completes an anti-entropy sync. Event-sourced in the SAME batch
+        # as the expiries, so a dropped publish (lost leadership) drops
+        # both atomically and the fence map can never run ahead of the
+        # jobdb it protects.
+        if expired_executors:
+            from ..events.model import CONTROL_PLANE_JOBSET, ExecutorFenced
+
+            sequences.append(
+                EventSequence.of(
+                    "",
+                    CONTROL_PLANE_JOBSET,
+                    *[
+                        ExecutorFenced(
+                            created=now,
+                            name=name,
+                            fence=self.executor_fence(name) + 1,
+                        )
+                        for name in sorted(expired_executors)
+                    ],
+                )
             )
         return sequences
 

@@ -182,6 +182,7 @@ class ControlPlane:
         # Health surface (common/health; schedulerapp.go:71-75).
         from .health import (
             BackpressureChecker,
+            FencedExecutorChecker,
             FuncChecker,
             HeartbeatChecker,
             MultiChecker,
@@ -222,6 +223,11 @@ class ControlPlane:
                 advisory=True,
             )
         )
+        # Lease fencing is advisory detail too: a fenced executor means
+        # the split-brain protocol is WORKING (stale exchanges rejected
+        # until its anti-entropy sync) — name it for operators without
+        # tripping liveness.
+        checkers.append(FencedExecutorChecker(self.scheduler))
         self.health = MultiChecker(*checkers)
         self.health_server = None
         if health_port is not None:

@@ -128,6 +128,18 @@ class Simulator:
         # and torn-write faults exercise real crash recovery.
         self.fault_plan = fault_plan
         self.chaos_clock = None
+        # Fault window boundaries are interesting instants: stepping the
+        # virtual clock onto each start/heal keeps partition semantics
+        # crisp (a sever lands exactly mid-lease, a heal triggers
+        # anti-entropy on its own tick) and deterministic per seed.
+        self._fault_instants: tuple[float, ...] = ()
+        if fault_plan is not None:
+            instants = set()
+            for f in fault_plan.faults:
+                instants.add(f.start)
+                if f.duration != float("inf"):
+                    instants.add(f.start + f.duration)
+            self._fault_instants = tuple(sorted(instants))
         is_leader = lambda: True  # noqa: E731
         if fault_plan is not None:
             from ..services.chaos import ChaosLeader, VirtualClock
@@ -277,6 +289,10 @@ class Simulator:
                 due = self._pending_submissions[sub_idx][0]
                 if due > t:
                     nxt = min(nxt, due)
+            for instant in self._fault_instants:
+                if instant > t:
+                    nxt = min(nxt, instant)
+                    break  # sorted: the first future boundary is nearest
             t = max(nxt, t + 1e-9)
 
         txn = self.scheduler.jobdb.read_txn()

@@ -1,0 +1,926 @@
+"""Network-partition chaos, lease fencing, and anti-entropy reconciliation.
+
+Covers the wire-level fault layer (services/netchaos.py), the fencing
+protocol (grpc_api FAILED_PRECONDITION on stale tokens + ExecutorSync),
+the executor agent's lease-TTL/orphan-candidate behavior, the ingester's
+stale-run guards (one terminal outcome per job), FileLeaseLeader fencing
+under interleaved takeover, and a real-socket end-to-end partition test
+through a live ControlPlane.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from armada_tpu.services.chaos import (
+    ExponentialBackoff,
+    FaultPlan,
+    FaultSpec,
+    VirtualClock,
+)
+from armada_tpu.services.netchaos import ChaosProxy
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def echo_server():
+    """A TCP echo upstream; returns (port, close)."""
+    ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(16)
+
+    def pump(conn):
+        try:
+            while True:
+                data = conn.recv(65536)
+                if not data:
+                    return
+                conn.sendall(data)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def serve():
+        while True:
+            try:
+                conn, _ = ls.accept()
+            except OSError:
+                return
+            threading.Thread(target=pump, args=(conn,), daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return ls.getsockname()[1], ls.close
+
+
+def start_proxy(plan, clock):
+    port, close_upstream = echo_server()
+    proxy = ChaosProxy("e0", "127.0.0.1", port, plan, clock=clock)
+    proxy.start()
+    return proxy, close_upstream
+
+
+def connect(proxy):
+    sock = socket.create_connection(("127.0.0.1", proxy._listen_port), 2.0)
+    sock.settimeout(2.0)
+    return sock
+
+
+def roundtrip(sock, payload=b"ping"):
+    sock.sendall(payload)
+    got = b""
+    while len(got) < len(payload):
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("peer closed")
+        got += chunk
+    return got
+
+
+# ------------------------------------------------------------ ChaosProxy
+
+
+def test_proxy_forwards_cleanly():
+    clock = VirtualClock()
+    proxy, close = start_proxy(FaultPlan([]), clock)
+    try:
+        sock = connect(proxy)
+        assert roundtrip(sock, b"hello") == b"hello"
+        sock.close()
+        assert proxy.bytes_forwarded >= 10  # both directions
+    finally:
+        proxy.stop()
+        close()
+
+
+def test_partition_severs_live_and_new_connections_then_heals():
+    clock = VirtualClock()
+    plan = FaultPlan([FaultSpec("network_partition", "e0", 100.0, 100.0)])
+    proxy, close = start_proxy(plan, clock)
+    try:
+        sock = connect(proxy)
+        assert roundtrip(sock) == b"ping"
+        # Sever: the reaper tears the LIVE connection down mid-stream.
+        clock.now = 150.0
+        deadline = time.time() + 2.0
+        severed = False
+        while time.time() < deadline:
+            try:
+                sock.sendall(b"x")
+                if sock.recv(65536) == b"":
+                    severed = True
+                    break
+            except OSError:
+                severed = True
+                break
+            time.sleep(0.02)
+        assert severed, "live connection survived the partition window"
+        # New connections are refused for the window: the listener is
+        # DOWN, so the kernel answers ECONNREFUSED (clean — clients'
+        # reconnect machinery handles it like any dead endpoint).
+        with pytest.raises(OSError):
+            fresh = connect(proxy)
+            try:
+                fresh.sendall(b"y")
+                if fresh.recv(65536) == b"":
+                    raise ConnectionError("severed")
+            finally:
+                fresh.close()
+        # Heal: the wire works again (the accept loop rebinds its
+        # listener within one poll interval).
+        clock.now = 250.0
+        deadline = time.time() + 2.0
+        while True:
+            try:
+                healed = connect(proxy)
+                break
+            except OSError:
+                assert time.time() < deadline, "listener never came back"
+                time.sleep(0.05)
+        assert roundtrip(healed, b"back") == b"back"
+        healed.close()
+        assert proxy.connections_severed >= 1
+    finally:
+        proxy.stop()
+        close()
+
+
+def test_rst_resets_connections():
+    clock = VirtualClock(now=50.0)
+    plan = FaultPlan([FaultSpec("network_rst", "e0", 0.0, 100.0)])
+    proxy, close = start_proxy(plan, clock)
+    try:
+        # Accept-path RST: the reset may land during connect itself (the
+        # proxy RSTs as fast as it accepts) or on the first interaction —
+        # every image of it is an OSError, never a clean exchange.
+        with pytest.raises(OSError):
+            sock = connect(proxy)
+            try:
+                sock.sendall(b"x")
+                if sock.recv(65536) == b"":
+                    raise ConnectionResetError("closed")
+            finally:
+                sock.close()
+    finally:
+        proxy.stop()
+        close()
+
+
+def test_blackhole_swallows_without_closing():
+    clock = VirtualClock()
+    plan = FaultPlan([FaultSpec("network_blackhole", "e0", 10.0, 100.0)])
+    proxy, close = start_proxy(plan, clock)
+    try:
+        sock = connect(proxy)
+        assert roundtrip(sock) == b"ping"  # pre-window: clean
+        clock.now = 50.0
+        sock.sendall(b"lost")
+        sock.settimeout(0.5)
+        with pytest.raises(TimeoutError):
+            sock.recv(65536)  # no reply, no close: a routing black hole
+        sock.close()
+        assert proxy.bytes_blackholed >= 4
+    finally:
+        proxy.stop()
+        close()
+
+
+def test_delay_adds_latency():
+    clock = VirtualClock(now=50.0)
+    plan = FaultPlan(
+        [FaultSpec("network_delay", "e0", 0.0, 100.0, param=0.25)]
+    )
+    proxy, close = start_proxy(plan, clock)
+    try:
+        sock = connect(proxy)
+        started = time.time()
+        assert roundtrip(sock) == b"ping"
+        # Request and reply chunks each eat the delay at least once.
+        assert time.time() - started >= 0.25
+        sock.close()
+    finally:
+        proxy.stop()
+        close()
+
+
+def test_generate_network_kinds_deterministic():
+    kinds = ("network_partition", "network_rst")
+    a = FaultPlan.generate(9, 500.0, executors=["e0"], kinds=kinds)
+    b = FaultPlan.generate(9, 500.0, executors=["e0"], kinds=kinds)
+    assert a.faults == b.faults
+    assert {f.kind for f in a.faults} == set(kinds)
+    assert all(f.target == "e0" for f in a.faults)
+    # The default mix stays network-free: pre-existing seeded soaks keep
+    # their schedules.
+    assert not any(
+        f.kind.startswith("network")
+        for f in FaultPlan.generate(9, 500.0, executors=["e0"]).faults
+    )
+
+
+# ------------------------------------------------------ backoff budget
+
+
+def test_backoff_budget_capped_at_lease_ttl():
+    b = ExponentialBackoff(base_s=1.0, cap_s=8.0, seed=3, budget_s=5.0)
+    total = 0.0
+    for _ in range(50):
+        total += b.next_delay()
+        if b.exhausted:
+            break
+    assert total <= 5.0 + 1e-9
+    assert b.exhausted
+    # Past the budget: flat base_s polling, never longer sleeps.
+    assert b.next_delay() == 1.0
+    b.reset()
+    assert not b.exhausted and b.spent_s == 0.0
+
+
+# ------------------------------------------- ingester stale-run guards
+
+
+def _mk_sched_stack(**cfg_kw):
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.scheduler import SchedulerService
+
+    config = SchedulingConfig(
+        priority_classes={
+            "default": PriorityClass("default", 1000, preemptible=True),
+        },
+        default_priority_class="default",
+        enable_assertions=True,
+        **cfg_kw,
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    return config, log, sched
+
+
+def _publish(log, queue, jobset, *events):
+    from armada_tpu.events import EventSequence
+
+    log.publish(EventSequence.of(queue, jobset, *events))
+
+
+def test_stale_run_success_after_requeue_single_terminal_outcome():
+    """The acceptance scenario: a requeued job whose OLD run reports
+    success after the partition heals must resolve to exactly one
+    terminal outcome — the requeue wins, the new run's outcome lands."""
+    from armada_tpu.core.types import JobSpec
+    from armada_tpu.events import (
+        JobRequeued,
+        JobRunErrors,
+        JobRunLeased,
+        JobRunRunning,
+        JobRunSucceeded,
+        JobSucceeded,
+        SubmitJob,
+    )
+    from armada_tpu.jobdb import JobState
+    from armada_tpu.jobdb.jobdb import RunState
+
+    _, log, sched = _mk_sched_stack()
+    spec = JobSpec(id="j-1", queue="q", jobset="s",
+                   requests={"cpu": "1", "memory": "1Gi"})
+    _publish(log, "q", "s", SubmitJob(created=0.0, job=spec))
+    _publish(log, "q", "s",
+             JobRunLeased(created=1.0, job_id="j-1", run_id="run-old",
+                          executor="e0", node_id="n0", pool="default"))
+    _publish(log, "q", "s",
+             JobRunRunning(created=2.0, job_id="j-1", run_id="run-old"))
+    # Partition: the scheduler expires the run and requeues the job.
+    _publish(log, "q", "s",
+             JobRunErrors(created=10.0, job_id="j-1", run_id="run-old",
+                          error="executor e0 timed out", retryable=True),
+             JobRequeued(created=10.0, job_id="j-1"))
+    sched.ingester.sync()
+    job = sched.jobdb.get("j-1")
+    assert job.state == JobState.QUEUED
+    assert job.latest_run.state == RunState.FAILED
+
+    # Heal: the zombie's stale success echoes in. Both events must drop.
+    _publish(log, "q", "s",
+             JobRunSucceeded(created=12.0, job_id="j-1", run_id="run-old"),
+             JobSucceeded(created=12.0, job_id="j-1"))
+    sched.ingester.sync()
+    job = sched.jobdb.get("j-1")
+    assert job.state == JobState.QUEUED, "stale success resurrected the job"
+    assert job.latest_run.state == RunState.FAILED
+
+    # Re-leased ordering: the stale success may also land AFTER the
+    # requeue was re-leased (run-new live). It must not mark the job
+    # SUCCEEDED out from under the active run — success is run-anchored.
+    _publish(log, "q", "s",
+             JobRunLeased(created=15.0, job_id="j-1", run_id="run-tmp",
+                          executor="e1", node_id="n1", pool="default"))
+    _publish(log, "q", "s",
+             JobRunSucceeded(created=16.0, job_id="j-1", run_id="run-old"),
+             JobSucceeded(created=16.0, job_id="j-1"))
+    sched.ingester.sync()
+    job = sched.jobdb.get("j-1")
+    assert job.state == JobState.LEASED, (
+        "stale success terminated a job with a live re-leased run"
+    )
+    assert job.latest_run.id == "run-tmp"
+    # (Fail run-tmp + requeue so the canonical path below proceeds.)
+    _publish(log, "q", "s",
+             JobRunErrors(created=17.0, job_id="j-1", run_id="run-tmp",
+                          error="executor e1 timed out", retryable=True),
+             JobRequeued(created=17.0, job_id="j-1"))
+
+    # The NEW attempt's outcome is the one terminal outcome.
+    _publish(log, "q", "s",
+             JobRunLeased(created=20.0, job_id="j-1", run_id="run-new",
+                          executor="e1", node_id="n1", pool="default"))
+    _publish(log, "q", "s",
+             JobRunRunning(created=21.0, job_id="j-1", run_id="run-new"))
+    _publish(log, "q", "s",
+             JobRunSucceeded(created=30.0, job_id="j-1", run_id="run-new"),
+             JobSucceeded(created=30.0, job_id="j-1"))
+    sched.ingester.sync()
+    job = sched.jobdb.get("j-1")
+    assert job.state == JobState.SUCCEEDED
+    assert job.latest_run.id == "run-new"
+    assert [r.state for r in job.runs] == [
+        RunState.FAILED,
+        RunState.FAILED,
+        RunState.SUCCEEDED,
+    ]
+    sched.jobdb.read_txn().assert_valid()
+
+
+def test_stale_running_cannot_resurrect_expired_run():
+    from armada_tpu.core.types import JobSpec
+    from armada_tpu.events import (
+        JobRequeued,
+        JobRunErrors,
+        JobRunLeased,
+        JobRunRunning,
+        SubmitJob,
+    )
+    from armada_tpu.jobdb import JobState
+
+    _, log, sched = _mk_sched_stack()
+    spec = JobSpec(id="j-2", queue="q", jobset="s",
+                   requests={"cpu": "1", "memory": "1Gi"})
+    _publish(log, "q", "s", SubmitJob(created=0.0, job=spec))
+    _publish(log, "q", "s",
+             JobRunLeased(created=1.0, job_id="j-2", run_id="r0",
+                          executor="e0", node_id="n0", pool="default"))
+    _publish(log, "q", "s",
+             JobRunErrors(created=5.0, job_id="j-2", run_id="r0",
+                          error="executor e0 timed out", retryable=True),
+             JobRequeued(created=5.0, job_id="j-2"))
+    _publish(log, "q", "s",
+             JobRunRunning(created=6.0, job_id="j-2", run_id="r0"))
+    sched.ingester.sync()
+    job = sched.jobdb.get("j-2")
+    assert job.state == JobState.QUEUED, "zombie run came back RUNNING"
+
+
+# --------------------------------------------- fencing: scheduler + API
+
+
+def _lease_one_job(log, sched, executor="e0", job_id="jf-1"):
+    """Heartbeat + submit + cycle so `executor` holds one leased run."""
+    from armada_tpu.core.types import JobSpec, NodeSpec, QueueSpec
+    from armada_tpu.events import SubmitJob
+    from armada_tpu.services.scheduler import ExecutorHeartbeat
+    from armada_tpu.services.submit import SubmitService
+
+    submit = SubmitService(sched.config, log, scheduler=sched)
+    if "q" not in sched.queues:
+        submit.create_queue(QueueSpec("q"))
+    nodes = [
+        NodeSpec(id=f"{executor}-n0", name=f"{executor}-n0",
+                 executor=executor, pool="default",
+                 total_resources={"cpu": "8", "memory": "32Gi"})
+    ]
+    sched.report_executor(
+        ExecutorHeartbeat(name=executor, pool="default", nodes=nodes,
+                          last_seen=0.0)
+    )
+    submit.submit("q", "s", [
+        JobSpec(id=job_id, queue="q", jobset="s",
+                requests={"cpu": "1", "memory": "1Gi"})
+    ], now=0.0)
+    sched.cycle(now=1.0)
+    return submit
+
+
+def test_expiry_bumps_fence_and_stale_calls_are_rejected():
+    from armada_tpu.jobdb import JobState
+    from armada_tpu.services.grpc_api import ApiServer, FencedError
+
+    _, log, sched = _mk_sched_stack(executor_timeout_s=30.0)
+    _lease_one_job(log, sched)
+    assert sched.jobdb.get("jf-1").state == JobState.LEASED
+    assert sched.executor_fence("e0") == 0
+
+    # No heartbeat past the timeout: runs expire, fence bumps.
+    sched.cycle(now=40.0)
+    assert sched.executor_fence("e0") == 1
+    assert "e0" in sched.fence_breached
+    assert sched.jobdb.get("jf-1").state == JobState.QUEUED
+
+    api = ApiServer(None, sched, None, log)
+    # Stale lease exchange: FAILED_PRECONDITION, never reaches the inner
+    # handler (the heartbeat map must not resurrect).
+    with pytest.raises(FencedError):
+        api._executor_lease(
+            {"executor": "e0", "fence_token": 0, "nodes": []}
+        )
+    assert "e0" not in sched.executors
+    # Stale report: same rejection.
+    with pytest.raises(FencedError):
+        api._report_events(
+            {"executor": "e0", "fence_token": 0, "events": []}
+        )
+    # Current-fence calls pass.
+    reply = api._executor_lease(
+        {"executor": "e0", "fence_token": 1, "nodes": []}
+    )
+    assert reply["fence_token"] == 1
+    assert reply["lease_ttl_s"] == sched.config.executor_lease_ttl_s
+    # Tokenless calls pass too (pre-fencing clients, in-process callers).
+    api._report_events({"events": []})
+
+
+def test_fence_survives_event_replay():
+    """Fences are event-sourced: a fresh scheduler replaying the same log
+    rebuilds the same fence map (restart/failover safety) — and a breach
+    CLEARED by an ExecutorSync stays cleared across the replay (no
+    standing 'awaiting post-fence sync' false alarm)."""
+    from armada_tpu.services.scheduler import SchedulerService
+
+    config, log, sched = _mk_sched_stack(executor_timeout_s=30.0)
+    _lease_one_job(log, sched)
+    sched.cycle(now=40.0)
+    assert sched.executor_fence("e0") == 1
+    assert "e0" in sched.fence_breached
+
+    standby = SchedulerService(config, log, backend="oracle")
+    assert standby.executor_fence("e0") == 1
+    assert "e0" in standby.fence_breached  # not yet synced: alarm stands
+
+    sched.note_executor_synced("e0")  # the ExecutorSync's breach clear
+    assert "e0" not in sched.fence_breached
+    restarted = SchedulerService(config, log, backend="oracle")
+    assert restarted.executor_fence("e0") == 1
+    assert "e0" not in restarted.fence_breached, (
+        "log replay resurrected a healed fence breach"
+    )
+
+
+def test_executor_sync_classifies_zombie_duplicate_kept_orphaned():
+    from armada_tpu.events import JobRunLeased, JobRunPending
+    from armada_tpu.jobdb import JobState
+    from armada_tpu.services.grpc_api import ApiServer
+
+    _, log, sched = _mk_sched_stack(executor_timeout_s=30.0)
+    submit = _lease_one_job(log, sched, job_id="jz-1")
+
+    # jz-1: expire (requeue) -> its old run is a ZOMBIE on the agent.
+    old_run = sched.jobdb.get("jz-1").latest_run.id
+    sched.cycle(now=40.0)
+    assert sched.jobdb.get("jz-1").state == JobState.QUEUED
+
+    # jd-1: expire, then re-lease to another executor -> the agent's old
+    # run is a DUPLICATE.
+    from armada_tpu.core.types import JobSpec
+
+    submit.submit("q", "s", [
+        JobSpec(id="jd-1", queue="q", jobset="s",
+                requests={"cpu": "1", "memory": "1Gi"})
+    ], now=41.0)
+    _publish(log, "q", "s",
+             JobRunLeased(created=42.0, job_id="jd-1", run_id="dup-old",
+                          executor="e0", node_id="e0-n0", pool="default"))
+    _publish(log, "q", "s",
+             JobRunLeased(created=43.0, job_id="jd-1", run_id="dup-new",
+                          executor="e1", node_id="e1-n0", pool="default"))
+    # jk-1: live PENDING run on e0 the agent still holds -> KEPT; and
+    # jo-1: live PENDING run on e0 the agent LOST -> ORPHANED.
+    for jid, rid in (("jk-1", "keep-r"), ("jo-1", "orph-r")):
+        submit.submit("q", "s", [
+            JobSpec(id=jid, queue="q", jobset="s",
+                    requests={"cpu": "1", "memory": "1Gi"})
+        ], now=44.0)
+        _publish(log, "q", "s",
+                 JobRunLeased(created=45.0, job_id=jid, run_id=rid,
+                              executor="e0", node_id="e0-n0",
+                              pool="default"))
+        _publish(log, "q", "s",
+                 JobRunPending(created=46.0, job_id=jid, run_id=rid))
+    sched.ingester.sync()
+
+    api = ApiServer(None, sched, None, log)
+    reply = api._executor_sync({
+        "executor": "e0",
+        "runs": [
+            {"run_id": old_run, "job_id": "jz-1", "phase": "running"},
+            {"run_id": "dup-old", "job_id": "jd-1", "phase": "running"},
+            {"run_id": "keep-r", "job_id": "jk-1", "phase": "pending"},
+            {"run_id": "totally-unknown", "job_id": "", "phase": "running"},
+        ],
+    })
+    killed = {k["run_id"]: k["reason"] for k in reply["kill_runs"]}
+    assert old_run in killed and "requeued" in killed[old_run]
+    assert "dup-old" in killed and "superseded" in killed["dup-old"]
+    assert "totally-unknown" in killed
+    assert reply["kept_run_ids"] == ["keep-r"]
+    assert reply["orphaned_run_ids"] == ["orph-r"]
+    assert reply["fence_token"] == sched.executor_fence("e0")
+    assert "e0" not in sched.fence_breached  # sync clears the breach
+
+    # The orphan's failure event landed: the job requeues next cycle.
+    # (Keep both executors heartbeating so the expiry sweep stays out of
+    # the way and only the failed-run path acts.)
+    from armada_tpu.services.scheduler import ExecutorHeartbeat
+
+    for name in ("e0", "e1"):
+        sched.report_executor(
+            ExecutorHeartbeat(name=name, pool="default", nodes=[],
+                              last_seen=49.0)
+        )
+    sched.ingester.sync()
+    sched.cycle(now=50.0)
+    from armada_tpu.jobdb import JobState as JS
+
+    assert sched.jobdb.get("jo-1").state == JS.QUEUED
+    # The kept job is untouched.
+    assert sched.jobdb.get("jk-1").state == JS.PENDING
+
+
+def test_fenced_executor_checker_advisory():
+    from armada_tpu.services.health import FencedExecutorChecker
+
+    _, log, sched = _mk_sched_stack(executor_timeout_s=30.0)
+    checker = FencedExecutorChecker(sched)
+    ok, detail = checker.check()
+    assert ok and "no fenced executors" in detail
+    _lease_one_job(log, sched)
+    sched.cycle(now=40.0)
+    ok, detail = checker.check()
+    assert ok  # advisory: never fails liveness
+    assert "e0" in detail and "post-fence sync" in detail
+
+
+# -------------------------------------------- agent lease TTL + resync
+
+
+class StubClient:
+    """In-process client speaking the agent's `_call` surface."""
+
+    def __init__(self):
+        self.calls = []
+        self.lease_reply = {
+            "leases": [],
+            "cancel_runs": [],
+            "active_runs": [],
+            "store_healthy": True,
+            "fence_token": 0,
+            "lease_ttl_s": 10.0,
+        }
+        self.sync_reply = {
+            "fence_token": 0,
+            "kill_runs": [],
+            "kept_run_ids": [],
+            "orphaned_run_ids": [],
+        }
+        self.fail_lease_with = None
+
+    def _call(self, method, req):
+        self.calls.append((method, req))
+        if method == "ExecutorLease":
+            if self.fail_lease_with is not None:
+                exc, self.fail_lease_with = self.fail_lease_with, None
+                raise exc
+            return dict(self.lease_reply)
+        if method == "ExecutorSync":
+            return dict(self.sync_reply)
+        return {}
+
+
+def _mk_agent(client, ttl=None):
+    from armada_tpu.services.executor_agent import ExecutorAgent, _PodRuntime
+
+    return ExecutorAgent(
+        client,
+        "e0",
+        nodes=[{"id": "e0-n0",
+                "total_resources": {"cpu": "8", "memory": "32Gi"}}],
+        runtime=_PodRuntime(runtime_s=1000.0),
+        lease_ttl_s=ttl,
+    )
+
+
+def _lease(run_id="r1", job_id="j1"):
+    from armada_tpu.utils.compress import compress_obj
+
+    return {
+        "run_id": run_id,
+        "job_id": job_id,
+        "queue": "q",
+        "jobset": "s",
+        "node_id": "e0-n0",
+        "spec": compress_obj({"requests": {"cpu": "1"}}),
+    }
+
+
+def test_agent_adopts_server_lease_ttl_and_defers_work_after_expiry():
+    client = StubClient()
+    agent = _mk_agent(client, ttl=None)
+    client.lease_reply["leases"] = [_lease()]
+    agent.tick(now=0.0)
+    assert agent.lease_ttl_s == 10.0  # adopted from the reply
+    assert "r1" in agent.runtime.pods
+
+    # TTL expires with no successful exchange between 0 and 20: the next
+    # exchange defers NEW leases and runs the anti-entropy sync first.
+    client.lease_reply["leases"] = [_lease("r2", "j2")]
+    assert agent.lease_expired(20.0)
+    agent.tick(now=20.0)
+    methods = [m for m, _ in client.calls]
+    assert "ExecutorSync" in methods
+    assert "r2" not in agent.runtime.pods, "expired lease accepted new work"
+    assert not agent.orphan_candidates  # cleared by the sync
+    # Next clean tick accepts it (unacked leases re-send).
+    agent.tick(now=21.0)
+    assert "r2" in agent.runtime.pods
+
+
+def test_agent_recovers_from_fence_rejection_with_sync_and_retry():
+    from armada_tpu.services.grpc_api import FencedError
+
+    client = StubClient()
+    agent = _mk_agent(client, ttl=0)  # TTL disabled: isolate the fence path
+    client.lease_reply["leases"] = [_lease()]
+    agent.tick(now=0.0)
+    assert "r1" in agent.runtime.pods
+
+    # Server fenced us: next lease is rejected; the sync kills the zombie
+    # and hands over the new token; the retried exchange carries it.
+    client.fail_lease_with = FencedError("stale fence")
+    client.sync_reply = {
+        "fence_token": 3,
+        "kill_runs": [{"run_id": "r1", "job_id": "j1", "reason": "requeued"}],
+        "kept_run_ids": [],
+        "orphaned_run_ids": [],
+    }
+    agent.tick(now=5.0)
+    assert agent.fence_token == 3
+    assert "r1" not in agent.runtime.pods, "zombie pod survived the sync"
+    lease_calls = [r for m, r in client.calls if m == "ExecutorLease"]
+    assert lease_calls[-1]["fence_token"] == 3
+    assert agent.syncs == 1
+
+
+def test_agent_marks_orphan_candidates_when_partitioned():
+    client = StubClient()
+    agent = _mk_agent(client, ttl=10.0)
+    client.lease_reply["leases"] = [_lease()]
+    agent.tick(now=0.0)
+    agent.mark_orphan_candidates()  # what run() does once the TTL lapses
+    assert agent.orphan_candidates == {"r1"}
+    # Pods keep running — the server may still own them.
+    assert "r1" in agent.runtime.pods
+
+
+# ------------------------------------- FileLeaseLeader interleaved race
+
+
+from armada_tpu.services.leader import FileLeaseLeader
+
+
+class RacingLeader(FileLeaseLeader):
+    """FileLeaseLeader whose FIRST read returns a pre-captured stale
+    snapshot — the deterministic image of two candidates reading the
+    expired lease before either writes."""
+
+    def arm(self):
+        self._stale_view = FileLeaseLeader._read(self)
+
+    def _read(self):
+        view = getattr(self, "_stale_view", None)
+        if view is not None:
+            self._stale_view = None
+            return view
+        return FileLeaseLeader._read(self)
+
+
+def test_file_lease_interleaved_takeover_exactly_one_validates(tmp_path):
+    from armada_tpu.services.leader import LeaderToken
+
+    path = str(tmp_path / "lease")
+    stale_ts = time.time() - 1000.0
+    with open(path, "w") as f:
+        f.write(f"dead-holder\n{stale_ts}\n5\n")
+
+    b = RacingLeader(path, lease_duration=15.0, identity="cand-b")
+    c = RacingLeader(path, lease_duration=15.0, identity="cand-c")
+    # Both candidates observe the SAME expired lease (fence 5) ...
+    b.arm()
+    c.arm()
+    # ... then race the takeover: B writes fence 6 and confirms; C —
+    # still acting on its stale read — overwrites with fence 6 too. The
+    # later writer's file survives.
+    assert b.try_acquire_or_renew() is True
+    assert c.try_acquire_or_renew() is True
+    token_b = LeaderToken(leader=True, id=f"{b.identity}:{b._epoch}")
+    token_c = LeaderToken(leader=True, id=f"{c.identity}:{c._epoch}")
+
+    validations = [b.validate(token_b), c.validate(token_c)]
+    assert validations.count(True) == 1, (
+        "interleaved takeover must leave exactly one valid leader"
+    )
+    assert validations == [False, True]  # the surviving file is C's
+    # And B cannot renew into C's fresh lease.
+    assert b.try_acquire_or_renew() is False
+
+    # Direct fence-mismatch branch: holder matches but the file's fence
+    # moved on (another takeover happened behind our back).
+    with open(path, "w") as f:
+        f.write(f"cand-c\n{time.time()}\n99\n")
+    assert c.validate(token_c) is False
+
+
+# ------------------------------------------- partition soak (tier-1 cut)
+
+
+@pytest.mark.chaos
+def test_partition_soak_subset_deterministic():
+    """Seeded partition plans through the simulator: anti-entropy fires,
+    fences bump, every job terminates exactly once, and the final jobdb
+    digest is bit-identical per seed (seeds chosen to exercise both the
+    duplicate and zombie resolution paths; tools/chaos_soak.py runs the
+    full 20)."""
+    from tools.chaos_soak import run_plan
+
+    for seed in (3, 7):
+        first = run_plan(seed, "oracle", 24)
+        second = run_plan(seed, "oracle", 24)
+        assert first["digest"] == second["digest"]
+        assert first["finished"] == first["total"]
+        assert first["fences"], "no executor was fenced under partition"
+        assert first["anti_entropy"], "anti-entropy never resolved a run"
+        assert second["anti_entropy"] == first["anti_entropy"]
+
+
+# --------------------------------------- real sockets, end to end
+
+
+@pytest.mark.chaos
+def test_real_socket_partition_fencing_and_heal():
+    """The acceptance scenario on REAL sockets: an executor agent speaks
+    gRPC to a live ControlPlane through a ChaosProxy; the wire is
+    severed until the scheduler expires + fences the executor; after the
+    heal, the executor's stale-fenced lease AND report calls are
+    rejected with FAILED_PRECONDITION; the agent's anti-entropy sync
+    tears down the zombie pod and rejoins, and the job resolves to
+    exactly one terminal outcome."""
+    import grpc
+
+    from armada_tpu.core.config import PriorityClass, SchedulingConfig
+    from armada_tpu.jobdb import JobState
+    from armada_tpu.services.executor_agent import ExecutorAgent, _PodRuntime
+    from armada_tpu.services.grpc_api import ApiClient
+    from armada_tpu.services.server import ControlPlane
+
+    config = SchedulingConfig(
+        priority_classes={
+            "default": PriorityClass("default", 1000, preemptible=True),
+        },
+        default_priority_class="default",
+        executor_timeout_s=1.0,
+        executor_lease_ttl_s=30.0,  # fence path, not the TTL path
+        enable_assertions=True,
+    )
+    plane = ControlPlane(config, cycle_period=0.05).start()
+    clock = VirtualClock()
+    plan = FaultPlan(
+        [FaultSpec("network_partition", "agent-a", 100.0, 100.0)]
+    )
+    proxy = ChaosProxy(
+        "agent-a", "127.0.0.1", plane.grpc_port, plan, clock=clock
+    )
+    proxy.start()
+    try:
+        client = ApiClient(proxy.address)
+        agent = ExecutorAgent(
+            client,
+            "agent-a",
+            # Two nodes: the post-partition retry carries anti-affinity
+            # against the failed attempt's node, so the re-lease needs a
+            # second one to land on.
+            nodes=[
+                {"id": f"agent-a-n{i}",
+                 "total_resources": {"cpu": "8", "memory": "32Gi"}}
+                for i in range(2)
+            ],
+            runtime=_PodRuntime(runtime_s=1.0),
+        )
+        client.create_queue("q")
+        client.submit_jobs("q", "s", [
+            {"id": "net-1", "requests": {"cpu": "1", "memory": "1Gi"}}
+        ])
+
+        deadline = time.time() + 10.0
+        while time.time() < deadline and "net-1" not in {
+            p["job_id"] for p in agent.runtime.pods.values()
+        }:
+            agent.tick()
+            time.sleep(0.05)
+        assert any(
+            p["job_id"] == "net-1" for p in agent.runtime.pods.values()
+        ), "agent never received the lease"
+        old_fence = agent.fence_token
+
+        # ---- sever the wire mid-lease ----
+        clock.now = 150.0
+        with pytest.raises(Exception):
+            for _ in range(20):
+                agent.tick()
+                time.sleep(0.05)
+
+        # The scheduler expires the silent executor and bumps its fence.
+        deadline = time.time() + 10.0
+        while (
+            time.time() < deadline
+            and plane.scheduler.executor_fence("agent-a") == 0
+        ):
+            time.sleep(0.05)
+        assert plane.scheduler.executor_fence("agent-a") == 1
+        assert plane.scheduler.jobdb.get("net-1").state == JobState.QUEUED
+
+        # ---- heal ----
+        clock.now = 250.0
+
+        # THE acceptance assertion: stale-fenced lease and report calls
+        # are rejected FAILED_PRECONDITION over the real socket. The
+        # channel may still be reconnecting for a moment after the heal
+        # (UNAVAILABLE) — keep calling until the listener answers.
+        def assert_fenced(method, req):
+            deadline = time.time() + 10.0
+            while True:
+                with pytest.raises(grpc.RpcError) as exc_info:
+                    client._call(method, req)
+                code = exc_info.value.code()
+                if code == grpc.StatusCode.FAILED_PRECONDITION:
+                    return
+                assert code == grpc.StatusCode.UNAVAILABLE, code
+                assert time.time() < deadline, (
+                    f"{method} never reached the healed server"
+                )
+                time.sleep(0.1)
+
+        assert_fenced("ExecutorLease", {
+            "executor": "agent-a",
+            "pool": "default",
+            "nodes": [],
+            "acked_run_ids": [],
+            "fence_token": old_fence,
+        })
+        assert_fenced("ReportEvents", {
+            "executor": "agent-a", "fence_token": old_fence, "events": [],
+        })
+
+        # The agent recovers on its own: fenced tick -> sync -> retry.
+        deadline = time.time() + 10.0
+        while agent.syncs == 0 and time.time() < deadline:
+            try:
+                agent.tick()
+            except grpc.RpcError:
+                time.sleep(0.1)
+        assert agent.fence_token == 1
+        assert agent.syncs >= 1
+        assert not any(
+            p["job_id"] == "net-1" for p in agent.runtime.pods.values()
+        ), "zombie pod survived the anti-entropy sync"
+
+        # And the job completes exactly once through the healed wire.
+        deadline = time.time() + 15.0
+        while (
+            time.time() < deadline
+            and plane.scheduler.jobdb.get("net-1").state
+            != JobState.SUCCEEDED
+        ):
+            agent.tick()
+            time.sleep(0.05)
+        job = plane.scheduler.jobdb.get("net-1")
+        assert job.state == JobState.SUCCEEDED
+        from armada_tpu.jobdb.jobdb import RunState
+
+        terminal_ok = [r for r in job.runs if r.state == RunState.SUCCEEDED]
+        assert len(terminal_ok) == 1, "job succeeded on more than one run"
+        plane.scheduler.jobdb.read_txn().assert_valid()
+    finally:
+        proxy.stop()
+        plane.stop()

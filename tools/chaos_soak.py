@@ -1,15 +1,24 @@
 """Chaos soak: seeded FaultPlans through the simulator, invariants asserted.
 
 Runs N seeded fault plans (executor crashes/hangs, lease faults, leader
-flaps, torn event-log writes) through whole-fleet simulator runs on the
-REAL control-plane code path, asserting after each:
+flaps, torn event-log writes, and network partitions on the virtual
+clock) through whole-fleet simulator runs on the REAL control-plane code
+path, asserting after each:
 
   - zero jobdb invariant violations (enable_assertions runs
-    txn.assert_valid() after every cycle);
+    txn.assert_valid() after every cycle — including the split-brain
+    invariant that no job ever holds two active runs);
   - every job reached a terminal state (faults delay work, never lose it);
   - determinism: the same seed run twice produces the IDENTICAL final
     jobdb digest (state + final placement per job) — the property that
     makes chaos failures reproducible from a one-line seed.
+
+Every seeded plan carries partition faults on top of the generated mix:
+a short sever that heals MID-LEASE (window < executor timeout, so held
+work resumes and reports late), a long partition that heals only AFTER
+the scheduler reassigned the executor's runs (anti-entropy must resolve
+the zombies/duplicates to exactly one terminal outcome per job), and the
+workload includes gang waves so partitions land during gang placement.
 
 Usage:
   python tools/chaos_soak.py [--plans 20] [--backend oracle]
@@ -32,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def build_sim(seed: int, backend: str, n_jobs: int, data_dir: str | None):
     from armada_tpu.core.config import SchedulingConfig
-    from armada_tpu.services.chaos import FaultPlan
+    from armada_tpu.services.chaos import FaultPlan, FaultSpec
     from armada_tpu.sim.simulator import (
         ClusterSpec,
         JobTemplate,
@@ -48,13 +57,59 @@ def build_sim(seed: int, backend: str, n_jobs: int, data_dir: str | None):
     # over (waves of submissions through [0, 0.75*duration)), so crash /
     # flap / torn-write windows actually intersect live work.
     duration = 1200.0
-    plan = FaultPlan.generate(
+    timeout = 120.0
+    generated = FaultPlan.generate(
         seed, duration, executors=executors, events_per_kind=2
+    )
+    # Partition faults on top of the generated mix, engineered around the
+    # executor timeout so every seed exercises both heal regimes:
+    #   - short sever healing MID-LEASE (window < timeout: no expiry,
+    #     held pods report late);
+    #   - long partition healing AFTER REASSIGNMENT (window > timeout:
+    #     runs expired + fence bumped while dark; anti-entropy must
+    #     resolve zombies/duplicates to one terminal outcome per job).
+    # Starts anchor just after a submission wave lands (waves at
+    # 0/225/450/675; placements need a cycle, runtimes are >= 60s), so
+    # the sever catches pods RUNNING on the target — with small per-seed
+    # jitter so the interleaving still varies. The long partition also
+    # overlaps the second gang wave (t=490).
+    wave = duration * 0.75 / 4
+    short_start = wave + 35.0 + (seed % 4) * 5.0
+    long_start = 2 * wave + 30.0 + (seed % 4) * 5.0
+    partitions = (
+        FaultSpec(
+            "network_partition",
+            executors[seed % 2],
+            start=short_start,
+            duration=timeout * 0.5,
+        ),
+        FaultSpec(
+            "network_partition",
+            executors[(seed + 1) % 2],
+            start=long_start,
+            duration=timeout * 2.0,
+        ),
+        # Second long sever on the OTHER link, over the last wave: both
+        # executors see a heal-after-reassignment partition every seed,
+        # whatever the generated crash/hang windows blot out.
+        FaultSpec(
+            "network_partition",
+            executors[seed % 2],
+            start=3 * wave + 30.0 + (seed % 4) * 5.0,
+            duration=timeout * 2.0,
+        ),
+    )
+    plan = FaultPlan(
+        sorted(
+            generated.faults + partitions,
+            key=lambda f: (f.start, f.kind, f.target),
+        ),
+        seed=seed,
     )
     config = SchedulingConfig(
         enable_assertions=True,  # jobdb invariants checked every cycle
         # Crashed executors must expire well inside the sim horizon.
-        executor_timeout_s=120.0,
+        executor_timeout_s=timeout,
         max_retries=10,
     )
     clusters = [
@@ -77,6 +132,23 @@ def build_sim(seed: int, backend: str, n_jobs: int, data_dir: str | None):
                         submit_time=w * duration * 0.75 / waves + i * 20.0,
                     )
                     for w in range(waves)
+                )
+                # Gang waves: all-or-nothing placements in flight while
+                # partitions sever an executor (the gang path is where a
+                # half-resurrected zombie would hurt most).
+                + tuple(
+                    JobTemplate(
+                        id=f"g{i}w{w}",
+                        number=4,
+                        gang_cardinality=2,
+                        cpu="2",
+                        memory="4Gi",
+                        runtime=ShiftedExponential(minimum=90.0),
+                        submit_time=(
+                            w * duration * 0.75 / waves + 40.0 + i * 20.0
+                        ),
+                    )
+                    for w in range(0, waves, 2)
                 ),
             )
             for i in range(2)
@@ -124,8 +196,23 @@ def run_plan(seed: int, backend: str = "oracle", n_jobs: int = 40,
     try:
         sim, plan = build_sim(seed, backend, n_jobs, data_dir)
         result = sim.run()
-        # Final invariant sweep on top of the per-cycle assertions.
-        sim.scheduler.jobdb.read_txn().assert_valid()
+        # Final invariant sweep on top of the per-cycle assertions
+        # (assert_valid includes the split-brain invariant: at most one
+        # live run per job, every run id owned by exactly one job).
+        txn = sim.scheduler.jobdb.read_txn()
+        txn.assert_valid()
+        # Explicit double-active-run sweep, belt over the braces: a
+        # healed partition must never leave a job running twice.
+        from armada_tpu.jobdb.jobdb import RunState
+
+        live = (RunState.LEASED, RunState.PENDING, RunState.RUNNING)
+        for job in txn.all_jobs():
+            active = [r.id for r in job.runs if r.state in live]
+            if len(active) > 1:
+                raise AssertionError(
+                    f"seed {seed}: job {job.id} holds two active runs "
+                    f"{active} after the soak"
+                )
         unfinished = result.total_jobs - sum(
             1 for s in result.events_by_job.values() if s.terminal
         )
@@ -135,6 +222,10 @@ def run_plan(seed: int, backend: str = "oracle", n_jobs: int = 40,
                 "reached a terminal state under chaos"
             )
         crashes = getattr(sim.log, "crashes", 0)
+        anti_entropy: dict = {}
+        for ex in sim.executors:
+            for kind, count in getattr(ex, "anti_entropy", {}).items():
+                anti_entropy[kind] = anti_entropy.get(kind, 0) + count
         return {
             "seed": seed,
             "digest": jobdb_digest(sim),
@@ -145,6 +236,8 @@ def run_plan(seed: int, backend: str = "oracle", n_jobs: int = 40,
             "makespan": round(result.makespan, 1),
             "faults_fired": plan.fired(),
             "log_crashes": crashes,
+            "anti_entropy": anti_entropy,
+            "fences": dict(sim.scheduler.executor_fences),
         }
     finally:
         if tmp is not None:
