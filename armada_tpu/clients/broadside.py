@@ -283,7 +283,7 @@ class GrpcBackend:
     name = "grpc"
 
     def __init__(self, server: str):
-        from .grpc_client import connect
+        from ..services.grpc_api import connect
 
         self.server = server
         self._connect = connect

@@ -16,7 +16,7 @@ import sys
 
 import yaml
 
-from .grpc_client import connect
+from ..services.grpc_api import connect
 
 
 def _print(obj):
@@ -188,6 +188,7 @@ def cmd_server(args):
     plane = ControlPlane(
         config,
         backend=args.backend,
+        mesh=args.mesh or None,
         grpc_port=args.port,
         metrics_port=args.metrics_port,
         lookout_port=args.lookout_port,
@@ -294,6 +295,12 @@ def build_parser():
     )
     srv.add_argument("--config")
     srv.add_argument("--backend", default="oracle", choices=["oracle", "kernel"])
+    srv.add_argument(
+        "--mesh",
+        default="",
+        help="sharded-solve mesh for --backend kernel: chip count (\"8\") "
+        "or hosts x chips (\"2x4\", two-level ICI+DCN hierarchy)",
+    )
     srv.add_argument("--cycle-period", type=float, default=1.0)
     srv.add_argument("--tls-cert", default="", help="TLS certificate (PEM)")
     srv.add_argument("--tls-key", default="", help="TLS private key (PEM)")

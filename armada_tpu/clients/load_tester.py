@@ -15,7 +15,7 @@ import json
 import sys
 import time
 
-from .grpc_client import connect
+from ..services.grpc_api import connect
 
 
 def percentile(values, p):

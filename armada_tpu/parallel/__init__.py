@@ -1,3 +1,19 @@
-from .mesh import node_sharded_solve, make_node_mesh, pad_nodes
+"""Device-mesh execution: 1D single-host sharding (mesh), the two-level
+(hosts, chips) hierarchy (multihost), and the multi-process coordinator
+(launcher).
+
+Lazy re-exports: importing this package must not pull in the solver
+chain, because solver.kernel materializes jax constants at import time
+(backend init) and the launcher's worker processes must call
+jax.distributed.initialize before ANY jax computation runs.
+"""
 
 __all__ = ["node_sharded_solve", "make_node_mesh", "pad_nodes"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from . import mesh
+
+        return getattr(mesh, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -31,20 +31,53 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..solver.dist import ShardDist
+from ..solver.dist import CollectiveStats, ShardDist
 from ..solver.kernel import solve_impl
 from ..solver.kernel_prep import DeviceRound
 
-# Per-field partition specs: node-axis position in each sharded array.
-_NODE_SHARDED = {
-    "alloc0": P(None, "nodes", None),
-    "node_total": P("nodes", None),
-    "node_taints": P("nodes", None),
-    "node_labels": P("nodes", None),
-    "node_id_rank": P("nodes",),
-    "node_unschedulable": P("nodes",),
-    "node_gid": P("nodes",),
+# Node-axis position per sharded field; the axis entry is filled in with
+# the mesh axis name(s) — "nodes" for the 1D mesh, ("hosts", "chips") for
+# the two-level mesh (parallel/multihost.py).
+_NODE_AXIS_POS = {
+    "alloc0": 1,
+    "node_total": 0,
+    "node_taints": 0,
+    "node_labels": 0,
+    "node_id_rank": 0,
+    "node_unschedulable": 0,
+    "node_gid": 0,
 }
+
+
+def node_specs(axis) -> dict:
+    """Per-field PartitionSpecs sharding the node axis over `axis` (an
+    axis name or tuple of axis names)."""
+    ndim = {"alloc0": 3, "node_total": 2, "node_taints": 2, "node_labels": 2}
+    out = {}
+    for name, pos in _NODE_AXIS_POS.items():
+        dims = [None] * ndim.get(name, 1)
+        dims[pos] = axis
+        out[name] = P(*dims)
+    return out
+
+
+_NODE_SHARDED = node_specs("nodes")
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the promoted jax.shard_map
+    spells the replication check `check_vma`; the older
+    jax.experimental.shard_map spells it `check_rep`."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
 
 
 def make_node_mesh(devices=None) -> Mesh:
@@ -92,59 +125,140 @@ def _pad_words(aw: np.ndarray, n_nodes: int) -> np.ndarray:
     return np.pad(aw, [(0, 0), (0, need - aw.shape[1])])
 
 
-def _spec_tree(dev: DeviceRound):
+def spec_tree(dev: DeviceRound, specs: dict):
     """A DeviceRound-shaped pytree of PartitionSpecs (meta fields kept).
 
     Every data leaf (including scalar leaves like global_tokens) gets a
     spec; only the node-major arrays are actually sharded."""
     from ..solver.kernel_prep import _META_FIELDS
 
-    specs = {
-        f.name: _NODE_SHARDED.get(f.name, P())
+    full = {
+        f.name: specs.get(f.name, P())
         for f in dataclasses.fields(DeviceRound)
         if f.name not in _META_FIELDS
     }
-    return dataclasses.replace(dev, **specs)
+    return dataclasses.replace(dev, **full)
 
 
-def node_sharded_solve(mesh: Mesh):
-    """Jitted round solve with node-sharded inputs over `mesh`.
+def place_round(dev: DeviceRound, mesh: Mesh, specs: dict) -> DeviceRound:
+    """Place a DeviceRound's arrays onto the mesh so jit does not
+    re-layout on every call. make_array_from_callback assembles each
+    global array from per-device slices of the host copy, which also
+    works when the mesh spans multiple processes (each process holds the
+    full host copy and contributes its addressable shards)."""
+    placed = {}
+    multiproc = jax.process_count() > 1
+    for f in dataclasses.fields(DeviceRound):
+        v = getattr(dev, f.name)
+        if isinstance(v, (np.ndarray, jax.Array)):
+            sharding = NamedSharding(mesh, specs.get(f.name, P()))
+            if multiproc:
+                arr = np.asarray(v)
+                placed[f.name] = jax.make_array_from_callback(
+                    arr.shape, sharding, lambda idx, a=arr: a[idx]
+                )
+            else:
+                placed[f.name] = jax.device_put(v, sharding)
+    return dataclasses.replace(dev, **placed)
 
-    Returns a callable dev -> outputs. Inputs must have the node axis padded
-    to a multiple of the mesh size (pad_nodes). Outputs are replicated and
-    identical to the single-device solve on the same snapshot
-    (tests/test_multichip.py asserts this)."""
-    n_shards = mesh.devices.size
-    dist = ShardDist("nodes", n_shards)
+
+def sharded_solve(mesh: Mesh, dist, specs: dict):
+    """Jitted round solve with node-sharded inputs over `mesh` through the
+    given dist seam. Returns a callable dev -> outputs with `.stats` (the
+    dist's trace-time CollectiveStats) and `.mesh_shape` attached. Inputs
+    must have the node axis padded to a multiple of the shard count
+    (pad_nodes). Outputs are replicated and identical to the
+    single-device solve on the same snapshot (tests/test_multichip.py,
+    tests/test_multihost.py assert this)."""
 
     def inner(dev):
+        # Trace-time side effect: inner's body runs once per (re)trace,
+        # so the stats describe THIS compiled program only.
+        if dist.stats is not None:
+            dist.stats.begin_trace()
         return solve_impl(dev, dist=dist)
 
     def build(dev: DeviceRound):
-        sharded = jax.shard_map(
-            inner,
-            mesh=mesh,
-            in_specs=(_spec_tree(dev),),
-            out_specs=P(),
-            check_vma=False,
+        return jax.jit(
+            shard_map_compat(
+                inner, mesh, in_specs=(spec_tree(dev, specs),), out_specs=P()
+            )
         )
-        return jax.jit(sharded)
 
     cache = {}
 
-    def run(dev: DeviceRound):
-        # One compiled program per (shapes, static config); shard_map in_specs
-        # depend only on the treedef, so cache by it.
-        key = jax.tree_util.tree_structure(dev)
-        if key not in cache:
-            cache[key] = build(dev)
-        # Place inputs on the mesh so jit does not re-layout on every call.
-        placed = {}
-        for f in dataclasses.fields(DeviceRound):
-            v = getattr(dev, f.name)
-            if isinstance(v, (np.ndarray, jax.Array)):
-                spec = _NODE_SHARDED.get(f.name, P())
-                placed[f.name] = jax.device_put(v, NamedSharding(mesh, spec))
-        return cache[key](dataclasses.replace(dev, **placed))
+    def _cache_key(dev):
+        # Tree structure alone is not enough: the cache holds
+        # AOT-compiled executables, which are shape-specialized (unlike
+        # a jit wrapper, which re-specializes internally).
+        leaves, treedef = jax.tree_util.tree_flatten(dev)
+        return treedef, tuple(
+            (getattr(v, "shape", ()), str(getattr(v, "dtype", type(v))))
+            for v in leaves
+        )
 
+    # The prepare(dev) -> run(dev) pattern (parallel/launcher.py) hands
+    # the SAME DeviceRound to both calls; re-placing it would double the
+    # host->device work (make_array_from_callback rebuilds every array
+    # from the full host copy on multi-process meshes). One-entry memo,
+    # keyed by identity WITH a strong ref so the id cannot be reused.
+    last_placed = []
+
+    # dist.stats is trace-time state: it describes the most recently
+    # COMPILED program, which with >1 cached executable (shape buckets,
+    # several pools) is not necessarily the one a given run() executes.
+    # Snapshot per cache key at compile time; run.last_stats always
+    # names the program that just ran.
+    stats_by_key = {}
+
+    def _compiled(dev):
+        if last_placed and last_placed[0] is dev:
+            placed = last_placed[1]
+        else:
+            placed = place_round(dev, mesh, specs)
+            last_placed[:] = [dev, placed]
+        key = _cache_key(dev)
+        if key not in cache:
+            # AOT (lower + compile, no execution): on a multi-process
+            # mesh every EXECUTABLE gets its own gloo communicator whose
+            # cross-process rendezvous has a hard ~30s window at first
+            # execution — compiling AOT lets callers (parallel/launcher)
+            # barrier between compile and execute so all processes enter
+            # that window together, however far their multi-minute
+            # compile wall clocks drifted apart.
+            cache[key] = build(dev).lower(placed).compile()
+            if dist.stats is not None:
+                stats_by_key[key] = dataclasses.replace(dist.stats)
+        run.last_stats = stats_by_key.get(key)
+        return cache[key], placed
+
+    def run(dev: DeviceRound):
+        fn, placed = _compiled(dev)
+        try:
+            return fn(placed)
+        finally:
+            # Keep the placed tree only across a prepare(dev) -> run(dev)
+            # pair; retaining it between service cycles would pin a full
+            # round's host+device arrays that the caller has dropped.
+            last_placed.clear()
+
+    def prepare(dev: DeviceRound):
+        """Compile this round's program without executing it (see
+        _compiled); the next run(dev) dispatches the cached executable
+        immediately."""
+        _compiled(dev)
+
+    run.prepare = prepare
+    run.stats = dist.stats
+    run.last_stats = None
+    run.n_shards = dist.n_shards
+    run.mesh_shape = tuple(mesh.devices.shape)
     return run
+
+
+def node_sharded_solve(mesh: Mesh):
+    """The 1D path: every device is a standalone shard, all collectives
+    are mesh-wide (single-host ICI). See parallel/multihost.py for the
+    two-level (hosts, chips) variant."""
+    dist = ShardDist("nodes", mesh.devices.size, stats=CollectiveStats())
+    return sharded_solve(mesh, dist, _NODE_SHARDED)

@@ -1387,6 +1387,19 @@ class ApiClient:
             yield _decode(msg)
 
 
+def connect(target: str, ca_cert: str | None = None,
+            token: str | None = None) -> ApiClient:
+    """Env-aware client factory (pkg/client ApiConnectionDetails
+    analogue): TLS when a CA bundle is given (flag or ARMADA_CA_CERT),
+    Bearer token from ARMADA_TOKEN when present — the client-side half
+    of the server's TLS + auth chain (client/rust/src/auth.rs role)."""
+    import os
+
+    ca_cert = ca_cert or os.environ.get("ARMADA_CA_CERT") or None
+    token = token or os.environ.get("ARMADA_TOKEN") or None
+    return ApiClient(target, ca_cert=ca_cert, token=token)
+
+
 class ProtoApiClient:
     """Binary-protobuf client over proto/armada.proto — what a codegen
     client in any protobuf language looks like against this server (the

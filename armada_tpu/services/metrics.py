@@ -213,6 +213,42 @@ class SchedulerMetrics:
             buckets=(1, 5, 15, 60, 300, 900, 3600, 14400),
             registry=r,
         )
+        # ---- two-level mesh surface (parallel/multihost.py): topology,
+        # trace-time collective accounting of the compiled sharded round
+        # program, and the per-host sharded-solve wall clock — the gauges
+        # the DCN cost model in docs/architecture.md regresses against.
+        self.solve_mesh_extent = Gauge(
+            "scheduler_solve_mesh_extent",
+            "Sharded-solve mesh extent by axis (hosts / chips)",
+            ["axis"],
+            registry=r,
+        )
+        self.solve_collective_sites = Gauge(
+            "scheduler_solve_collective_sites",
+            "Cross-shard collective call sites traced into the compiled "
+            "round program, by kind (selects / fills / point_ops)",
+            ["kind"],
+            registry=r,
+        )
+        self.solve_collective_bytes = Gauge(
+            "scheduler_solve_collective_bytes",
+            "Bytes one shard receives per execution of all traced "
+            "collective sites, by fabric level (ici / dcn)",
+            ["level"],
+            registry=r,
+        )
+        self.solve_dcn_scalars_per_select = Gauge(
+            "scheduler_solve_dcn_scalars_per_select",
+            "Cross-host scalars per candidate selection: one winner "
+            "tuple per host (O(hosts x keys), chip count cancels)",
+            registry=r,
+        )
+        self.shard_solve_time = Histogram(
+            "scheduler_shard_solve_seconds",
+            "Per-host wall clock of the sharded round solve",
+            ["pool"],
+            registry=r,
+        )
         self.anti_entropy_resolutions = Counter(
             "scheduler_anti_entropy_resolutions_total",
             "Run resolutions produced by post-partition ExecutorSync "

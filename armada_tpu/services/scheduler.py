@@ -1196,26 +1196,53 @@ class SchedulerService:
         ]
 
     def _resolve_sharded_run(self):
-        """Lazily build the node-sharded solve runner for self.mesh."""
+        """Lazily build the sharded solve runner for self.mesh: an int or
+        1D jax Mesh selects the single-host node-sharded path, an "HxC"
+        string / (hosts, chips) tuple / 2D Mesh the two-level
+        ICI-within-host + DCN-across-hosts hierarchy
+        (parallel/multihost.py)."""
         if self._sharded_run is None:
-            from jax.sharding import Mesh
+            from ..parallel.multihost import resolve_solver
 
-            from ..parallel.mesh import make_node_mesh, node_sharded_solve
-
-            mesh = self.mesh
-            if not isinstance(mesh, Mesh):
-                import jax
-
-                n = int(mesh)
-                devices = jax.devices()[:n]
-                if len(devices) < n:
-                    raise RuntimeError(
-                        f"mesh={n} requested but only {len(devices)} devices"
-                    )
-                mesh = make_node_mesh(devices)
-            self._mesh_size = mesh.devices.size
-            self._sharded_run = node_sharded_solve(mesh)
+            self._sharded_run = resolve_solver(self.mesh)
+            self._mesh_size = self._sharded_run.n_shards
         return self._sharded_run
+
+    def _note_mesh_metrics(self, pool: str, solve_s: float):
+        """Mesh topology + per-program collective accounting gauges, so
+        DCN cost regressions show in the metrics trajectory alongside
+        the per-shard (this host's) sharded-solve wall clock."""
+        if self.metrics is None or self.metrics.registry is None:
+            return
+        run = self._sharded_run
+        shape = run.mesh_shape
+        hosts, chips = (shape if len(shape) == 2 else (1, shape[0]))
+        self.metrics.solve_mesh_extent.labels(axis="hosts").set(hosts)
+        self.metrics.solve_mesh_extent.labels(axis="chips").set(chips)
+        # last_stats describes the program the cycle just executed;
+        # run.stats only the most recently TRACED one, which with several
+        # pools / shape buckets may be a different program.
+        stats = getattr(run, "last_stats", None) or run.stats
+        if stats is not None:
+            for kind, value in (
+                ("selects", stats.selects),
+                ("fills", stats.fills),
+                ("point_ops", stats.point_ops),
+            ):
+                self.metrics.solve_collective_sites.labels(kind=kind).set(
+                    value
+                )
+            for level, nbytes in (
+                ("ici", stats.ici_bytes),
+                ("dcn", stats.dcn_bytes),
+            ):
+                self.metrics.solve_collective_bytes.labels(level=level).set(
+                    nbytes
+                )
+            self.metrics.solve_dcn_scalars_per_select.set(
+                stats.per_select_dcn_scalars
+            )
+        self.metrics.shard_solve_time.labels(pool=pool).observe(solve_s)
 
     # ------------------------------------------------------------------
     # Incremental snapshots (O(delta) cycles): the service-side analogue
@@ -1492,11 +1519,21 @@ class SchedulerService:
                 # The sharded solve is one fused program; the budget is
                 # enforced between pools only (chunked pass 1 is
                 # single-device for now).
+                import time as _t
+
                 from ..parallel.mesh import pad_nodes
 
                 run = self._resolve_sharded_run()
+                t0 = _t.monotonic()
                 out = run(pad_nodes(dev, self._mesh_size))
+                # jit dispatch is asynchronous: force execution so the
+                # histogram records solve wall clock, not dispatch time.
+                import jax as _jax
+
+                _jax.block_until_ready(out)
+                out = dict(out)
                 out["truncated"] = False
+                self._note_mesh_metrics(snap.pool, _t.monotonic() - t0)
             else:
                 out = solve_round(dev, budget_s=budget_s)
             truncated = bool(out.get("truncated", False))

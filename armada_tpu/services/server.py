@@ -31,6 +31,10 @@ class ControlPlane:
         config: SchedulingConfig | None = None,
         *,
         backend: str = "oracle",
+        # Sharded-solve mesh spec for the kernel backend: int (1D chip
+        # count), "HxC" / (hosts, chips) (two-level ICI+DCN hierarchy,
+        # parallel/multihost.py), or a jax Mesh. None = unsharded.
+        mesh=None,
         cycle_period: float = 1.0,
         grpc_port: int = 0,
         metrics_port: int | None = None,
@@ -75,8 +79,8 @@ class ControlPlane:
                 max_ingest_lag_events=self.config.max_ingest_lag_events,
             )
         self.scheduler = SchedulerService(
-            self.config, self.log, backend=backend, is_leader=self.leader,
-            checkpoint=_ckpt("scheduler"),
+            self.config, self.log, backend=backend, mesh=mesh,
+            is_leader=self.leader, checkpoint=_ckpt("scheduler"),
         )
         # Submit-side shedding consumes store capacity AND round-deadline
         # pressure (repeated maxSchedulingDuration truncations) through one

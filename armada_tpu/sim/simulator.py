@@ -109,6 +109,10 @@ class Simulator:
         config: SchedulingConfig | None = None,
         *,
         backend: str = "oracle",
+        # Sharded-solve mesh spec, forwarded to SchedulerService: an int
+        # (1D single-host chip count), an "HxC" string / (hosts, chips)
+        # tuple (two-level ICI+DCN hierarchy, parallel/multihost.py), or
+        # a prebuilt jax Mesh. None = unsharded.
         mesh=None,
         snapshot_mode: str = "auto",
         seed: int = 0,

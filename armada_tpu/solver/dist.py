@@ -26,10 +26,71 @@ local paths share one code body — parity by construction.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
 from ..ops.select import lex_argmin, _sentinel
+
+
+@dataclasses.dataclass
+class CollectiveStats:
+    """Trace-time accounting of the kernel's cross-shard traffic.
+
+    Every collective in the dist seam notes itself here while the kernel
+    BODY is being traced, so the numbers describe the compiled program:
+    how many collective call sites it contains and how many scalars each
+    one moves per execution, split by fabric level (ICI within a host,
+    DCN across hosts). while_loop bodies trace once, so a site inside the
+    fill loop executes `num_loops` times at runtime — multiply to get
+    totals. A 1D (single-host) mesh books everything as ICI.
+
+    The headline number for the DCN cost model (docs/architecture.md) is
+    `per_select_dcn_scalars`: the cross-host traffic of ONE candidate
+    selection — one winner tuple per host, O(hosts x num_keys), however
+    many chips each host holds.
+    """
+
+    n_hosts: int = 1
+    n_chips: int = 1
+    selects: int = 0  # lex_argmin_nodes sites (candidate selection)
+    fills: int = 0  # fill_candidates sites (batched best-fit merge)
+    point_ops: int = 0  # take/take_col/take_rows psum-class sites
+    ici_scalars: int = 0  # scalars received per shard, all sites, one exec
+    dcn_scalars: int = 0
+    ici_bytes: int = 0
+    dcn_bytes: int = 0
+    per_select_dcn_scalars: int = 0
+    per_select_ici_scalars: int = 0
+
+    def begin_trace(self) -> None:
+        """Zero the per-program accounting. Called at the START of each
+        kernel trace (sharded_solve's inner body runs once per trace),
+        so after any solve the numbers describe the most recently
+        compiled program — not an accumulation over every retrace and
+        shape bucket the runner ever compiled."""
+        self.selects = self.fills = self.point_ops = 0
+        self.ici_scalars = self.dcn_scalars = 0
+        self.ici_bytes = self.dcn_bytes = 0
+        self.per_select_dcn_scalars = self.per_select_ici_scalars = 0
+
+    def note(self, level: str, arrays) -> None:
+        fanin = self.n_chips if level == "ici" else self.n_hosts
+        scalars = bytes_ = 0
+        for a in arrays:
+            n = fanin * int(getattr(a, "size", 1))
+            scalars += n
+            bytes_ += n * jnp.dtype(a.dtype).itemsize
+        if level == "ici":
+            self.ici_scalars += scalars
+            self.ici_bytes += bytes_
+        else:
+            self.dcn_scalars += scalars
+            self.dcn_bytes += bytes_
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
 
 
 def _fill_sort(keys, mask, B):
@@ -107,9 +168,15 @@ class ShardDist:
     for them (the collectives below are the only cross-shard data flow, and
     they produce shard-invariant results)."""
 
-    def __init__(self, axis: str, n_shards: int):
+    def __init__(self, axis: str, n_shards: int, stats: CollectiveStats | None = None):
         self.axis = axis
         self.n_shards = n_shards
+        # Trace-time traffic accounting; a 1D mesh is a single host, so
+        # every collective books as ICI. note() fan-in follows n_chips.
+        self.stats = stats
+        if stats is not None:
+            stats.n_hosts = 1
+            stats.n_chips = n_shards
 
     def num_nodes(self, alloc):
         return alloc.shape[1] * self.n_shards
@@ -118,6 +185,9 @@ class ShardDist:
         return (jax.lax.axis_index(self.axis) * ln).astype(jnp.int32)
 
     def _psum(self, v):
+        if self.stats is not None:
+            self.stats.point_ops += 1
+            self.stats.note("ici", [v])
         if v.dtype == jnp.bool_:
             return jax.lax.psum(v.astype(jnp.int32), self.axis) > 0
         return jax.lax.psum(v, self.axis)
@@ -127,6 +197,13 @@ class ShardDist:
         gkeys = [jax.lax.all_gather(k[lidx], self.axis) for k in keys]
         gfound = jax.lax.all_gather(lfound, self.axis)
         ggid = jax.lax.all_gather(gids[lidx], self.axis)
+        if self.stats is not None:
+            self.stats.selects += 1
+            self.stats.note("ici", [k[lidx] for k in keys] + [lfound, lidx])
+            if not self.stats.per_select_ici_scalars:
+                self.stats.per_select_ici_scalars = self.n_shards * (
+                    len(keys) + 2
+                )
         widx, wfound = lex_argmin(gkeys, gfound)
         return jnp.where(wfound, ggid[widx], 0).astype(jnp.int32), wfound
 
@@ -176,10 +253,139 @@ class ShardDist:
         lkeys = [k[take] for k in mk]
         lcaps = jnp.where(mask[take], caps[take], 0)
         lgids = gids[take]
+        if self.stats is not None:
+            self.stats.fills += 1
+            self.stats.note("ici", lkeys + [lcaps, lgids])
         gkeys = [
             jax.lax.all_gather(k, self.axis).reshape(-1) for k in lkeys
         ]
         gcaps = jax.lax.all_gather(lcaps, self.axis).reshape(-1)
         ggids = jax.lax.all_gather(lgids, self.axis).reshape(-1)
+        order = jnp.lexsort(tuple(reversed(gkeys)))[:B]
+        return gcaps[order], ggids[order]
+
+
+class HierarchicalDist(ShardDist):
+    """Two-level node sharding for a 2D `(hosts, chips)` mesh.
+
+    Same seam as ShardDist — every kernel entry point is oblivious to
+    which one it got — but each shard-crossing collective is decomposed
+    to match the physical fabric of a multi-host TPU pod (or a
+    multi-process CPU mesh standing in for one):
+
+      1. local per-shard reduction (no traffic);
+      2. all_gather over the **chip** axis + reduction — ICI, stays
+         inside one host/slice;
+      3. all_gather over the **host** axis of ONE winner tuple per host
+         + final reduction — the only DCN traffic, O(hosts x num_keys)
+         scalars per select instead of the flat mesh's
+         O(hosts x chips x num_keys).
+
+    Bit-exactness: the last key of every lexicographic reduction is
+    globally unique among masked entries (node_id_rank / node gid), so
+    the reduction has a single well-defined winner no matter how it is
+    associated — the two-level argmin and top-B merges produce exactly
+    the flat ShardDist's (and therefore LOCAL's) results. psum-class
+    point reads combine one owning shard's values with zeros, exact in
+    any association. tests/test_multihost.py asserts all of this.
+
+    Binds/evictions stay collective-free at both levels: ownership of a
+    global node id is a local predicate (ShardDist._owned), so scatter
+    updates never cross ICI or DCN.
+    """
+
+    def __init__(
+        self,
+        host_axis: str,
+        chip_axis: str,
+        n_hosts: int,
+        n_chips: int,
+        stats: CollectiveStats | None = None,
+    ):
+        self.host_axis = host_axis
+        self.chip_axis = chip_axis
+        self.n_hosts = n_hosts
+        self.n_chips = n_chips
+        self.n_shards = n_hosts * n_chips
+        self.stats = stats
+        if stats is not None:
+            stats.n_hosts = n_hosts
+            stats.n_chips = n_chips
+
+    def _offset(self, ln):
+        # Node blocks are host-major: PartitionSpec (hosts, chips) splits
+        # the global node axis into hosts*chips blocks with block index
+        # host*chips + chip.
+        shard = jax.lax.axis_index(self.host_axis) * self.n_chips + (
+            jax.lax.axis_index(self.chip_axis)
+        )
+        return (shard * ln).astype(jnp.int32)
+
+    def _psum(self, v):
+        # ICI partial sums first, then one partial per host over DCN.
+        # Exact for the kernel's point reads: only the owning shard
+        # contributes non-zeros.
+        if self.stats is not None:
+            self.stats.point_ops += 1
+            self.stats.note("ici", [v])
+            self.stats.note("dcn", [v])
+        as_bool = v.dtype == jnp.bool_
+        if as_bool:
+            v = v.astype(jnp.int32)
+        per_host = jax.lax.psum(v, self.chip_axis)
+        total = jax.lax.psum(per_host, self.host_axis)
+        return total > 0 if as_bool else total
+
+    def lex_argmin_nodes(self, keys, mask, gids):
+        lidx, lfound = lex_argmin(keys, mask)
+        if self.stats is not None:
+            self.stats.selects += 1
+            self.stats.note("ici", [k[lidx] for k in keys] + [lfound, lidx])
+            self.stats.note("dcn", [k[lidx] for k in keys] + [lfound, lidx])
+            if not self.stats.per_select_dcn_scalars:
+                self.stats.per_select_dcn_scalars = self.n_hosts * (
+                    len(keys) + 2
+                )
+                self.stats.per_select_ici_scalars = self.n_chips * (
+                    len(keys) + 2
+                )
+        # ICI: the chips' winners, reduced to one winner per host.
+        ckeys = [jax.lax.all_gather(k[lidx], self.chip_axis) for k in keys]
+        cfound = jax.lax.all_gather(lfound, self.chip_axis)
+        cgid = jax.lax.all_gather(gids[lidx], self.chip_axis)
+        hidx, hfound = lex_argmin(ckeys, cfound)
+        # DCN: one winner tuple per host.
+        gkeys = [jax.lax.all_gather(k[hidx], self.host_axis) for k in ckeys]
+        gfound = jax.lax.all_gather(hfound, self.host_axis)
+        ggid = jax.lax.all_gather(cgid[hidx], self.host_axis)
+        widx, wfound = lex_argmin(gkeys, gfound)
+        return jnp.where(wfound, ggid[widx], 0).astype(jnp.int32), wfound
+
+    def fill_candidates(self, keys, mask, caps, gids, B):
+        """Hierarchical top-B merge: chips' top-Bs -> host top-B over ICI,
+        hosts' top-Bs -> global top-B over DCN. The global top-B is a
+        subset of the union of per-host top-Bs, so the two-level merge is
+        exact; entry keys end in the globally-unique node id rank, so the
+        merged ORDER matches the flat sort too."""
+        take, mk = _fill_sort(keys, mask, B)
+        lkeys = [k[take] for k in mk]
+        lcaps = jnp.where(mask[take], caps[take], 0)
+        lgids = gids[take]
+        if self.stats is not None:
+            self.stats.fills += 1
+            self.stats.note("ici", lkeys + [lcaps, lgids])
+            self.stats.note("dcn", lkeys + [lcaps, lgids])
+        ckeys = [
+            jax.lax.all_gather(k, self.chip_axis).reshape(-1) for k in lkeys
+        ]
+        ccaps = jax.lax.all_gather(lcaps, self.chip_axis).reshape(-1)
+        cgids = jax.lax.all_gather(lgids, self.chip_axis).reshape(-1)
+        horder = jnp.lexsort(tuple(reversed(ckeys)))[:B]
+        hkeys = [k[horder] for k in ckeys]
+        gkeys = [
+            jax.lax.all_gather(k, self.host_axis).reshape(-1) for k in hkeys
+        ]
+        gcaps = jax.lax.all_gather(ccaps[horder], self.host_axis).reshape(-1)
+        ggids = jax.lax.all_gather(cgids[horder], self.host_axis).reshape(-1)
         order = jnp.lexsort(tuple(reversed(gkeys)))[:B]
         return gcaps[order], ggids[order]

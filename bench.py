@@ -142,17 +142,17 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None):
     from armada_tpu.solver.kernel_prep import pad_device_round
 
     budget_s = float(os.environ.get("BENCH_ROUND_BUDGET_S", 0) or 0) or None
+    sharded = None
     if mesh:
-        from armada_tpu.parallel.mesh import (
-            make_node_mesh,
-            node_sharded_solve,
-            pad_nodes,
-        )
+        # mesh is a spec: int (1D chip count) or "HxC" (two-level
+        # hosts x chips hierarchy, parallel/multihost.py).
+        from armada_tpu.parallel.mesh import pad_nodes
+        from armada_tpu.parallel.multihost import resolve_solver
 
-        sharded = node_sharded_solve(make_node_mesh())
+        sharded = resolve_solver(mesh)
 
         def solve_round(dev):
-            return sharded(pad_nodes(dev, mesh))
+            return sharded(pad_nodes(dev, sharded.n_shards))
     elif budget_s:
         # Round-deadline mode: the chunked budget-aware driver
         # (solver/kernel.solve_round) — wall clock checkpointed between
@@ -246,7 +246,24 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None):
     # The reported component breakdown comes from the median-cycle sample
     # (closest to the reported headline), spread from all samples.
     rep = min(samples, key=lambda s: abs(s["cycle_s"] - median))
+    mesh_extra = {}
+    if sharded is not None:
+        shape = sharded.mesh_shape
+        hosts, chips = shape if len(shape) == 2 else (1, shape[0])
+        mesh_extra["mesh"] = {
+            "hosts": hosts,
+            "chips": chips,
+            # Trace-time accounting of the executed program's collectives
+            # (solver/dist.CollectiveStats): sites + bytes per execution
+            # by fabric level; multiply by `loops` for per-cycle totals.
+            "collectives": (
+                (sharded.last_stats or sharded.stats).as_dict()
+                if sharded.stats
+                else None
+            ),
+        }
     return {
+        **mesh_extra,
         "cycle_s": round(median, 4),
         **{k: v for k, v in rep.items() if k != "cycle_s"},
         "warm_cycles_measured": len(times),
@@ -262,7 +279,15 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None):
 
 
 def main():
-    mesh = int(os.environ.get("BENCH_MESH", 0))
+    # BENCH_MESH spellings: "8" (1D, 8 chips on one host) or "2x4"
+    # (two-level hosts x chips hierarchy, parallel/multihost.py).
+    raw_mesh = os.environ.get("BENCH_MESH", "0").lower()
+    if "x" in raw_mesh:
+        hosts, chips = (int(t) for t in raw_mesh.lower().split("x", 1))
+        mesh, n_mesh_devices = raw_mesh, hosts * chips
+    else:
+        n_mesh_devices = int(raw_mesh or 0)
+        mesh = n_mesh_devices or None
     if mesh:
         # Virtual multi-device mesh on the host platform: must be set
         # before the first jax import. (On a real multi-chip TPU slice,
@@ -270,7 +295,7 @@ def main():
         # actual devices.)
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={mesh}"
+            + f" --xla_force_host_platform_device_count={n_mesh_devices}"
         )
         os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -294,15 +319,15 @@ def main():
     if custom:
         n_jobs = int(os.environ.get("BENCH_JOBS", 100_000))
         n_nodes = int(os.environ.get("BENCH_NODES", 5000))
-        flag = run_config(n_jobs, n_nodes, mesh=mesh or None)
+        flag = run_config(n_jobs, n_nodes, mesh=mesh)
     else:
         n_jobs, n_nodes = 1_000_000, 50_000
-        tracking = run_config(100_000, 5000, mesh=mesh or None)
+        tracking = run_config(100_000, 5000, mesh=mesh)
         if os.environ.get("BENCH_FLAGSHIP", "1") == "1":
-            flag = run_config(n_jobs, n_nodes, mesh=mesh or None)
+            flag = run_config(n_jobs, n_nodes, mesh=mesh)
             if os.environ.get("BENCH_BURST50K", "1") == "1":
                 burst50k = run_config(
-                    n_jobs, n_nodes, burst=50_000, mesh=mesh or None
+                    n_jobs, n_nodes, burst=50_000, mesh=mesh
                 )
         else:
             flag, (n_jobs, n_nodes) = tracking, (100_000, 5000)
@@ -312,7 +337,7 @@ def main():
     cycle_s = extra.pop("cycle_s")
     extra["platform"] = platform
     if mesh:
-        extra["mesh_devices"] = mesh
+        extra["mesh_devices"] = n_mesh_devices
     extra["platform_probe"] = plat.last_probe_report.get("reason", "")
     if tracking is not None:
         extra["tracking_100k"] = tracking

@@ -1,0 +1,66 @@
+"""The multi-process DCN parity dryrun, wired as a slow-marked test.
+
+tools/dcn_dryrun.py boots 2 REAL host processes (jax.distributed +
+gloo) owning a (2 hosts, 4 chips) mesh and asserts bit-exact parity of
+the two-level HierarchicalDist solve against the single-device solve on
+the mixed-fleet scenarios. Each worker compiles its own sharded program
+from scratch (separate processes, minutes on this box), so the run is
+slow-marked: tier-1 stays fast, `ARMADA_FULL_SUITE=1` (or running the
+tool directly) exercises genuine inter-process DCN traffic.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_dcn_dryrun_2x4_parity():
+    # A subprocess (not launcher.launch in-process): the coordinator must
+    # not inherit this suite's initialized jax backend or its 8-device
+    # XLA_FLAGS — the tool owns its workers' env end to end.
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_NUM_CPU_DEVICES")
+    }
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "dcn_dryrun.py"),
+            "--hosts", "2",
+            "--chips", "4",
+            "--nodes", "256",
+            "--jobs", "1024",
+            "--timeout", "1200",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1500,
+    )
+    # The tool prints exactly one machine-readable JSON line on stdout.
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert lines, f"no JSON line on stdout; stderr tail: {proc.stderr[-2000:]}"
+    result = json.loads(lines[-1])
+    assert proc.returncode == 0 and result["ok"], (
+        f"DCN dryrun failed: {json.dumps(result)[:4000]}"
+    )
+    assert not result["timed_out"]
+    assert result["hosts"] == 2 and result["chips"] == 4
+    # Every worker saw bit-exact parity on every round.
+    for w in result["workers"]:
+        assert w["ok"], w
+        assert {r["round"] for r in w["rounds"]} == {"home_away", "market"}
+        assert all(r["mismatch"] == [] for r in w["rounds"])
+    # The measured DCN bill: one winner tuple per host per select.
+    coll = result["collectives"]
+    assert coll["n_hosts"] == 2 and coll["n_chips"] == 4
+    assert 0 < coll["per_select_dcn_scalars"] < coll["per_select_ici_scalars"] * 2
+    assert coll["dcn_bytes"] < coll["ici_bytes"]

@@ -1,31 +1,47 @@
 """Staleness guard for docs/architecture.md "Known gaps".
 
 The gaps list rotted twice (it kept claiming a JSON-only executor wire
-and a ~330-line UI long after both were obsolete). This test makes the
-list self-verifying: every listed gap carries a `gap:<id>` marker mapped
-here to a detector that answers "does the claimed-missing feature exist
-now?". A gap whose feature EXISTS fails the suite (stale claim); a
-marker with no detector fails too (unguarded claim); and the obsolete
-claims that prompted this guard must stay gone.
+and a ~330-line UI long after both were obsolete), so the section is now
+GENERATED from the tracked checklist docs/known_gaps.yaml
+(tools/gen_known_gaps.py) and this suite makes the checklist itself
+self-verifying:
+
+  - the rendered section must match the doc byte-for-byte (no hand
+    edits, no drift);
+  - every OPEN gap carries a feature detector answering "does the
+    claimed-missing feature exist now?" — a gap whose feature exists
+    fails (stale claim), a gap with no detector fails (unguarded);
+  - every OPEN gap names its future closer test; if that test already
+    exists AND passes, the suite fails — flip the gap to closed;
+  - every CLOSED gap's closer test must exist (the evidence that closed
+    it cannot silently vanish).
 """
 
 import os
 import re
+import subprocess
+import sys
 
-DOC = os.path.join(os.path.dirname(__file__), "..", "docs", "architecture.md")
+REPO = os.path.join(os.path.dirname(__file__), "..")
+DOC = os.path.join(REPO, "docs", "architecture.md")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from gen_known_gaps import SECTION_RE, load_gaps, render  # noqa: E402
+
+GAPS = load_gaps()
 
 
 def _gaps_section() -> str:
     with open(DOC) as f:
         text = f.read()
-    m = re.search(r"## Known gaps.*?(?=\n## |\Z)", text, re.DOTALL)
+    m = SECTION_RE.search(text)
     assert m, "docs/architecture.md lost its 'Known gaps' section"
     return m.group(0)
 
 
 def _feature_exists_kubernetes() -> bool:
     # A kubelet/kube-api integration would import the kubernetes client.
-    root = os.path.join(os.path.dirname(__file__), "..", "armada_tpu")
+    root = os.path.join(REPO, "armada_tpu")
     for dirpath, _, files in os.walk(root):
         for name in files:
             if not name.endswith(".py"):
@@ -39,16 +55,13 @@ def _feature_exists_kubernetes() -> bool:
 def _feature_exists_rich_lookout_ui() -> bool:
     # The gap claims "a fraction of the surface" of a 22.6k-line app:
     # consider it closed once the UI grows past a few thousand lines.
-    path = os.path.join(
-        os.path.dirname(__file__), "..", "armada_tpu", "services",
-        "lookout_ui.py",
-    )
+    path = os.path.join(REPO, "armada_tpu", "services", "lookout_ui.py")
     with open(path) as f:
         return sum(1 for _ in f) > 5000
 
 
 def _feature_exists_cpp_grpc() -> bool:
-    client_dir = os.path.join(os.path.dirname(__file__), "..", "native", "client")
+    client_dir = os.path.join(REPO, "native", "client")
     if not os.path.isdir(client_dir):
         return False
     for dirpath, _, files in os.walk(client_dir):
@@ -61,27 +74,14 @@ def _feature_exists_cpp_grpc() -> bool:
 
 
 def _feature_exists_scala_client() -> bool:
-    return os.path.isdir(
-        os.path.join(os.path.dirname(__file__), "..", "client", "scala")
-    )
+    return os.path.isdir(os.path.join(REPO, "client", "scala"))
 
 
 def _feature_exists_sharded_budget() -> bool:
     # Closed once the mesh solve takes a budget (chunked pass 1).
-    path = os.path.join(
-        os.path.dirname(__file__), "..", "armada_tpu", "parallel", "mesh.py"
-    )
+    path = os.path.join(REPO, "armada_tpu", "parallel", "mesh.py")
     with open(path) as f:
         return "budget" in f.read()
-
-
-def _feature_exists_network_chaos() -> bool:
-    path = os.path.join(
-        os.path.dirname(__file__), "..", "armada_tpu", "services", "chaos.py"
-    )
-    with open(path) as f:
-        src = f.read()
-    return "network_partition" in src
 
 
 DETECTORS = {
@@ -90,24 +90,84 @@ DETECTORS = {
     "cpp-client-grpc": _feature_exists_cpp_grpc,
     "scala-client": _feature_exists_scala_client,
     "sharded-round-budget": _feature_exists_sharded_budget,
-    "chaos-network": _feature_exists_network_chaos,
 }
 
 
-def test_every_gap_is_guarded_and_current():
-    section = _gaps_section()
-    markers = re.findall(r"<!-- gap:([a-z0-9-]+) -->", section)
-    assert markers, "Known gaps entries must carry <!-- gap:<id> --> markers"
-    unguarded = [m for m in markers if m not in DETECTORS]
+def _closer_exists(closer: str) -> bool:
+    """Does the pytest node id point at an existing test function?
+    Handles both module-level ids (file::test) and class-based ones
+    (file::Class::test — the def is indented, the class must exist)."""
+    parts = closer.split("::")
+    path, func = parts[0], parts[-1]
+    full = os.path.join(REPO, path)
+    if not os.path.exists(full):
+        return False
+    with open(full) as f:
+        src = f.read()
+    for cls in parts[1:-1]:
+        if re.search(rf"^class {re.escape(cls)}\b", src, re.M) is None:
+            return False
+    return re.search(rf"^[ \t]*def {re.escape(func)}\(", src, re.M) is not None
+
+
+def test_doc_matches_checklist():
+    """The doc section is exactly the YAML rendering — regenerate with
+    `python tools/gen_known_gaps.py --write` after editing the YAML."""
+    assert _gaps_section().rstrip("\n") == render(GAPS), (
+        "docs/architecture.md 'Known gaps' drifted from "
+        "docs/known_gaps.yaml; rerun tools/gen_known_gaps.py --write"
+    )
+
+
+def test_every_open_gap_is_guarded_and_current():
+    open_ids = [g["id"] for g in GAPS if g["status"] == "open"]
+    assert open_ids, "no open gaps tracked — suspicious for this repo"
+    unguarded = [i for i in open_ids if i not in DETECTORS]
     assert not unguarded, (
-        f"gaps {unguarded} have no staleness detector in test_docs_gaps.py; "
-        "add one so the claim can't rot"
+        f"open gaps {unguarded} have no staleness detector in "
+        "test_docs_gaps.py; add one so the claim can't rot"
     )
-    stale = [m for m in markers if DETECTORS[m]()]
+    stale = [i for i in open_ids if DETECTORS[i]()]
     assert not stale, (
-        f"gaps {stale} claim features that now exist — "
-        "update docs/architecture.md 'Known gaps'"
+        f"gaps {stale} claim features that now exist — flip them to "
+        "closed in docs/known_gaps.yaml"
     )
+
+
+def test_closed_gaps_name_existing_tests():
+    missing = [
+        g["id"]
+        for g in GAPS
+        if g["status"] == "closed" and not _closer_exists(g["closer"])
+    ]
+    assert not missing, (
+        f"closed gaps {missing} name closer tests that do not exist — "
+        "the evidence that closed them has rotted"
+    )
+
+
+def test_open_gaps_closers_not_already_passing():
+    """An open gap whose named closer test exists and PASSES is a rotted
+    claim: the feature landed but the checklist wasn't flipped."""
+    landed = [g for g in GAPS if g["status"] == "open" and _closer_exists(g["closer"])]
+    for g in landed:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", g["closer"], "-q", "--no-header"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        # Exit 0 alone is not "passes": a slow-marked closer is SKIPPED
+        # by the nested run (conftest policy) and pytest still exits 0 —
+        # only an actual "N passed" report proves the claim rotted.
+        passed = proc.returncode == 0 and re.search(
+            r"\b[1-9]\d* passed", proc.stdout
+        )
+        assert not passed, (
+            f"open gap {g['id']}: closer {g['closer']} exists and passes — "
+            "flip it to closed in docs/known_gaps.yaml"
+        )
 
 
 def test_obsolete_claims_stay_gone():
@@ -126,10 +186,11 @@ def test_gap_markers_match_prose():
     """Every bullet in the gaps list carries a marker (no unmarked,
     therefore unguarded, claims sneak in)."""
     section = _gaps_section()
-    bullets = [
-        line
-        for line in section.splitlines()
-        if line.startswith("- ")
+    bullets = [line for line in section.splitlines() if line.startswith("- ")]
+    assert bullets, "Known gaps section lost its bullets"
+    unmarked = [
+        b
+        for b in bullets
+        if "<!-- gap:" not in b and "<!-- closed-gap:" not in b
     ]
-    unmarked = [b for b in bullets if "<!-- gap:" not in b]
     assert not unmarked, f"gap bullets without markers: {unmarked}"

@@ -160,7 +160,7 @@ def test_event_index_pruned_then_recreated_jobset_defers_to_log():
 
 def test_watch_uses_index_end_to_end():
     """The full stack's watch path serves from the index."""
-    from armada_tpu.clients.grpc_client import connect
+    from armada_tpu.services.grpc_api import connect
     from armada_tpu.core.config import SchedulingConfig
     from armada_tpu.services.server import ControlPlane
 

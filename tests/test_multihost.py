@@ -1,0 +1,204 @@
+"""Two-level (hosts, chips) mesh: parity and mesh-shape edge cases.
+
+The HierarchicalDist solve decomposes every shard-crossing collective
+into ICI-within-host + DCN-across-hosts stages (solver/dist.py); these
+tests pin the bit-exactness claims that make the decomposition safe:
+
+  - a 2x4 mesh reproduces the single-device solve bit-for-bit on the
+    mixed-fleet scenarios (away pools, a market pool, mixed gangs);
+  - pad_nodes handles node counts that do not divide hosts*chips;
+  - a degenerate single-host 2D mesh (1xN) equals the 1D N-mesh
+    bit-for-bit (the host stage reduces over one element);
+  - a 1x1 mesh equals LOCAL (both stages are identities);
+  - CollectiveStats books the DCN bill as O(hosts x keys) per select,
+    independent of the chip count.
+
+The 8 virtual CPU devices come from conftest
+(xla_force_host_platform_device_count=8): a 2x4 mesh in one process.
+The multi-PROCESS version of the same assertions is the slow-marked
+tests/test_dcn_dryrun.py."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from armada_tpu.parallel.mesh import make_node_mesh, node_sharded_solve, pad_nodes
+from armada_tpu.parallel.multihost import (
+    MeshSpec,
+    hierarchical_sharded_solve,
+    make_host_mesh,
+    parse_mesh_spec,
+    resolve_solver,
+)
+from armada_tpu.parallel.scenarios import mixed_fleet_rounds
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round, prep_device_round
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+@pytest.fixture(scope="module")
+def mesh_2x4():
+    return make_host_mesh(2, 4)
+
+
+@pytest.fixture(scope="module")
+def solve_2x4(mesh_2x4):
+    return hierarchical_sharded_solve(mesh_2x4)
+
+
+def _rounds(n_nodes=32, n_jobs=96):
+    """Small mixed-fleet rounds: away pool + market pool + mixed gangs,
+    the same generator the fleet-scale dryruns use. Extents are tuned
+    for tier-1 wall clock on a 1-core box driving 8 virtual devices:
+    fill loops dominate, so jobs stay low; the fleet-scale extents live
+    in dryrun_multichip and the slow-marked DCN dryrun."""
+    return mixed_fleet_rounds(n_nodes, n_jobs)
+
+
+def _dev(snap, multiple):
+    return pad_nodes(pad_device_round(prep_device_round(snap)), multiple)
+
+
+def _assert_equal(a, b, label):
+    for k, v in a.items():
+        assert np.array_equal(
+            np.asarray(b[k]), np.asarray(v), equal_nan=True
+        ), f"{label}: {k} diverges"
+
+
+def test_two_level_parity_mixed_fleet(solve_2x4):
+    """2x4 hierarchy == single device, bit-for-bit, on away + market
+    rounds with gangs and running jobs."""
+    for label, snap in _rounds():
+        dev = _dev(snap, 8)
+        single = solve_round(dev)
+        multi = solve_2x4(dev)
+        _assert_equal(single, multi, f"2x4-{label}")
+        assert int(np.asarray(single["scheduled_mask"]).sum()) > 0, label
+
+
+def test_pad_nodes_indivisible(solve_2x4):
+    """Node counts that do not divide hosts*chips=8: inert padding must
+    not change any placement. One representative count tier-1; the
+    (9, 50) sweep rides the slow marker per conftest policy."""
+    for n_nodes in (21,):
+        label, snap = _rounds(n_nodes=n_nodes, n_jobs=64)[0]
+        dev = _dev(snap, 8)
+        assert dev.node_total.shape[0] % 8 == 0
+        _assert_equal(
+            solve_round(dev), solve_2x4(dev), f"indivisible-{n_nodes}"
+        )
+
+
+@pytest.mark.slow
+def test_pad_nodes_indivisible_sweep(solve_2x4):
+    for n_nodes in (9, 50):
+        label, snap = _rounds(n_nodes=n_nodes, n_jobs=64)[0]
+        dev = _dev(snap, 8)
+        _assert_equal(
+            solve_round(dev), solve_2x4(dev), f"indivisible-{n_nodes}"
+        )
+
+
+def test_degenerate_single_host_equals_1d():
+    """A 1x8 two-level mesh (host stage reduces over one element) must
+    equal the 1D 8-shard mesh bit-for-bit — same winners, same order."""
+    flat = node_sharded_solve(make_node_mesh(jax.devices()[:8]))
+    degenerate = hierarchical_sharded_solve(make_host_mesh(1, 8))
+    label, snap = _rounds(n_nodes=32, n_jobs=64)[0]
+    dev = _dev(snap, 8)
+    _assert_equal(flat(dev), degenerate(dev), "1x8-vs-1d")
+    # The degenerate host axis books zero extra selects relative to the
+    # flat path — but its DCN bill is O(1 host x keys): effectively free.
+    assert degenerate.stats.per_select_dcn_scalars < (
+        degenerate.stats.per_select_ici_scalars
+    )
+
+
+def test_1x1_mesh_equals_local():
+    """A 1x1 mesh: both reduction stages are single-element — the
+    sharded program must equal the LOCAL solve exactly."""
+    one = hierarchical_sharded_solve(make_host_mesh(1, 1))
+    label, snap = _rounds(n_nodes=12, n_jobs=24)[0]
+    dev = _dev(snap, 1)
+    _assert_equal(solve_round(dev), one(dev), "1x1-vs-local")
+
+
+def test_collective_stats_dcn_scaling(solve_2x4):
+    """The per-select DCN bill is one winner tuple per HOST —
+    O(hosts x keys) scalars, the chip count cancels."""
+    stats = solve_2x4.stats
+    assert stats.n_hosts == 2 and stats.n_chips == 4
+    assert stats.selects > 0 and stats.fills >= 0
+    assert stats.per_select_dcn_scalars > 0
+    # hosts x (keys + found + idx): per-select DCN traffic carries the
+    # host fan-in (2), the ICI stage the chip fan-in (4).
+    assert stats.per_select_dcn_scalars == (
+        stats.per_select_ici_scalars // 2
+    )
+    assert 0 < stats.dcn_bytes < stats.ici_bytes
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec(8) == MeshSpec(1, 8)
+    assert parse_mesh_spec("2x4") == MeshSpec(2, 4)
+    assert parse_mesh_spec("2X4") == MeshSpec(2, 4)
+    assert parse_mesh_spec((2, 4)) == MeshSpec(2, 4)
+    assert parse_mesh_spec(MeshSpec(4, 2)) == MeshSpec(4, 2)
+    assert parse_mesh_spec(Mesh(np.asarray(jax.devices()[:4]), ("nodes",))) \
+        == MeshSpec(1, 4)
+    assert parse_mesh_spec(make_host_mesh(2, 2)) == MeshSpec(2, 2)
+    for bad in (0, -2, "0x4", "2x0", (2, -1), "nonsense"):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+
+
+def test_resolve_solver_shapes():
+    """The shared seam: int -> 1D path, "HxC" -> hierarchy, with the
+    mesh shape and shard count surfaced for padding + metrics."""
+    flat = resolve_solver(8)
+    assert flat.n_shards == 8 and flat.mesh_shape == (8,)
+    two = resolve_solver("2x4")
+    assert two.n_shards == 8 and two.mesh_shape == (2, 4)
+    assert two.stats.n_hosts == 2
+    with pytest.raises(RuntimeError):
+        resolve_solver("4x4")  # 16 devices on an 8-device platform
+
+
+def test_mesh_metrics_surface():
+    """The DCN cost-model gauges exist and render: mesh extent,
+    per-kind collective sites, per-level bytes, per-select DCN scalars,
+    per-host shard-solve wall clock."""
+    from armada_tpu.services.metrics import SchedulerMetrics
+
+    m = SchedulerMetrics()
+    if m.registry is None:
+        pytest.skip("prometheus_client unavailable")
+    m.solve_mesh_extent.labels(axis="hosts").set(2)
+    m.solve_mesh_extent.labels(axis="chips").set(4)
+    m.solve_collective_sites.labels(kind="selects").set(78)
+    m.solve_collective_bytes.labels(level="dcn").set(57_672_790)
+    m.solve_dcn_scalars_per_select.set(14)
+    m.shard_solve_time.labels(pool="default").observe(1.0)
+    text = m.render().decode()
+    for needle in (
+        'scheduler_solve_mesh_extent{axis="hosts"} 2.0',
+        'scheduler_solve_collective_sites{kind="selects"} 78.0',
+        'scheduler_solve_collective_bytes{level="dcn"} 5.767279e+07',
+        "scheduler_solve_dcn_scalars_per_select 14.0",
+        'scheduler_shard_solve_seconds_count{pool="default"} 1.0',
+    ):
+        assert needle in text, needle
+
+
+def test_make_host_mesh_validation():
+    with pytest.raises(ValueError):
+        make_host_mesh(3, 4)  # 12 > 8 devices
+    with pytest.raises(ValueError):
+        # a 1D mesh is not a (hosts, chips) mesh
+        hierarchical_sharded_solve(make_node_mesh(jax.devices()[:8]))

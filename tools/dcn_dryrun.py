@@ -1,0 +1,59 @@
+"""DCN dryrun: the 2-host x 4-chip multi-process CPU parity run.
+
+Boots 2 real host processes (jax.distributed + gloo) owning a
+(2 hosts, 4 chips) mesh, runs the mixed-fleet scenario set (away pools,
+a market pool, mixed gangs) through the two-level HierarchicalDist
+solve, and checks **bit-exact** equality against the single-device
+solve computed independently inside every worker.
+
+Prints exactly ONE machine-readable JSON line on stdout:
+
+  {"ok": true|false, "timed_out": ..., "hosts": 2, "chips": 4,
+   "rounds": [...per-round parity/timing...],
+   "collectives": {...trace-time DCN/ICI accounting...}, ...}
+
+Exit code 0 iff ok. The wall clock is bounded by --timeout (hard kill).
+Wired as a slow-marked test (tests/test_dcn_dryrun.py) so the tier-1
+suite stays fast; run directly for the architecture doc's measured DCN
+numbers:
+
+  python tools/dcn_dryrun.py --hosts 2 --chips 4 --nodes 512 --jobs 2048
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--chips", type=int, default=4)
+    ap.add_argument("--nodes", type=int, default=512)
+    ap.add_argument("--jobs", type=int, default=2048)
+    ap.add_argument(
+        "--timeout",
+        type=float,
+        default=1500.0,
+        help="hard kill for the whole worker fleet, seconds",
+    )
+    args = ap.parse_args(argv)
+
+    from armada_tpu.parallel.launcher import launch
+
+    result = launch(
+        n_hosts=args.hosts,
+        n_chips=args.chips,
+        n_nodes=args.nodes,
+        n_jobs=args.jobs,
+        timeout_s=args.timeout,
+    )
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
