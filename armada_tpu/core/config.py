@@ -14,6 +14,11 @@ from dataclasses import dataclass, field
 from .priorities import AwayNodeType, PriorityClass
 from .resources import ResourceListFactory
 
+# Hot-window compaction engagement floor (see SchedulingConfig
+# .hot_window_min_slots) — the single constant shared with
+# solver/kernel.solve_round's parameter default.
+HOT_WINDOW_MIN_SLOTS_DEFAULT = 1 << 19
+
 
 @dataclass(frozen=True)
 class ResourceType:
@@ -171,6 +176,21 @@ class SchedulingConfig:
     # are cut at the first entry introducing key number fill_group_max+1
     # (the cut entry batches next iteration instead).
     fill_group_max: int = 8
+    # Hot-window compaction (solver/hotwindow.py): pass 1 solves over a
+    # gathered active set of ~this many slots per queue (power-of-two
+    # bucketed, floored at the fill window) and scatters results back at
+    # chunk boundaries, re-gathering when a queue's window runs low.
+    # Bit-exact with the uncompacted kernel; engages only when the
+    # window axes actually shrink the round, so small rounds run the
+    # fused program unchanged. 0 disables. Sized at ~2x the fill window
+    # so one gather covers about two merged fill loops.
+    hot_window_slots: int = 4096
+    # Compaction engages only when the padded slot axis is at least this
+    # big: the host-driven chunked driver costs a fixed ~0.1-0.2s of
+    # dispatch/sync overhead per round, which mid-size rounds cannot
+    # amortize. The default is the flagship/burst regime (>=512k slots);
+    # solve_round's parameter default references this same constant.
+    hot_window_min_slots: int = HOT_WINDOW_MIN_SLOTS_DEFAULT
     executor_timeout_s: float = 600.0
     # Lease TTL advertised to executor agents in every lease reply: an
     # agent that cannot complete a lease exchange for this long must
@@ -424,6 +444,8 @@ class SchedulingConfig:
             ),
             ("enablePreferLargeJobOrdering", "enable_prefer_large_job_ordering", bool),
             ("batchFillWindow", "batch_fill_window", int),
+            ("hotWindowSlots", "hot_window_slots", int),
+            ("hotWindowMinSlots", "hot_window_min_slots", int),
             ("enableFastFill", "enable_fast_fill", bool),
             ("fillGroupMax", "fill_group_max", int),
         ]:
@@ -505,6 +527,10 @@ def validate_config(config: SchedulingConfig):
         problems.append("maxQueueLookback must be >= 0")
     if config.batch_fill_window < 0:
         problems.append("batchFillWindow must be >= 0")
+    if config.hot_window_slots < 0:
+        problems.append("hotWindowSlots must be >= 0")
+    if config.hot_window_min_slots < 0:
+        problems.append("hotWindowMinSlots must be >= 0")
     if config.fill_group_max < 1:
         problems.append("fillGroupMax must be >= 1")
     if config.max_scheduling_duration_s < 0:

@@ -30,6 +30,26 @@ def masked_min(values, mask):
     return jnp.min(jnp.where(mask, values, _sentinel(values.dtype)))
 
 
+def masked_keys(keys, mask):
+    """Substitute each key's masked-out entries with its dtype's max
+    sentinel, so masked entries sort last under any lexicographic order.
+
+    The one shared helper for every sort/argmin call site that needs
+    sentinel keys (dist._fill_sort, the fair-preemption walk order):
+    callers used to each re-derive and re-broadcast their own sentinel
+    per key per call, which both duplicated the pattern and let the
+    sentinels drift (BIG vs iinfo.max) between sites."""
+    return [jnp.where(mask, k, _sentinel(k.dtype)) for k in keys]
+
+
+def masked_lexsort(keys, mask):
+    """Indices sorting masked entries by lexicographic key (first key
+    most significant); masked-out entries sort last."""
+    mk = masked_keys(keys, mask)
+    # jnp.lexsort: LAST key is primary -> reverse (ours is first-primary).
+    return jnp.lexsort(tuple(reversed(mk)))
+
+
 def lex_argmin(keys, mask):
     """Index of the lexicographically smallest entry among masked entries.
 

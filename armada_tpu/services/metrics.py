@@ -249,6 +249,38 @@ class SchedulerMetrics:
             ["pool"],
             registry=r,
         )
+        # ---- hot-window solve profile (solver/hotwindow.py): wall clock
+        # per solve segment and the pass-1 loop mix, from the host-driven
+        # kernel driver. The numbers future perf PRs regress against —
+        # "the round is solve-bound" stops being one opaque histogram.
+        self.solve_segment_time = Histogram(
+            "scheduler_solve_segment_seconds",
+            "Device solve wall clock by segment (setup / pass1 / "
+            "gather / finish) within a round",
+            ["pool", "segment"],
+            buckets=(0.001, 0.01, 0.05, 0.2, 1, 5, 20, 60),
+            registry=r,
+        )
+        self.solve_loops_by_kind = Gauge(
+            "scheduler_solve_loops_by_kind",
+            "Pass-1 while-loop iterations of the last solve by kind "
+            "(gang = serial attempts, fill, merged_fill)",
+            ["pool", "kind"],
+            registry=r,
+        )
+        self.solve_rewindows = Gauge(
+            "scheduler_solve_rewindows",
+            "Hot-window re-gathers during the last solve's pass 1",
+            ["pool"],
+            registry=r,
+        )
+        self.solve_window_slots = Gauge(
+            "scheduler_solve_window_slots",
+            "Per-queue hot-window size of the last solve (0 = "
+            "compaction disengaged)",
+            ["pool"],
+            registry=r,
+        )
         self.anti_entropy_resolutions = Counter(
             "scheduler_anti_entropy_resolutions_total",
             "Run resolutions produced by post-partition ExecutorSync "

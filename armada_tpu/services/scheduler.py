@@ -1244,6 +1244,32 @@ class SchedulerService:
             )
         self.metrics.shard_solve_time.labels(pool=pool).observe(solve_s)
 
+    def _note_solve_profile(self, pool: str, profile: dict | None):
+        """Per-segment solve timings + pass-1 loop mix from the
+        host-driven kernel driver (solve_round's `profile` block), so
+        future perf work can see WHERE a round spends its time instead
+        of one opaque solve number."""
+        if (
+            not profile
+            or self.metrics is None
+            or self.metrics.registry is None
+        ):
+            return
+        for segment in ("setup", "pass1", "gather", "finish"):
+            self.metrics.solve_segment_time.labels(
+                pool=pool, segment=segment
+            ).observe(float(profile.get(f"{segment}_s", 0.0)))
+        for kind in ("gang", "fill", "merged_fill"):
+            self.metrics.solve_loops_by_kind.labels(
+                pool=pool, kind=kind
+            ).set(int(profile.get(f"{kind}_loops", 0)))
+        self.metrics.solve_rewindows.labels(pool=pool).set(
+            int(profile.get("rewindows", 0))
+        )
+        self.metrics.solve_window_slots.labels(pool=pool).set(
+            int(profile.get("window_slots", 0))
+        )
+
     # ------------------------------------------------------------------
     # Incremental snapshots (O(delta) cycles): the service-side analogue
     # of the reference's serial-based delta sync (scheduler.go:441). The
@@ -1535,8 +1561,14 @@ class SchedulerService:
                 out["truncated"] = False
                 self._note_mesh_metrics(snap.pool, _t.monotonic() - t0)
             else:
-                out = solve_round(dev, budget_s=budget_s)
+                out = solve_round(
+                    dev,
+                    budget_s=budget_s,
+                    window=snap.config.hot_window_slots or None,
+                    window_min_slots=snap.config.hot_window_min_slots,
+                )
             truncated = bool(out.get("truncated", False))
+            self._note_solve_profile(snap.pool, out.get("profile"))
             J, Q = snap.num_jobs, snap.num_queues
             return {
                 "assigned_node": out["assigned_node"][:J],

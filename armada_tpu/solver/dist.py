@@ -31,7 +31,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from ..ops.select import lex_argmin, _sentinel
+from ..ops.select import lex_argmin, masked_keys
 
 
 @dataclasses.dataclass
@@ -95,8 +95,8 @@ class CollectiveStats:
 
 def _fill_sort(keys, mask, B):
     """Indices of the B lexicographically-smallest masked entries (sorted).
-    Masked-out entries sort last (sentinel keys)."""
-    mk = [jnp.where(mask, k, _sentinel(k.dtype)) for k in keys]
+    Masked-out entries sort last (shared sentinel keys, ops/select.py)."""
+    mk = masked_keys(keys, mask)
     # jnp.lexsort: LAST key is primary -> reverse (ours is first-primary).
     order = jnp.lexsort(tuple(reversed(mk)))
     return order[:B], mk

@@ -38,11 +38,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.config import HOT_WINDOW_MIN_SLOTS_DEFAULT
 from ..core.priorities import EVICTED_PRIORITY, MIN_PRIORITY
 from ..ops.bitset import bits_subset
-from ..ops.select import lex_argmin
+from ..ops.select import lex_argmin, masked_lexsort
 from .dist import LOCAL
-from .kernel_prep import DeviceRound
+from .kernel_prep import DeviceRound, _pow2
+
+# Segment-counter indices: pass-1 loop kinds for the solve profile
+# (serial gang attempts, single-queue batched fill, merged multi-queue
+# fill). A [3]-int32 rides the while-loop state next to the carry.
+SEG_GANG, SEG_FILL, SEG_MERGED = 0, 1, 2
 
 NO_NODE = -1
 
@@ -81,6 +87,32 @@ class Carry(NamedTuple):
 
 def _f(x):
     return jnp.asarray(x, jnp.result_type(float))
+
+
+def _pack_fill_keys(dev, dist, n_local, keys):
+    """Fuse the best-fit candidate keys into ONE packed int64 when their
+    static bit widths fit — the fill sort then runs a single-key sort
+    instead of K+1 stable passes (the dominant cost of a big-N fill
+    loop; 2x measured on 65k nodes).
+
+    Order-exact by mixed-radix packing: every in-mask key is within
+    [0, 2^bits) — a fitting node's allocatable is within [0, node
+    total] on every resource (requests are non-negative), and the id
+    rank is below the padded global node count — so packed comparison
+    equals lexicographic comparison. Masked-out entries may clip, but
+    the fill sort replaces them with sentinels anyway. Falls back to
+    the multi-key path when the widths overflow 62 bits or x64 is off
+    (TPU: no int64 lanes)."""
+    if not jax.config.jax_enable_x64:
+        return keys
+    rank_bits = max(1, (n_local * dist.n_shards - 1).bit_length())
+    bits = [max(1, int(b)) for b in dev.order_key_bits] + [rank_bits]
+    if len(bits) != len(keys) or sum(bits) > 62:
+        return keys
+    acc = jnp.zeros(keys[0].shape, jnp.int64)
+    for k, b in zip(keys, bits):
+        acc = (acc << b) | jnp.clip(k, 0, (1 << b) - 1).astype(jnp.int64)
+    return [acc]
 
 
 def _drf_cost(alloc, total, mult):
@@ -183,11 +215,13 @@ def _select_at_row(dev, dist, alloc, j, row, static_ok):
 def fair_preemption_order(carry):
     """Precompute the (node, -rank) walk order once per pass: ranks are
     fixed at assignment; only the active mask changes as evicted jobs are
-    consumed or rescheduled, which the per-select mask handles."""
+    consumed or rescheduled, which the per-select mask handles. Inactive
+    rows sort last via the shared sentinel keys (ops/select.py); their
+    relative order is irrelevant — the walk zeroes their contributions
+    and the selection mask excludes them."""
     rank = carry.evict_rank
     active = rank >= 0
-    node_key = jnp.where(active, carry.job_node, BIG)
-    return jnp.lexsort((BIG - rank, node_key))
+    return masked_lexsort([carry.job_node, BIG - rank], active)
 
 
 def _fair_preemption(dev, dist, carry, j, static_ok, fp_order):
@@ -661,6 +695,8 @@ def _pass_segment(
     use_key_skip: bool,
     consider_priority: bool,
     prefer_large: bool,
+    seg0=None,
+    window_trunc=None,
 ):
     """QueueScheduler.Schedule as a while_loop (queue_scheduler.go:91-276).
 
@@ -682,7 +718,18 @@ def _pass_segment(
     the segment boundary is a while-iteration boundary, where gang
     attempts are complete, so per-chunk recomputation of the all-evicted
     flags and the fair-preemption order is value-identical for every slot
-    still PENDING."""
+    still PENDING.
+
+    `seg0` (int32[3]) accumulates per-kind loop counts (gang / fill /
+    merged-fill) for the solve profile; always returned.
+
+    `window_trunc` (bool[Q]) marks hot-window compaction
+    (solver/hotwindow.py): queues whose slot table is a truncated window
+    of the real one. The loop then also stops — the REWINDOW handshake —
+    as soon as any truncated queue's in-window remainder drops below the
+    kernel's head lookahead (the fill window, or 1 slot in serial mode),
+    so no iteration ever runs that could have seen slots beyond the
+    window; the host re-gathers from the full slot order and resumes."""
     Q = dev.queue_slot_start.shape[0]
     S = dev.slot_members.shape[0]
     # Fill fast path is statically compiled in only for the queued pass of a
@@ -694,14 +741,26 @@ def _pass_segment(
         and not consider_priority
     )
     fast_fill_enabled = fill_enabled and dev.fast_fill
+    loops0 = carry.loops
+    lookahead = jnp.int32(dev.batch_window if fill_enabled else 1)
 
     def cond(state):
-        c, ptr, _ = state
+        c, ptr, _, _ = state
         # Every iteration either consumes >=1 slot, flips a validity flag,
-        # or arms force-serial for the next one: 2S+4 bounds the loop even
-        # with fill-miss/serial-retry pairs. loop_cap cuts earlier when a
-        # round budget is in force (solve_round's chunked driver).
-        return ~c.stop & (c.loops < loop_cap) & (c.loops < 2 * S + 4)
+        # or arms force-serial for the next one: 2S+4 bounds the segment
+        # even with fill-miss/serial-retry pairs (relative to the entry
+        # count — `loops` accumulates across chunks). loop_cap cuts
+        # earlier when a round budget is in force (solve_round's chunked
+        # driver).
+        go = ~c.stop & (c.loops < loop_cap) & (c.loops - loops0 < 2 * S + 4)
+        if window_trunc is not None:
+            # Hot-window rewindow handshake: never enter an iteration in
+            # which a truncated queue's head lookahead could cross its
+            # window end — the full kernel would see real slots there.
+            go = go & ~jnp.any(
+                window_trunc & ((dev.queue_slot_end - ptr) < lookahead)
+            )
+        return go
 
     # all-evicted flags are stable within a pass: evictions happen between
     # passes, and a rescheduled member's slot is the one being consumed.
@@ -753,7 +812,7 @@ def _pass_segment(
             res = dev.order_res_resolution[k]
             nkeys.append(alloc0[:, ri] // res)
         nkeys.append(dev.node_id_rank)
-        return fit0, caps, nkeys
+        return fit0, caps, _pack_fill_keys(dev, dist, alloc0.shape[0], nkeys)
 
     def fill_apply(c, qstar, sstar, kmax):
         """Place up to kmax jobs from the identical-singleton run headed at
@@ -1174,15 +1233,45 @@ def _pass_segment(
         # the entry's key within the window; rank_in_g = how many earlier
         # window entries share its key. Windows are cut at key number G+1.
         # (Evicted windows skip grouping entirely — placement is pinned.)
+        # Occurrence ranking runs as ONE (queue, key, position) sort over
+        # the Q*W entries plus segment scans — O(QW log QW) — instead of
+        # the [Q, W, W] equality matrix, whose O(W^2) traffic capped
+        # usable fill windows at a few hundred slots (and measured
+        # slower even at W=512 on this host).
         grp = jnp.where(base, dev.slot_key_group[widx], -2 - ivec[None, :])
-        eqm = (grp[:, :, None] == grp[:, None, :]) & (
-            ivec[None, None, :] <= ivec[None, :, None]
+        QW = Q * W
+        flat_idx = jnp.arange(QW, dtype=jnp.int32)
+        qrow = flat_idx // W
+        pos_f = jnp.broadcast_to(ivec[None, :], (Q, W)).reshape(-1)
+        grp_f = grp.reshape(-1)
+        order_g = jnp.lexsort((pos_f, grp_f, qrow))
+        q_s = qrow[order_g]
+        g_s = grp_f[order_g]
+        p_s = pos_f[order_g]
+        run_head = jnp.concatenate(
+            [
+                jnp.ones(1, bool),
+                (q_s[1:] != q_s[:-1]) | (g_s[1:] != g_s[:-1]),
+            ]
         )
-        first_j = jnp.argmax(eqm, axis=2).astype(jnp.int32)
+        head_at = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(run_head, flat_idx, 0)
+        )
+        rank_in_g = (
+            jnp.zeros(QW, jnp.int32)
+            .at[order_g]
+            .set((flat_idx - head_at).astype(jnp.int32))
+            .reshape(Q, W)
+        )
+        first_j = (
+            jnp.zeros(QW, jnp.int32)
+            .at[order_g]
+            .set(p_s[head_at])
+            .reshape(Q, W)
+        )
         first_occ = (first_j == ivec[None, :]) & base
         gnum = jnp.cumsum(first_occ.astype(jnp.int32), axis=1)
         gid = jnp.take_along_axis(gnum, first_j, axis=1) - 1
-        rank_in_g = jnp.sum(eqm, axis=2).astype(jnp.int32) - 1
         base = base & ((gid < G) | kind_ev[:, None])
         base = jnp.cumprod(base.astype(jnp.int8), axis=1).astype(bool)
 
@@ -1360,7 +1449,7 @@ def _pass_segment(
         return c, ptr, progressed
 
     def body(state):
-        c, ptr, force_serial = state
+        c, ptr, force_serial, segc = state
         has_head = ptr < dev.queue_slot_end
         heads = jnp.clip(ptr, 0, S - 1)
 
@@ -1484,6 +1573,7 @@ def _pass_segment(
             c, ptr, fs = jax.lax.cond(
                 do_merge, merged_branch, serial_branch, (c, ptr)
             )
+            segc = segc.at[jnp.where(do_merge, SEG_MERGED, SEG_GANG)].add(1)
         elif fill_enabled:
             do_fill = (
                 any_head
@@ -1503,13 +1593,19 @@ def _pass_segment(
                 return c2, ptr2, jnp.zeros((), bool)
 
             c, ptr, fs = jax.lax.cond(do_fill, fill_branch, serial_branch, (c, ptr))
+            segc = segc.at[jnp.where(do_fill, SEG_FILL, SEG_GANG)].add(1)
         else:
             c, ptr = serial_step(c, ptr)
             fs = jnp.zeros((), bool)
-        return c._replace(loops=c.loops + 1), ptr, fs
+            segc = segc.at[SEG_GANG].add(1)
+        return c._replace(loops=c.loops + 1), ptr, fs, segc
 
-    carry, ptr, fs = jax.lax.while_loop(cond, body, (carry, ptr0, fs0))
-    return carry, ptr, fs
+    if seg0 is None:
+        seg0 = jnp.zeros(3, jnp.int32)
+    carry, ptr, fs, segc = jax.lax.while_loop(
+        cond, body, (carry, ptr0, fs0, seg0)
+    )
+    return carry, ptr, fs, segc
 
 
 def _pass_init_ptrs(dev, carry, include_queued, use_key_skip):
@@ -1536,7 +1632,7 @@ def _schedule_pass(
     # The counter restarts per pass (the reference's loopNumber is also
     # per-QueueScheduler, queue_scheduler.go:99).
     carry = carry._replace(stop=jnp.zeros((), bool), loops=jnp.zeros((), jnp.int32))
-    carry, _, _ = _pass_segment(
+    carry, _, _, _ = _pass_segment(
         dev,
         dist,
         carry,
@@ -1911,7 +2007,7 @@ def _pass1_begin_impl(dev: DeviceRound):
     return carry, ptr0, budgets, fair_share, demand_capped, uncapped
 
 
-def _pass1_chunk_impl(dev: DeviceRound, carry, ptr, fs, budgets, loop_cap):
+def _pass1_chunk_impl(dev: DeviceRound, carry, ptr, fs, segc, budgets, loop_cap):
     return _pass_segment(
         dev,
         LOCAL,
@@ -1924,41 +2020,162 @@ def _pass1_chunk_impl(dev: DeviceRound, carry, ptr, fs, budgets, loop_cap):
         use_key_skip=True,
         consider_priority=False,
         prefer_large=dev.prefer_large,
+        seg0=segc,
+    )
+
+
+def _normalize_window_ptrs(dev, carry, ptr, include_queued, use_key_skip):
+    """Advance each pointer to its queue's next valid slot at or after it.
+
+    The kernel's pointer invariant is "ptr rests on a valid slot or the
+    queue end"; a window segment can break it when the in-window advance
+    is cut at the window edge (the remaining skip happens beyond the
+    gathered slots). Validity is monotone non-increasing within a pass
+    (flags only set, consumption only forward), so completing the skip
+    here — against the same carry — lands exactly where the full
+    kernel's advance would have; for pointers already on valid slots
+    this is the identity."""
+    valid, _ = _slot_validity(dev, carry, include_queued, use_key_skip)
+    S = valid.shape[0]
+    Q = dev.queue_slot_start.shape[0]
+    pos = jnp.arange(S, dtype=jnp.int32)
+    seg = jnp.clip(dev.slot_queue, 0, Q - 1)
+    ahead = valid & (pos >= ptr[seg])
+    heads = jax.ops.segment_min(
+        jnp.where(ahead, pos, BIG), seg, num_segments=Q
+    )
+    return jnp.where(heads < BIG, heads, dev.queue_slot_end).astype(jnp.int32)
+
+
+def _pass1_norm_impl(dev: DeviceRound, carry, ptr):
+    """Full-table pointer normalization between hot windows. Run before
+    every gather so a window never opens on an invalid head: a cut
+    in-window advance continues here in ONE full scan — crucially, a
+    queue whose remaining stream is entirely invalid (tokens spent,
+    only-evicted flags) jumps straight to its end instead of walking it
+    window by window (the drain phase that cost 48 re-gathers on the
+    first burst run)."""
+    return _normalize_window_ptrs(dev, carry, ptr, True, True)
+
+
+def _pass1_window_chunk_impl(
+    dev_w: DeviceRound, carry, ptr, fs, segc, budgets, loop_cap, trunc
+):
+    """One pass-1 segment over a hot-window compacted round
+    (solver/hotwindow.py): identical machinery, W-sized slot/job axes,
+    plus the rewindow stop for truncated queues."""
+    return _pass_segment(
+        dev_w,
+        LOCAL,
+        carry,
+        ptr,
+        fs,
+        budgets,
+        loop_cap,
+        include_queued=True,
+        use_key_skip=True,
+        consider_priority=False,
+        prefer_large=dev_w.prefer_large,
+        seg0=segc,
+        window_trunc=trunc,
     )
 
 
 def _finish_impl(dev: DeviceRound, carry, budgets, fair_share, demand_capped,
-                 uncapped):
+                 uncapped, rescue: bool):
     # Rescue pass for truncated rounds: pass 1 evicts running jobs up
     # front, so stopping it early would finalize evicted-but-never-
     # attempted jobs as PREEMPTED — mass preemption, not degradation. An
     # evicted-only pass gives every still-pending evicted slot its pinned
     # rebind attempt (evicted jobs only ever return to their own node,
     # _select_node). After a COMPLETE pass 1 no pending evicted slots
-    # remain and this is a structural no-op. Rebind capacity at the
-    # truncation point is a superset of what the full round's later
-    # attempts would see, so truncated preemptions are a subset of the
-    # full round's.
-    loops0 = carry.loops
-    carry = _schedule_pass(
-        dev,
-        LOCAL,
-        carry,
-        budgets,
-        include_queued=False,
-        use_key_skip=False,
-        consider_priority=False,
-        prefer_large=dev.prefer_large,
-    )
-    carry = carry._replace(loops=loops0 + carry.loops)
+    # remain and the pass is a structural no-op — `rescue` is static
+    # (only truncated rounds compile/run it), keeping the untruncated
+    # host-driven round loop-for-loop identical to the fused program.
+    # Rebind capacity at the truncation point is a superset of what the
+    # full round's later attempts would see, so truncated preemptions
+    # are a subset of the full round's.
+    if rescue:
+        loops0 = carry.loops
+        carry = _schedule_pass(
+            dev,
+            LOCAL,
+            carry,
+            budgets,
+            include_queued=False,
+            use_key_skip=False,
+            consider_priority=False,
+            prefer_large=dev.prefer_large,
+        )
+        carry = carry._replace(loops=loops0 + carry.loops)
     return _round_finish(
         dev, LOCAL, carry, budgets, fair_share, demand_capped, uncapped
     )
 
 
 _pass1_begin = jax.jit(_pass1_begin_impl)
-_pass1_chunk = jax.jit(_pass1_chunk_impl)
-_round_finish_jit = jax.jit(_finish_impl)
+# The chunked carries are DONATED: each segment updates the previous
+# chunk's buffers in place instead of copying the J-sized job arrays and
+# the [P, N, R] allocation per chunk.
+_pass1_chunk = jax.jit(_pass1_chunk_impl, donate_argnums=(1, 2, 3, 4))
+_pass1_norm = jax.jit(_pass1_norm_impl, donate_argnums=(2,))
+_pass1_window_chunk = jax.jit(
+    _pass1_window_chunk_impl, donate_argnums=(1, 2, 3, 4)
+)
+_round_finish_jit = jax.jit(_finish_impl, static_argnums=(6,))
+
+
+def _window_precheck(dev: DeviceRound, window, min_slots):
+    """Static hot-window sizing, or None when compaction cannot pay off.
+
+    Ws is the per-queue window in slots: the configured size rounded up
+    to the kernel's head lookahead and bucketed to a power of two (one
+    compiled window program per bucket, not per round). Compaction
+    engages only when the window axes are strictly smaller (below half)
+    than the full ones AND the slot axis clears `min_slots` — the
+    host-driven driver costs a fixed ~0.1-0.2s of dispatch/sync
+    overhead per round, which a mid-size round (the tracking_100k
+    regression on the first measured run) cannot amortize even though
+    the geometric shrink looks fine. Needs no device data, so the
+    fused-vs-host-driven choice is made before anything runs."""
+    if not window or int(window) <= 0:
+        return None
+    from .hotwindow import window_lookahead
+
+    Q = int(dev.queue_weight.shape[0])
+    S, M = (int(x) for x in dev.slot_members.shape)
+    J = int(dev.job_req.shape[0])
+    if S < int(min_slots):
+        return None
+    la = window_lookahead(dev)
+    Ws = _pow2(max(int(window), la), 1)
+    # Slot side below HALF (the shrink that pays); job side merely below
+    # the full axis — M is the max gang width, so Q*Ws*M wildly
+    # overestimates the member count of singleton-dominated windows and
+    # a half-rule there would veto legitimate gang rounds.
+    if 2 * Q * Ws >= S or Q * Ws * M + 1 >= J:
+        return None
+    return Ws, la
+
+
+def _window_plan(dev: DeviceRound, carry, pre):
+    """Finish the window plan against the live carry: Ep is the padded
+    capacity for out-of-window evicted jobs, bucketed from the round's
+    actual evicted count (one scalar device->host sync per round; the
+    set only shrinks during pass 1, so the bucket holds all pass long).
+    A huge evicted set can still veto compaction here — the job axis
+    would not shrink."""
+    if pre is None:
+        return None
+    Ws, la = pre
+    Q = int(dev.queue_weight.shape[0])
+    M = int(dev.slot_members.shape[1])
+    J = int(dev.job_req.shape[0])
+    n_evicted = int(np.asarray(jnp.sum(carry.evict_rank >= 0)))
+    Ep = _pow2(max(n_evicted, 1), 1)
+    if Q * Ws * M + Ep >= J:
+        return None
+    return Ws, Ep, la
 
 
 def solve_round(
@@ -1966,65 +2183,173 @@ def solve_round(
     *,
     budget_s: float | None = None,
     chunk_loops: int = 1,
+    window: int | None = None,
+    window_min_slots: int = HOT_WINDOW_MIN_SLOTS_DEFAULT,
+    profile: bool = False,
 ):
-    """Run the round solve; returns numpy outputs plus a `truncated` flag.
+    """Run the round solve; returns numpy outputs (plus a `truncated`
+    flag when budgeted and a `profile` dict on the host-driven paths).
 
-    budget_s=None (default) runs the single fused XLA program exactly as
-    before. With a budget, pass 1 runs in chunks of while-loop iterations
-    (fill loops) with the wall clock checkpointed between chunks; once the
-    budget is spent the pass stops yielding new loops, the oversubscription
-    repair + pass 2 + finalize still run (they only rebind evicted running
-    jobs — cheap, and required for a committable result), and the caller
-    gets `truncated=True`. The chunk size starts at `chunk_loops` (default
-    1: at most one fill loop of slack past the deadline) and adapts upward
+    budget_s=None (default) runs pass 1 to completion. With a budget,
+    pass 1 runs in chunks of while-loop iterations (fill loops) with the
+    wall clock checkpointed between chunks; once the budget is spent the
+    pass stops yielding new loops, the oversubscription repair + pass 2 +
+    finalize still run (they only rebind evicted running jobs — cheap,
+    and required for a committable result), and the caller gets
+    `truncated=True`. The chunk size starts at `chunk_loops` (default 1:
+    at most one fill loop of slack past the deadline) and adapts upward
     only while per-loop time is far below the budget, so fast serial
     regimes don't pay a host sync per iteration.
+
+    window=W enables hot-window compaction (solver/hotwindow.py): pass 1
+    runs over a gathered active set of ~W slots per queue with results
+    scattered back at chunk boundaries, re-gathering (REWINDOW) whenever
+    a queue's window runs low — bit-exact with the uncompacted kernel.
+    Engages only when the window axes actually shrink the round AND the
+    slot axis clears `window_min_slots` (`_window_precheck`); smaller
+    rounds fall through to the fused program unchanged. Tests and the
+    bench pass window_min_slots=0 to exercise compaction at any scale.
+
+    profile=True forces the host-driven segmented driver even without a
+    budget or window, so per-segment timings are measured. Any
+    host-driven run attaches out["profile"]: wall clock per solve
+    segment (setup / pass-1 / gather+scatter / finish) and pass-1 loop
+    counts by kind (gang / fill / merged-fill), plus rewindow counts.
     """
-    if not budget_s or budget_s <= 0:
-        # No budget: the single fused program, and no `truncated` key —
-        # existing consumers iterate the result's array-valued keys.
+    use_budget = bool(budget_s) and budget_s > 0
+    pre = _window_precheck(dev, window, window_min_slots)
+    if not use_budget and pre is None and not profile:
+        # Fused single-program path (small rounds land here even with a
+        # window configured), and no `truncated` key — existing
+        # consumers iterate the result's array-valued keys.
         out = _solve(dev)
         return {k: np.asarray(v) for k, v in out.items()}
 
     import time as _time
 
-    deadline = _time.monotonic() + float(budget_s)
+    deadline = _time.monotonic() + float(budget_s) if use_budget else None
     # One upload: every chunk reuses the resident round tensors instead of
     # re-transferring the host arrays per segment.
     dev = jax.device_put(dev)
+    t0 = _time.monotonic()
     carry, ptr, budgets, fair_share, demand_capped, uncapped = _pass1_begin(dev)
+    jax.block_until_ready(carry.loops)
+    setup_s = _time.monotonic() - t0
     fs = jnp.zeros((), bool)
+    segc = jnp.zeros(3, jnp.int32)
     S = int(dev.slot_members.shape[0])
     hard_cap = 2 * S + 4
     chunk = max(1, int(chunk_loops))
     truncated = False
-    while True:
-        jax.block_until_ready(carry.loops)
-        loops = int(np.asarray(carry.loops))
-        if bool(np.asarray(carry.stop)) or loops >= hard_cap:
-            break
-        # Forward-progress floor: even a budget spent before the first
-        # loop (snapshot build ate it) runs ONE loop, so a persistently
-        # tiny budget drains the backlog instead of starving it.
-        if loops > 0 and _time.monotonic() >= deadline:
-            truncated = True
-            break
-        t0 = _time.monotonic()
-        carry, ptr, fs = _pass1_chunk(
-            dev, carry, ptr, fs, budgets,
-            jnp.int32(min(loops + chunk, hard_cap)),
-        )
-        jax.block_until_ready(carry.loops)
-        executed = max(1, int(np.asarray(carry.loops)) - loops)
-        per_loop = (_time.monotonic() - t0) / executed
+    plan = _window_plan(dev, carry, pre)
+    rewindows = 0
+    gather_s = 0.0
+    t_pass = _time.monotonic()
+
+    def _adapt_chunk(t0, executed):
         # Re-check the clock roughly every budget/8 while never batching
         # more than one loop when a single loop exceeds that interval
         # (the burst regime), keeping overshoot to one fill loop.
         target = max(float(budget_s) / 8.0, 0.02)
-        chunk = max(1, min(int(target / max(per_loop, 1e-7)), 4096))
+        per_loop = (_time.monotonic() - t0) / executed
+        return max(1, min(int(target / max(per_loop, 1e-7)), 4096))
+
+    if plan is None:
+        while True:
+            jax.block_until_ready(carry.loops)
+            loops = int(np.asarray(carry.loops))
+            if bool(np.asarray(carry.stop)) or loops >= hard_cap:
+                break
+            # Forward-progress floor: even a budget spent before the first
+            # loop (snapshot build ate it) runs ONE loop, so a persistently
+            # tiny budget drains the backlog instead of starving it.
+            if deadline is not None and loops > 0 and _time.monotonic() >= deadline:
+                truncated = True
+                break
+            cap = hard_cap if deadline is None else min(loops + chunk, hard_cap)
+            t0 = _time.monotonic()
+            carry, ptr, fs, segc = _pass1_chunk(
+                dev, carry, ptr, fs, segc, budgets, jnp.int32(cap)
+            )
+            jax.block_until_ready(carry.loops)
+            executed = max(1, int(np.asarray(carry.loops)) - loops)
+            if deadline is not None:
+                chunk = _adapt_chunk(t0, executed)
+    else:
+        from .hotwindow import gather_window, scatter_back
+
+        Ws, Ep, lookahead = plan
+        Q = int(dev.queue_weight.shape[0])
+        done = False
+        while not done:
+            t0 = _time.monotonic()
+            ptr = _pass1_norm(dev, carry, ptr)
+            win_base = ptr
+            dev_w, carry_w, ptr_w, trunc, win_len, sidx, jidx = gather_window(
+                dev, carry, ptr, Ws, Ep
+            )
+            trunc_np = np.asarray(trunc)
+            end_np = np.arange(Q) * Ws + np.asarray(win_len)
+            gather_s += _time.monotonic() - t0
+            while True:
+                jax.block_until_ready(carry_w.loops)
+                loops = int(np.asarray(carry_w.loops))
+                stop = bool(np.asarray(carry_w.stop))
+                short = (end_np - np.asarray(ptr_w)) < lookahead
+                rewind = (not stop) and bool(np.any(trunc_np & short))
+                if stop or loops >= hard_cap:
+                    done = True
+                    break
+                if rewind:
+                    break
+                if (
+                    deadline is not None
+                    and loops > 0
+                    and _time.monotonic() >= deadline
+                ):
+                    truncated = True
+                    done = True
+                    break
+                cap = hard_cap if deadline is None else min(loops + chunk, hard_cap)
+                t0 = _time.monotonic()
+                carry_w, ptr_w, fs, segc = _pass1_window_chunk(
+                    dev_w, carry_w, ptr_w, fs, segc, budgets,
+                    jnp.int32(cap), trunc,
+                )
+                jax.block_until_ready(carry_w.loops)
+                executed = max(1, int(np.asarray(carry_w.loops)) - loops)
+                if deadline is not None:
+                    chunk = _adapt_chunk(t0, executed)
+            t0 = _time.monotonic()
+            carry, ptr = scatter_back(
+                carry, carry_w, ptr_w, sidx, jidx, win_base, Ws
+            )
+            gather_s += _time.monotonic() - t0
+            if not done:
+                rewindows += 1
+
+    jax.block_until_ready(carry.loops)
+    pass1_s = _time.monotonic() - t_pass - gather_s
+    t0 = _time.monotonic()
     out = _round_finish_jit(
-        dev, carry, budgets, fair_share, demand_capped, uncapped
+        dev, carry, budgets, fair_share, demand_capped, uncapped, truncated
     )
+    jax.block_until_ready(out["num_loops"])
+    finish_s = _time.monotonic() - t0
+    seg_np = np.asarray(segc)
     out = {k: np.asarray(v) for k, v in out.items()}
-    out["truncated"] = truncated
+    if use_budget:
+        out["truncated"] = truncated
+    out["profile"] = {
+        "setup_s": round(setup_s, 4),
+        "pass1_s": round(pass1_s, 4),
+        "gather_s": round(gather_s, 4),
+        "finish_s": round(finish_s, 4),
+        "gang_loops": int(seg_np[SEG_GANG]),
+        "fill_loops": int(seg_np[SEG_FILL]),
+        "merged_fill_loops": int(seg_np[SEG_MERGED]),
+        "compacted": plan is not None,
+        "window_slots": int(plan[0]) if plan else 0,
+        "rewindows": rewindows,
+    }
     return out

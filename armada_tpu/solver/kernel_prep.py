@@ -42,6 +42,7 @@ _META_FIELDS = (
     "batch_window",
     "fast_fill",
     "fill_groups",
+    "order_key_bits",
 )
 
 
@@ -67,6 +68,12 @@ class DeviceRound:
     node_gid: np.ndarray  # int32[N]
     order_res_idx: np.ndarray  # int32[K]
     order_res_resolution: np.ndarray  # int32[K]
+    # Static bit width of each best-fit order key (allocatable // res of
+    # an in-mask node is within [0, max node total // res]): lets the
+    # fill sort fuse its K+1 keys into ONE packed int64 when they fit
+    # (kernel._pack_fill_keys). Padding adds zero-total rows, node-axis
+    # sharding only slices — neither raises the bound.
+    order_key_bits: tuple  # int per order key
 
     # jobs
     job_req: np.ndarray  # int32[J, R] full requests (costs, accounting)
@@ -84,6 +91,10 @@ class DeviceRound:
     job_excluded_nodes: np.ndarray  # int32[J, K] retry anti-affinity
     job_affinity_group: np.ndarray  # int32[J]
     affinity_allowed: np.ndarray  # uint32[A, ceil(N/32)]
+    # Slot containing this job as a member (-1 if none): the reverse of
+    # slot_members, used by the hot-window gather (solver/hotwindow.py)
+    # to test whether an evicted job's slot falls inside the window.
+    job_slot: np.ndarray  # int32[J]
 
     # slots
     slot_members: np.ndarray  # int32[S, M] (-1 pad)
@@ -195,6 +206,7 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
     Jp, Np, Sp, Qp, Mp = _pow2(J), _pow2(N), _pow2(S), _pow2(Q, 2), _pow2(M, 1)
     Gp = _pow2(dev.num_key_groups, 8)
     if (Jp, Np, Sp, Qp, Mp, Gp) == (J, N, S, Q, M, dev.num_key_groups):
+        _assert_pad_rows_inert(dev, J, S)
         return dev
 
     def pad(arr, axis, n_new, fill=0):
@@ -203,7 +215,7 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         widths[axis] = (0, n_new - arr.shape[axis])
         return np.pad(arr, widths, constant_values=fill)
 
-    return dataclasses.replace(
+    out = dataclasses.replace(
         dev,
         alloc0=pad(dev.alloc0, 1, Np),
         node_total=pad(dev.node_total, 0, Np),
@@ -228,6 +240,7 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         job_pc=pad(dev.job_pc, 0, Jp),
         job_excluded_nodes=pad(dev.job_excluded_nodes, 0, Jp, fill=-1),
         job_affinity_group=pad(dev.job_affinity_group, 0, Jp, fill=-1),
+        job_slot=pad(dev.job_slot, 0, Jp, fill=-1),
         affinity_allowed=pad(
             pad(dev.affinity_allowed, 1, (Np + 31) // 32),
             0,
@@ -260,6 +273,22 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         queue_pc_limit=pad(dev.queue_pc_limit, 0, Qp, fill=np.inf),
         queue_tokens=pad(dev.queue_tokens, 0, Qp),
         num_key_groups=Gp,
+    )
+    _assert_pad_rows_inert(out, J, S)
+    return out
+
+
+def _assert_pad_rows_inert(dev: DeviceRound, n_jobs: int, n_slots: int):
+    """Every padded row must be masked out of the kernel's predicates:
+    pad jobs impossible (no select/fill can choose them) and pad slots
+    count-0 (validity and rank assignment skip them). The hot-window
+    gather (solver/hotwindow.py) builds its compacted axes straight off
+    these tables, so a live pad row would silently join a window."""
+    assert not np.asarray(dev.job_possible[n_jobs:]).any(), (
+        "pad_device_round: padded job rows leaked into job_possible"
+    )
+    assert not (np.asarray(dev.slot_count[n_slots:]) > 0).any(), (
+        "pad_device_round: padded slot rows carry a nonzero slot_count"
     )
 
 
@@ -714,6 +743,17 @@ def prep_device_round(
             elig, ends[k] + 1 - np.arange(n_live), 0
         )
 
+    # Reverse member map for the hot-window gather: the slot each job is a
+    # member of (-1 for jobs in no slot, e.g. lookback-shrunk tails).
+    # Computed from the FINAL slot table so shrinking cannot leave stale
+    # slot ids behind.
+    job_slot = np.full(J, -1, dtype=np.int32)
+    mem_valid = slot_members >= 0
+    if mem_valid.any():
+        job_slot[slot_members[mem_valid]] = np.nonzero(mem_valid)[0].astype(
+            np.int32
+        )
+
     # ---- queue tensors ----
     queue_name_rank = np.argsort(np.argsort(snap.queue_names)).astype(np.int32)
     if cache is not None:
@@ -749,12 +789,16 @@ def prep_device_round(
         floating_mask, snap.floating_total.astype(np.float64) / div, 0.0
     )
 
-    # Candidate-order resolutions in device units.
+    # Candidate-order resolutions in device units, plus each key's static
+    # bit width (max possible rounded-allocatable of any node).
     order_res = []
+    order_key_bits = []
     for k, ri in enumerate(snap.order_res_idx):
         host_res = int(snap.order_res_resolution[k])
         dev_res = max(1, host_res // int(factory.device_divisor[ri]))
         order_res.append(dev_res)
+        max_total = int(total_dev[:, ri].max()) if N else 0
+        order_key_bits.append(max(1, (max(max_total, 0) // dev_res).bit_length()))
 
     mult = snap.drf_multipliers()
 
@@ -770,6 +814,7 @@ def prep_device_round(
         node_gid=np.arange(N, dtype=np.int32),
         order_res_idx=snap.order_res_idx.astype(np.int32),
         order_res_resolution=np.asarray(order_res, dtype=np.int32),
+        order_key_bits=tuple(order_key_bits),
         job_req=req_dev,
         job_req_fit=req_fit_dev,
         job_tolerated=snap.job_tolerated,
@@ -785,6 +830,7 @@ def prep_device_round(
         job_excluded_nodes=snap.job_excluded_nodes,
         job_affinity_group=snap.job_affinity_group,
         affinity_allowed=snap.affinity_allowed,
+        job_slot=job_slot,
         slot_members=slot_members,
         slot_count=slot_count,
         slot_queue=slot_queue,

@@ -70,19 +70,44 @@ def _probe_once(timeout: float) -> tuple[str | None, str]:
     return None, f"probe exited rc={proc.returncode}: {' | '.join(tail)}"
 
 
+def xla_target_signature() -> str:
+    """The components that pin XLA:CPU's EFFECTIVE target features,
+    beyond the raw cpuinfo flags: the jaxlib/XLA revision (whose LLVM
+    decides the feature set and tuning features like prefer-no-gather)
+    and any xla_cpu codegen flags in XLA_FLAGS. Two processes agreeing
+    on cpuinfo but differing here can still emit AOT executables whose
+    serialized target features mismatch at load time — the
+    cpu_aot_loader "could lead to ... SIGILL" warning flood."""
+    try:
+        import jaxlib
+
+        parts = [f"jaxlib-{jaxlib.__version__}"]
+    except Exception:  # pragma: no cover - jaxlib is a hard dep in practice
+        parts = ["jaxlib-unknown"]
+    flags = sorted(
+        t
+        for t in os.environ.get("XLA_FLAGS", "").split()
+        if t.startswith("--xla_cpu")
+    )
+    return " ".join(parts + flags)
+
+
 def host_cpu_signature() -> str:
-    """Stable hash of the host's CPU ISA features.
+    """Stable hash of the host's CPU ISA features plus the effective XLA
+    target-feature inputs (xla_target_signature).
 
     XLA:CPU AOT-compiles to the build host's feature set; loading cached
     executables compiled on a machine with different features is exactly
     the cpu_aot_loader.cc "could lead to ... SIGILL" hazard (its warnings
     flooded the round-5 bench tails when one shared cache dir served
-    heterogeneous hosts). Keying the cache directory by this signature
-    means a foreign host gets a MISS, never an incompatible load."""
+    heterogeneous hosts — and kept flooding when a toolchain bump changed
+    the feature set XLA targets on the SAME host). Keying the cache
+    directory by this signature means a foreign host or a different
+    toolchain gets a MISS, never an incompatible load."""
     import hashlib
     import platform as _platform
 
-    parts = [_platform.machine()]
+    parts = [_platform.machine(), xla_target_signature()]
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:

@@ -30,19 +30,42 @@ parity-mode fill; BENCH_WARM_CYCLES sets the warm-sample count (>=2,
 default 5); BENCH_ROUND_BUDGET_S runs every solve through the
 budget-aware chunked driver (maxSchedulingDuration) and reports
 truncation — the burst_50k config with BENCH_ROUND_BUDGET_S=5 is the
-round-deadline acceptance scenario.
+round-deadline acceptance scenario; BENCH_HOT_WINDOW sets the per-queue
+hot-window compaction size (0 disables; default: 2x the fill window);
+BENCH_FILL_WINDOW sets batch_fill_window (wide windows amortize the
+per-group candidate sort, the dominant per-loop cost at 50k nodes).
+
+The LAST stdout line is always one JSON object with an "ok" flag — on
+any failure it carries ok=false and the error instead of silently dying
+mid-run, so artifact parsers (tools/bench_trend.py, tools/bench_gate.py)
+never see a half-written result.
 """
 
 import json
 import os
 import time
 
+# The XLA CPU AOT loader logs a full machine-feature dump per
+# cache-entry mismatch ("could lead to ... SIGILL"), flooding bench
+# tails. The compile-cache key now includes the effective XLA target
+# features (utils/platform.py) so mismatched entries miss instead of
+# load; the residual one-time warnings are log noise, not signal.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
 N_QUEUES = int(os.environ.get("BENCH_QUEUES", 10))
 # Running preemptible jobs (exercises eviction + fair preemption paths).
 N_RUNNING = int(os.environ.get("BENCH_RUNNING", 0))
 
 
-def build_inputs(n_jobs, n_nodes, burst=None):
+def resolve_fill_window(fill_window=None) -> int:
+    """The effective batch_fill_window: BENCH_FILL_WINDOW env override,
+    else the per-config value, else 2048. One resolution shared by
+    build_inputs and run_config's hot-window sizing so the '~2x the fill
+    window' invariant cannot drift between the two sites."""
+    return int(os.environ.get("BENCH_FILL_WINDOW", fill_window or 2048))
+
+
+def build_inputs(n_jobs, n_nodes, burst=None, fill_window=None):
     import numpy as np
 
     from armada_tpu.core.config import (
@@ -69,6 +92,11 @@ def build_inputs(n_jobs, n_nodes, burst=None):
         # Fast mode: batch the multi-queue sweep (set-exact vs the serial
         # loop when everything fits; see SchedulingConfig.enable_fast_fill).
         enable_fast_fill=os.environ.get("BENCH_FAST_FILL", "1") == "1",
+        # Wide fill windows amortize the per-group best-fit candidate
+        # sort (the dominant per-loop cost at 50k+ nodes) over more
+        # placements per loop; burst drains in ~3 merged loops at 2048.
+        # The tracking config keeps the historical 512 (like-for-like).
+        batch_fill_window=resolve_fill_window(fill_window),
         **kw,
     )
     rng = np.random.default_rng(0)
@@ -129,7 +157,8 @@ def _put(dev):
     return out
 
 
-def run_config(n_jobs, n_nodes, burst=None, mesh=None):
+def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
+               hot_window=None):
     """Cold build, one shape-settling warm cycle, then >=5 measured warm
     cycles (BENCH_WARM_CYCLES): the headline is the MEDIAN cycle with its
     spread (min/max + IQR), not a single sample — a single warm cycle can
@@ -142,10 +171,18 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None):
     from armada_tpu.solver.kernel_prep import pad_device_round
 
     budget_s = float(os.environ.get("BENCH_ROUND_BUDGET_S", 0) or 0) or None
+    raw_window = os.environ.get("BENCH_HOT_WINDOW")
+    if raw_window is not None:
+        hot_window = int(raw_window)
+    elif hot_window is None:
+        # 2x the fill window: one gather covers ~two merged fill loops.
+        hot_window = 2 * resolve_fill_window(fill_window)
     sharded = None
     if mesh:
         # mesh is a spec: int (1D chip count) or "HxC" (two-level
-        # hosts x chips hierarchy, parallel/multihost.py).
+        # hosts x chips hierarchy, parallel/multihost.py). The sharded
+        # solve is one fused program (no hot-window chunking — the
+        # tracked sharded-round-budget gap).
         from armada_tpu.parallel.mesh import pad_nodes
         from armada_tpu.parallel.multihost import resolve_solver
 
@@ -153,17 +190,20 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None):
 
         def solve_round(dev):
             return sharded(pad_nodes(dev, sharded.n_shards))
-    elif budget_s:
-        # Round-deadline mode: the chunked budget-aware driver
-        # (solver/kernel.solve_round) — wall clock checkpointed between
-        # fill loops, partial placement on truncation.
-        def solve_round(dev):
-            return _single_solve(dev, budget_s=budget_s)
     else:
-        solve_round = _single_solve
+        # Single-device driver: hot-window compaction when the round is
+        # big enough to pay (solver/hotwindow.py), the budget-aware
+        # chunked pass 1 when BENCH_ROUND_BUDGET_S is set, the fused
+        # program otherwise — all in solver/kernel.solve_round. The
+        # min-slots floor is 0: window choice is per bench config.
+        def solve_round(dev):
+            return _single_solve(
+                dev, budget_s=budget_s, window=hot_window or None,
+                window_min_slots=0,
+            )
 
     t_setup = time.time()
-    inputs = build_inputs(n_jobs, n_nodes, burst=burst)
+    inputs = build_inputs(n_jobs, n_nodes, burst=burst, fill_window=fill_window)
     inc = IncrementalRound(*inputs)
     setup_s = time.time() - t_setup
 
@@ -229,6 +269,10 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None):
         }
         if "truncated" in out:
             timings["round_truncated"] = bool(out["truncated"])
+        if "profile" in out:
+            # Per-segment solve profile (setup / pass-1 / gather /
+            # finish wall clock + loop mix) from the host-driven driver.
+            timings["segments"] = out["profile"]
         return timings, out
 
     first, out = warm_cycle(out)  # may pay a shape-change compile once
@@ -279,6 +323,38 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None):
 
 
 def main():
+    """Run the bench matrix; ALWAYS prints one final JSON line.
+
+    Success: the full result with ok=true. Any exception: ok=false with
+    the error and whatever sub-results completed, so downstream parsers
+    get a parseable (if partial) artifact instead of a truncated tail."""
+    partial = {}
+    try:
+        result = _run_matrix(partial)
+        result["ok"] = True
+    # KeyboardInterrupt/SystemExit propagate: a deliberate cancellation
+    # is not a bench failure and must not mint an ok=false artifact.
+    except Exception as e:  # noqa: BLE001 - the artifact IS the report
+        import traceback
+
+        result = {
+            "metric": "warm_cycle_end_to_end",
+            "value": None,
+            "unit": "s",
+            "ok": False,
+            "error": f"{e.__class__.__name__}: {e}",
+            "traceback": traceback.format_exc().splitlines()[-6:],
+            # Sub-results that completed before the failure (e.g. the
+            # tracking run when the burst config OOMs) stay usable by
+            # tools/bench_trend.py / bench_gate.py.
+            "extra": partial,
+        }
+    print(json.dumps(result), flush=True)
+    if not result["ok"]:
+        raise SystemExit(1)
+
+
+def _run_matrix(partial=None):
     # BENCH_MESH spellings: "8" (1D, 8 chips on one host) or "2x4"
     # (two-level hosts x chips hierarchy, parallel/multihost.py).
     raw_mesh = os.environ.get("BENCH_MESH", "0").lower()
@@ -315,6 +391,8 @@ def main():
         k in os.environ
         for k in ("BENCH_JOBS", "BENCH_NODES", "BENCH_QUEUES", "BENCH_RUNNING")
     )
+    if partial is None:
+        partial = {}
     tracking = burst50k = None
     if custom:
         n_jobs = int(os.environ.get("BENCH_JOBS", 100_000))
@@ -322,13 +400,21 @@ def main():
         flag = run_config(n_jobs, n_nodes, mesh=mesh)
     else:
         n_jobs, n_nodes = 1_000_000, 50_000
-        tracking = run_config(100_000, 5000, mesh=mesh)
+        # Like-for-like vs earlier rounds: the historical 512 fill
+        # window, no hot-window compaction (a 100k round cannot
+        # amortize the host-driven driver's fixed overhead).
+        tracking = run_config(
+            100_000, 5000, mesh=mesh, fill_window=512, hot_window=0
+        )
+        partial["tracking_100k"] = tracking
         if os.environ.get("BENCH_FLAGSHIP", "1") == "1":
             flag = run_config(n_jobs, n_nodes, mesh=mesh)
+            partial["flagship"] = flag
             if os.environ.get("BENCH_BURST50K", "1") == "1":
                 burst50k = run_config(
                     n_jobs, n_nodes, burst=50_000, mesh=mesh
                 )
+                partial["burst_50k"] = burst50k
         else:
             flag, (n_jobs, n_nodes) = tracking, (100_000, 5000)
             tracking = None
@@ -343,7 +429,7 @@ def main():
         extra["tracking_100k"] = tracking
     if burst50k is not None:
         extra["burst_50k"] = burst50k
-    result = {
+    return {
         "metric": (
             f"warm_cycle_end_to_end({n_jobs} jobs x {n_nodes} nodes, "
             f"{N_QUEUES} queues, burst-limited, {platform})"
@@ -353,7 +439,6 @@ def main():
         "vs_baseline": round(5.0 / cycle_s, 2),
         "extra": extra,
     }
-    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,138 @@
+"""Bench artifact tooling: the regression gate and the trend printer
+must parse every historical BENCH_r*.json schema (r03 has no `parsed`
+block; burst_50k only exists from r05) and gate correctly on fixtures.
+Fast tier-1 smoke — no bench run, fixture dicts only."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from bench_gate import (  # noqa: E402
+    extract_metrics,
+    gate,
+    latest_baseline,
+    parse_artifact,
+)
+
+NEW_SCHEMA = {
+    "rc": 0,
+    "tail": "...",
+    "parsed": {
+        "value": 3.0,
+        "extra": {
+            "solve_s": 2.3,
+            "tracking_100k": {"cycle_s": 0.27},
+            "burst_50k": {"cycle_s": 18.7},
+        },
+    },
+}
+# r03-era artifact: no parsed block, the bench line only in the tail.
+OLD_SCHEMA = {
+    "rc": 0,
+    "tail": 'noise\n{"value": 1.2, "extra": {"solve_s": 0.9}}\n',
+}
+BROKEN = {"rc": 1, "tail": "Traceback (most recent call last)..."}
+FAILED_RUN = {"rc": 1, "parsed": {"ok": False, "error": "boom"}}
+
+
+def test_parse_both_schemas():
+    new = extract_metrics(parse_artifact(NEW_SCHEMA))
+    assert new == {"warm": 3.0, "tracking": 0.27, "burst": 18.7}
+    old = extract_metrics(parse_artifact(OLD_SCHEMA))
+    assert old == {"warm": 1.2, "tracking": None, "burst": None}
+    assert extract_metrics(parse_artifact(BROKEN)) == {
+        "warm": None, "tracking": None, "burst": None,
+    }
+    # ok=false parsed blocks are failures, not baselines.
+    assert parse_artifact(FAILED_RUN) is None
+
+
+def test_gate_passes_within_threshold_and_fails_on_regression():
+    base = {"warm": 3.0, "tracking": 0.27, "burst": 18.7}
+    ok_current = {"warm": 3.2, "tracking": 0.28, "burst": 9.0}
+    regressions, notes = gate(ok_current, base, threshold=1.15)
+    assert not regressions and len(notes) == 3
+    bad_current = {"warm": 4.0, "tracking": 0.28, "burst": 9.0}
+    regressions, _ = gate(bad_current, base, threshold=1.15)
+    assert len(regressions) == 1 and regressions[0].startswith("warm")
+
+
+def test_gate_skips_incomparable_metrics():
+    """Old baselines without burst numbers must not gate burst."""
+    base = {"warm": 1.2, "tracking": None, "burst": None}
+    regressions, notes = gate(
+        {"warm": 1.0, "tracking": 0.3, "burst": 50.0}, base, 1.15
+    )
+    assert not regressions
+    assert sum("not comparable" in n for n in notes) == 2
+
+
+def test_gate_cli_fails_on_crashed_bench(tmp_path):
+    """A crashed bench (ok=false, value null) must NOT read as a green
+    gate: no extractable current-side metric exits 2."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(NEW_SCHEMA))
+    current = tmp_path / "current.json"
+    current.write_text(json.dumps({"value": None, "ok": False, "error": "boom"}))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+            "--current", str(current), "--baseline-dir", str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def test_latest_baseline_skips_unusable(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(OLD_SCHEMA))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(NEW_SCHEMA))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(BROKEN))
+    (tmp_path / "BENCH_r04.json").write_text("not json at all")
+    path, metrics = latest_baseline(str(tmp_path))
+    assert path.endswith("BENCH_r02.json")
+    assert metrics["burst"] == 18.7
+
+
+def test_gate_cli_on_fixtures(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(NEW_SCHEMA))
+    current = tmp_path / "current.json"
+    current.write_text(json.dumps({"value": 2.9, "extra": {
+        "tracking_100k": {"cycle_s": 0.26}, "burst_50k": {"cycle_s": 8.0}}}))
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+        "--current", str(current), "--baseline-dir", str(tmp_path),
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    current.write_text(json.dumps({"value": 99.0, "extra": {}}))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    assert proc.returncode == 1 and "REGRESSION warm" in proc.stdout
+
+
+def test_trend_handles_every_checked_in_artifact(tmp_path):
+    """tools/bench_trend.py prints a row per artifact without crashing —
+    on fixtures covering all schema generations AND on the repo's real
+    BENCH_r*.json set."""
+    for name, doc in (
+        ("BENCH_r01.json", OLD_SCHEMA),
+        ("BENCH_r02.json", BROKEN),
+        ("BENCH_r03.json", NEW_SCHEMA),
+    ):
+        (tmp_path / name).write_text(json.dumps(doc))
+    for target in (str(tmp_path), REPO):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "bench_trend.py"),
+                "--dir", target,
+            ],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "BENCH_r01.json" in proc.stdout

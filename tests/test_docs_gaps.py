@@ -84,12 +84,26 @@ def _feature_exists_sharded_budget() -> bool:
         return "budget" in f.read()
 
 
+def _feature_exists_window_autotune() -> bool:
+    # Closed once something adapts the hot-window size at runtime: an
+    # autotune hook in the solver or a config switch for it.
+    solver = os.path.join(REPO, "armada_tpu", "solver")
+    for name in os.listdir(solver):
+        if name.endswith(".py"):
+            with open(os.path.join(solver, name)) as f:
+                if "autotune" in f.read().lower():
+                    return True
+    with open(os.path.join(REPO, "armada_tpu", "core", "config.py")) as f:
+        return "autotune" in f.read().lower()
+
+
 DETECTORS = {
     "kubernetes": _feature_exists_kubernetes,
     "lookout-ui-surface": _feature_exists_rich_lookout_ui,
     "cpp-client-grpc": _feature_exists_cpp_grpc,
     "scala-client": _feature_exists_scala_client,
     "sharded-round-budget": _feature_exists_sharded_budget,
+    "hot-window-autotune": _feature_exists_window_autotune,
 }
 
 

@@ -1,0 +1,301 @@
+"""Hot-window compaction parity (solver/hotwindow.py).
+
+The compacted pass-1 solve — gather the per-queue head windows plus the
+active evicted set, run the unchanged kernel machinery over the window
+axes, scatter back at chunk boundaries, re-gather on REWINDOW — must be
+BIT-EXACT with the uncompacted kernel. Windows here are deliberately
+tiny (2-4 slots against multi-hundred-slot rounds) so every round is
+forced through many mid-pass rewindows, and the loop STREAM (not just
+the final placement) is asserted against the uncompacted segmented
+driver, which shares its loop accounting.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import Gang, JobSpec, NodeSpec, QueueSpec, RunningJob
+from armada_tpu.snapshot.round import build_round_snapshot
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round, prep_device_round
+
+ARRAY_KEYS = (
+    "assigned_node",
+    "scheduled_priority",
+    "scheduled_mask",
+    "preempted_mask",
+    "fair_share",
+    "demand_capped_fair_share",
+    "uncapped_fair_share",
+    "spot_price",
+)
+
+
+def _assert_bit_exact(a, b, label):
+    for k in ARRAY_KEYS:
+        assert np.array_equal(
+            np.asarray(a[k]), np.asarray(b[k]), equal_nan=True
+        ), f"{label}: {k} diverges"
+
+
+def _dev(fast_fill=False, n_running=24, n_jobs=120, bw=4, gangs=3):
+    """A round exercising eviction + fair preemption (one hog queue over
+    fair share), gangs with and without uniformity constraints, and
+    enough queued stream per queue that a tiny window must rewindow."""
+    cfg = SchedulingConfig(
+        priority_classes={
+            "high": PriorityClass("high", 30000, preemptible=False),
+            "low": PriorityClass("low", 1000, preemptible=True),
+        },
+        default_priority_class="low",
+        protected_fraction_of_fair_share=0.5,
+        batch_fill_window=bw,
+        enable_fast_fill=fast_fill,
+    )
+    nodes = [
+        NodeSpec(
+            id=f"n{i:03d}",
+            pool="default",
+            total_resources={"cpu": "16", "memory": "64Gi"},
+            labels={"zone": "a" if i % 2 else "b"},
+        )
+        for i in range(10)
+    ]
+    queues = [QueueSpec(f"q{i}", 1.0) for i in range(3)]
+    rng = np.random.default_rng(1)
+    queued = [
+        JobSpec(
+            id=f"j{i:04d}",
+            queue=f"q{i % 3}",
+            requests={"cpu": str(int(rng.choice([1, 2])))},
+            submitted_ts=float(i),
+        )
+        for i in range(n_jobs)
+    ]
+    for k in range(gangs):
+        gg = Gang(
+            id=f"gg{k}",
+            cardinality=4,
+            node_uniformity_label="zone" if k % 2 else "",
+        )
+        for m in range(4):
+            queued.append(
+                JobSpec(
+                    id=f"gang{k}-{m}",
+                    queue="q1",
+                    requests={"cpu": "2"},
+                    submitted_ts=200.0 + k * 4 + m,
+                    gang=gg,
+                )
+            )
+    running = [
+        RunningJob(
+            job=JobSpec(
+                id=f"r{i:04d}",
+                queue="q0",
+                priority_class="low",
+                requests={"cpu": "2"},
+                submitted_ts=float(-100 + i),
+            ),
+            node_id=f"n{i % 10:03d}",
+            scheduled_at_priority=1000,
+        )
+        for i in range(n_running)
+    ]
+    snap = build_round_snapshot(cfg, "default", nodes, queues, running, queued)
+    return pad_device_round(prep_device_round(snap))
+
+
+@pytest.mark.parametrize(
+    "fast_fill",
+    [
+        # Serial fill rides the slow marker: its windowed fill_step path
+        # is already covered tier-1 by the mixed-fleet scenarios.
+        pytest.param(False, marks=pytest.mark.slow),
+        True,
+    ],
+)
+def test_compacted_solve_bit_exact_with_forced_rewindows(fast_fill):
+    """Evictions, fair preemption, gangs, uniformity search — compacted
+    vs fused placement is bit-exact, and the pass-1 loop stream matches
+    the uncompacted segmented driver exactly (same num_loops) across
+    many forced mid-pass rewindows."""
+    dev = _dev(fast_fill=fast_fill)
+    fused = solve_round(dev)
+    segmented = solve_round(dev, profile=True)  # host-driven, uncompacted
+    windowed = solve_round(dev, window=4, window_min_slots=0)
+    prof = windowed["profile"]
+    assert prof["compacted"], "window did not engage — test is vacuous"
+    assert prof["rewindows"] >= 1, "no mid-pass rewindow exercised"
+    _assert_bit_exact(fused, windowed, f"fast_fill={fast_fill}")
+    _assert_bit_exact(fused, segmented, f"segmented fast_fill={fast_fill}")
+    # Identical decision STREAM, not just identical outcomes: the
+    # untruncated host-driven drivers run loop-for-loop with the fused
+    # program (the rescue pass only compiles into truncated rounds).
+    assert int(fused["num_loops"]) == int(windowed["num_loops"])
+    assert int(fused["num_loops"]) == int(segmented["num_loops"])
+    assert not segmented["profile"]["compacted"]
+
+
+def test_window_smaller_than_one_gang():
+    """A 4-wide gang is ONE slot, so a 1-slot window must still place it
+    atomically (the window is in slots, not jobs) — including the
+    uniformity-search gangs."""
+    dev = _dev(fast_fill=False, bw=1)
+    fused = solve_round(dev)
+    windowed = solve_round(dev, window=1, window_min_slots=0)
+    assert windowed["profile"]["compacted"]
+    _assert_bit_exact(fused, windowed, "window<gang")
+
+
+def test_compacted_solve_bit_exact_mixed_fleet_and_market():
+    """The dryrun scenario set: away pools (borrowed tainted nodes) and
+    a market round (price-ordered, fill disabled). batch_fill_window is
+    shrunk so the tiny window genuinely truncates the streams."""
+    from armada_tpu.parallel.scenarios import mixed_fleet_rounds
+
+    for label, snap in mixed_fleet_rounds(24, 96):
+        snap = dataclasses.replace(
+            snap, config=dataclasses.replace(snap.config, batch_fill_window=4)
+        )
+        dev = pad_device_round(prep_device_round(snap))
+        fused = solve_round(dev)
+        windowed = solve_round(dev, window=2, window_min_slots=0)
+        assert windowed["profile"]["compacted"], label
+        _assert_bit_exact(fused, windowed, label)
+
+
+def test_budgeted_window_truncates_to_prefix():
+    """Round budget + compaction compose: a generous budget matches the
+    unbudgeted solve, a tiny budget commits a prefix of it."""
+    dev = _dev(fast_fill=True)
+    full = solve_round(dev, window=4, window_min_slots=0)
+    generous = solve_round(dev, window=4, window_min_slots=0, budget_s=120.0)
+    assert not generous["truncated"]
+    _assert_bit_exact(full, generous, "generous budget")
+    cut = solve_round(dev, window=4, window_min_slots=0, budget_s=1e-6)
+    assert cut["truncated"]
+    placed = np.flatnonzero(cut["scheduled_mask"])
+    assert np.asarray(full["scheduled_mask"])[placed].all()
+    assert (
+        np.asarray(cut["assigned_node"])[placed]
+        == np.asarray(full["assigned_node"])[placed]
+    ).all()
+
+
+def test_tiny_round_disengages():
+    """A round the window axes cannot shrink runs the fused program
+    (profile reports compaction off; result identical)."""
+    dev = _dev(fast_fill=False, n_jobs=12, n_running=0, gangs=0, bw=0)
+    fused = solve_round(dev)
+    windowed = solve_round(dev, window=2048, window_min_slots=0, profile=True)
+    assert not windowed["profile"]["compacted"]
+    _assert_bit_exact(fused, windowed, "disengaged")
+
+
+def test_sim_differential_compacted_vs_uncompacted():
+    """Whole-simulator differential (the test_sim_differential.py
+    pattern, seed 0): the same workload driven through the service loop
+    with compaction forced on (tiny fill window + tiny hot window, so
+    real rounds gather/rewindow) must produce the identical fleet
+    history — states, placements, preemptions — as compaction off."""
+    from armada_tpu.sim import (
+        ClusterSpec,
+        JobTemplate,
+        QueueSpecSim,
+        Simulator,
+        WorkloadSpec,
+    )
+    from armada_tpu.sim.simulator import NodeTemplate, ShiftedExponential
+
+    def run(hot_window):
+        cfg = SchedulingConfig(
+            priority_classes={
+                "high": PriorityClass("high", 30000, preemptible=False),
+                "low": PriorityClass("low", 1000, preemptible=True),
+            },
+            default_priority_class="low",
+            protected_fraction_of_fair_share=0.5,
+            batch_fill_window=2,
+            hot_window_slots=hot_window,
+            hot_window_min_slots=0,
+        )
+        sim = Simulator(
+            [
+                ClusterSpec(
+                    "c1",
+                    node_templates=(
+                        NodeTemplate(count=6, cpu="16", memory="64Gi"),
+                    ),
+                )
+            ],
+            WorkloadSpec(
+                queues=(
+                    QueueSpecSim(
+                        "steady",
+                        job_templates=(
+                            JobTemplate(
+                                id="long", number=24, cpu="2", memory="4Gi",
+                                runtime=ShiftedExponential(minimum=200.0),
+                            ),
+                        ),
+                    ),
+                    QueueSpecSim(
+                        "bursty",
+                        job_templates=(
+                            JobTemplate(
+                                id="gangs", number=8, cpu="4", memory="4Gi",
+                                gang_cardinality=4, submit_time=50.0,
+                                runtime=ShiftedExponential(minimum=100.0),
+                            ),
+                        ),
+                    ),
+                )
+            ),
+            config=cfg,
+            backend="kernel",
+            seed=0,
+            max_time=1500.0,
+        )
+        res = sim.run()
+        return {
+            "states": {k: v.value for k, v in res.events_by_job.items()},
+            "placements": res.placements,
+            "preemptions": res.preemptions,
+            "finished": res.finished_jobs,
+        }
+
+    off = run(0)
+    on = run(2)
+    assert off == on
+    assert off["finished"] > 0
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+def test_compacted_solve_matches_two_level_mesh():
+    """Compaction composes with the node-sharded solve: the job/slot
+    axes it compacts were never sharded, so the compacted single-device
+    result must equal the 2x4 HierarchicalDist mesh solve bit-for-bit
+    (both equal the fused single-device kernel)."""
+    from armada_tpu.parallel.mesh import pad_nodes
+    from armada_tpu.parallel.multihost import (
+        hierarchical_sharded_solve,
+        make_host_mesh,
+    )
+    from armada_tpu.parallel.scenarios import home_away_round
+
+    snap = home_away_round(24, 64)
+    snap = dataclasses.replace(
+        snap, config=dataclasses.replace(snap.config, batch_fill_window=2)
+    )
+    dev = pad_nodes(pad_device_round(prep_device_round(snap)), 8)
+    windowed = solve_round(dev, window=2, window_min_slots=0)
+    assert windowed["profile"]["compacted"]
+    mesh = hierarchical_sharded_solve(make_host_mesh(2, 4))(dev)
+    _assert_bit_exact(windowed, mesh, "2x4-vs-window")

@@ -1,0 +1,156 @@
+"""Bench regression gate: compare a current bench result against the
+latest checked-in BENCH_r*.json artifact and fail on regression.
+
+    python tools/bench_gate.py --current out.json [--threshold 1.15]
+    python bench.py | tail -1 | python tools/bench_gate.py --current -
+
+Accepts either shape on both sides (the artifact schema drifted across
+rounds — BENCH_r03.json has no `parsed` block at all):
+
+  - a driver artifact: {"rc": ..., "tail": ..., "parsed": {...}}
+    (falls back to parsing the LAST JSON line of `tail` when `parsed`
+    is absent);
+  - a raw bench stdout line: {"metric": ..., "value": ..., "extra": ...}.
+
+Gated metrics: the warm headline cycle, tracking_100k and burst_50k
+cycle times. A metric regresses when current > baseline * threshold; a
+metric missing on either side is reported but never gates (old
+artifacts predate burst_50k). Exits 1 on regression, 2 when no
+comparable baseline exists, 0 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_artifact(doc: dict) -> dict | None:
+    """The bench result dict out of either schema, or None."""
+    if not isinstance(doc, dict):
+        return None
+    if "value" in doc or "extra" in doc:  # raw bench stdout line
+        return doc
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and parsed.get("ok", True):
+        return parsed
+    # Old schema (r03 and earlier): no parsed block — recover the bench
+    # line from the captured tail.
+    tail = doc.get("tail") or ""
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def extract_metrics(result: dict | None) -> dict:
+    """{"warm": s|None, "tracking": s|None, "burst": s|None} from a
+    bench result dict; tolerant of every historical shape."""
+    out = {"warm": None, "tracking": None, "burst": None}
+    if not isinstance(result, dict):
+        return out
+    if isinstance(result.get("value"), (int, float)):
+        out["warm"] = float(result["value"])
+    extra = result.get("extra")
+    if isinstance(extra, dict):
+        for key, name in (("tracking_100k", "tracking"), ("burst_50k", "burst")):
+            sub = extra.get(key)
+            if isinstance(sub, dict) and isinstance(
+                sub.get("cycle_s"), (int, float)
+            ):
+                out[name] = float(sub["cycle_s"])
+    return out
+
+
+def gate(current: dict, baseline: dict, threshold: float) -> tuple[list, list]:
+    """(regressions, notes) comparing extract_metrics dicts. A metric
+    regresses when current > baseline * threshold."""
+    regressions, notes = [], []
+    for name in ("warm", "tracking", "burst"):
+        cur, base = current.get(name), baseline.get(name)
+        if cur is None or base is None:
+            notes.append(f"{name}: not comparable (current={cur} baseline={base})")
+            continue
+        limit = base * threshold
+        line = f"{name}: current {cur:.4f}s vs baseline {base:.4f}s (limit {limit:.4f}s)"
+        if cur > limit:
+            regressions.append(line)
+        else:
+            notes.append("OK " + line)
+    return regressions, notes
+
+
+def _round_num(path: str) -> int:
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def latest_baseline(search_dir: str) -> tuple[str | None, dict]:
+    """Newest BENCH_r*.json with extractable metrics (skips artifacts
+    no schema recovers anything from rather than gating on nothing)."""
+    for path in sorted(
+        glob.glob(os.path.join(search_dir, "BENCH_r*.json")),
+        key=_round_num,
+        reverse=True,
+    ):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        metrics = extract_metrics(parse_artifact(doc))
+        if any(v is not None for v in metrics.values()):
+            return path, metrics
+    return None, {"warm": None, "tracking": None, "burst": None}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--current", required=True,
+                    help="bench result JSON file, or - for stdin")
+    ap.add_argument("--baseline-dir", default=REPO)
+    ap.add_argument("--threshold", type=float, default=1.15,
+                    help="regression factor (1.15 = allow 15%% slower)")
+    args = ap.parse_args(argv)
+
+    raw = (
+        sys.stdin.read()
+        if args.current == "-"
+        else open(args.current).read()
+    )
+    try:
+        doc = json.loads(raw)
+    except json.JSONDecodeError as e:
+        print(f"bench_gate: current result is not JSON: {e}")
+        return 2
+    current = extract_metrics(parse_artifact(doc))
+    if all(v is None for v in current.values()):
+        # A crashed/failed bench (ok=false, value null) must not read as
+        # a green gate: nothing on the current side is comparable.
+        print("bench_gate: current result carries no extractable metrics")
+        return 2
+    base_path, baseline = latest_baseline(args.baseline_dir)
+    if base_path is None:
+        print("bench_gate: no usable BENCH_r*.json baseline found")
+        return 2
+    regressions, notes = gate(current, baseline, args.threshold)
+    print(f"baseline: {os.path.basename(base_path)}")
+    for line in notes:
+        print(line)
+    for line in regressions:
+        print("REGRESSION " + line)
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,73 @@
+"""Print the bench trajectory across every checked-in BENCH_r*.json.
+
+    python tools/bench_trend.py [--dir REPO]
+
+One row per artifact — warm headline, tracking_100k and burst_50k cycle
+times plus the solve share of the warm cycle — tolerant of every
+historical schema (BENCH_r03.json has no `parsed` block; burst_50k only
+exists from r05): a metric an artifact does not carry prints as "-",
+and an artifact nothing can be recovered from still gets a row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bench_gate import REPO, _round_num, extract_metrics, parse_artifact  # noqa: E402
+
+
+def _fmt(v) -> str:
+    return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+
+def rows(search_dir: str) -> list[dict]:
+    out = []
+    for path in sorted(
+        glob.glob(os.path.join(search_dir, "BENCH_r*.json")), key=_round_num
+    ):
+        row = {"round": os.path.basename(path), "warm": None,
+               "tracking": None, "burst": None, "solve": None}
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            row["round"] += " (unreadable)"
+            out.append(row)
+            continue
+        result = parse_artifact(doc)
+        row.update(extract_metrics(result))
+        extra = result.get("extra") if isinstance(result, dict) else None
+        if isinstance(extra, dict) and isinstance(
+            extra.get("solve_s"), (int, float)
+        ):
+            row["solve"] = float(extra["solve_s"])
+        out.append(row)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default=REPO)
+    args = ap.parse_args(argv)
+    table = rows(args.dir)
+    if not table:
+        print("no BENCH_r*.json artifacts found")
+        return 1
+    header = f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} {'burst_s':>8}"
+    print(header)
+    print("-" * len(header))
+    for r in table:
+        print(
+            f"{r['round']:<18} {_fmt(r['warm']):>8} {_fmt(r['solve']):>8} "
+            f"{_fmt(r['tracking']):>10} {_fmt(r['burst']):>8}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
