@@ -281,6 +281,29 @@ class SchedulerMetrics:
             ["pool"],
             registry=r,
         )
+        # ---- flight recorder (armada_tpu/trace): capture volume from
+        # the attached TraceRecorder, and the divergence counter the
+        # replayer bumps when a re-solved round drifts from the
+        # recorded decision stream (kinds: placement / loop_stream /
+        # profile_regression).
+        self.trace_rounds_recorded = Counter(
+            "scheduler_trace_rounds_recorded",
+            "Scheduling rounds appended to the flight-recorder bundle",
+            ["pool"],
+            registry=r,
+        )
+        self.trace_bytes_written = Counter(
+            "scheduler_trace_bytes_written",
+            "Bytes appended to the flight-recorder .atrace bundle",
+            registry=r,
+        )
+        self.trace_replay_divergences = Counter(
+            "scheduler_trace_replay_divergences",
+            "Replayed-round divergences from the recorded decision "
+            "stream, by classification",
+            ["kind"],
+            registry=r,
+        )
         self.anti_entropy_resolutions = Counter(
             "scheduler_anti_entropy_resolutions_total",
             "Run resolutions produced by post-partition ExecutorSync "

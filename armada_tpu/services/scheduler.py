@@ -129,6 +129,10 @@ class SchedulerService:
 
         self.reports = SchedulingReportsRepository()
         self.metrics = None  # set via attach_metrics
+        # Flight recorder (armada_tpu/trace): when attached, every pool
+        # round's solver inputs + decision stream append to an .atrace
+        # bundle for deterministic replay (attach_trace_recorder).
+        self.trace_recorder = None
         # Round-deadline guardrail (maxSchedulingDuration): wall-clock
         # deadline for the current cycle's rounds, armed per cycle in
         # _schedule_all_pools; pools share the budget in round order.
@@ -185,6 +189,44 @@ class SchedulerService:
 
     def attach_metrics(self, metrics):
         self.metrics = metrics
+
+    def attach_trace_recorder(self, recorder):
+        """Start appending every scheduling round (padded DeviceRound
+        inputs + decision stream) to the recorder's .atrace bundle."""
+        self.trace_recorder = recorder
+
+    def _trace_round(self, snap, dev, decisions, *, solver, truncated,
+                     solve_s, profile=None):
+        """Append one solved round to the attached flight recorder.
+        Recording must never fail the round: errors log and drop."""
+        rec = self.trace_recorder
+        try:
+            ids = None
+            if rec.wants_ids(snap.num_jobs):
+                ids = {
+                    "jobs": list(snap.job_ids),
+                    "nodes": list(snap.node_ids),
+                    "queues": list(snap.queue_names),
+                }
+            rec.record_round(
+                pool=snap.pool,
+                dev=dev,
+                decisions=decisions,
+                num_jobs=snap.num_jobs,
+                num_queues=snap.num_queues,
+                config=snap.config,
+                cycle=self.cycle_count,
+                solver=solver,
+                truncated=truncated,
+                profile=profile,
+                solve_s=solve_s,
+                ids=ids,
+                metrics=self.metrics,
+            )
+        except Exception as e:  # noqa: BLE001 - advisory path
+            self.log_.with_fields(pool=snap.pool).error(
+                "flight-recorder append failed: %r", e
+            )
 
     def _observe_transition(self, txn, event):
         """State-transition metrics with time-in-previous-state
@@ -1541,12 +1583,13 @@ class SchedulerService:
                 dev = pad_device_round(inc.device_round())
             else:
                 dev = pad_device_round(prep_device_round(snap))
+            import time as _t
+
+            t_solve = _t.monotonic()
             if self.mesh is not None:
                 # The sharded solve is one fused program; the budget is
                 # enforced between pools only (chunked pass 1 is
                 # single-device for now).
-                import time as _t
-
                 from ..parallel.mesh import pad_nodes
 
                 run = self._resolve_sharded_run()
@@ -1560,6 +1603,9 @@ class SchedulerService:
                 out = dict(out)
                 out["truncated"] = False
                 self._note_mesh_metrics(snap.pool, _t.monotonic() - t0)
+                shape = run.mesh_shape
+                hosts, chips = shape if len(shape) == 2 else (1, shape[0])
+                solver_info = {"backend": "kernel", "mesh": f"{hosts}x{chips}"}
             else:
                 out = solve_round(
                     dev,
@@ -1567,7 +1613,23 @@ class SchedulerService:
                     window=snap.config.hot_window_slots or None,
                     window_min_slots=snap.config.hot_window_min_slots,
                 )
+                solver_info = {
+                    "backend": "kernel",
+                    "mesh": None,
+                    "window": int(snap.config.hot_window_slots or 0),
+                    "budget": bool(budget_s),
+                }
             truncated = bool(out.get("truncated", False))
+            if self.trace_recorder is not None:
+                self._trace_round(
+                    snap,
+                    dev,
+                    out,
+                    solver=solver_info,
+                    truncated=truncated,
+                    solve_s=round(_t.monotonic() - t_solve, 4),
+                    profile=out.get("profile"),
+                )
             self._note_solve_profile(snap.pool, out.get("profile"))
             J, Q = snap.num_jobs, snap.num_queues
             return {
@@ -1589,7 +1651,40 @@ class SchedulerService:
             }
         from ..solver.reference import ReferenceSolver
 
+        import time as _t
+
+        t_solve = _t.monotonic()
         res = ReferenceSolver(snap).solve(budget_s=budget_s)
+        if self.trace_recorder is not None:
+            # Oracle-backed services record too: the bundle's DeviceRound
+            # is the same device prep the kernel would see, so a trace
+            # captured here replays any candidate kernel against the
+            # oracle's decisions (spot price + loop accounting are
+            # oracle-specific and skipped by the replay compare).
+            import numpy as np
+
+            from ..solver.kernel_prep import pad_device_round, prep_device_round
+
+            self._trace_round(
+                snap,
+                pad_device_round(prep_device_round(snap)),
+                {
+                    "assigned_node": res.assigned_node,
+                    "scheduled_priority": res.scheduled_priority,
+                    "scheduled_mask": res.scheduled_mask,
+                    "preempted_mask": res.preempted_mask,
+                    "fair_share": res.fair_share,
+                    "demand_capped_fair_share": res.demand_capped_fair_share,
+                    "uncapped_fair_share": res.uncapped_fair_share,
+                    "spot_price": np.float64(
+                        np.nan if res.spot_price is None else res.spot_price
+                    ),
+                    "num_loops": int(res.num_loops),
+                },
+                solver={"backend": "oracle"},
+                truncated=bool(res.truncated),
+                solve_s=round(_t.monotonic() - t_solve, 4),
+            )
         return {
             "spot_price": res.spot_price,
             "assigned_node": res.assigned_node,

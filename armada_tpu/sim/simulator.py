@@ -120,6 +120,10 @@ class Simulator:
         max_time: float = 7 * 24 * 3600.0,
         fault_plan=None,
         data_dir: str | None = None,
+        # Flight recorder (armada_tpu/trace): append every scheduling
+        # round's DeviceRound inputs + decision stream to this .atrace
+        # bundle, seeds included, for deterministic replay.
+        trace_path: str | None = None,
     ):
         self.config = config or SchedulingConfig()
         self.rng = np.random.default_rng(seed)
@@ -168,6 +172,21 @@ class Simulator:
             snapshot_mode=snapshot_mode, is_leader=is_leader,
         )
         self.submit = SubmitService(self.config, self.log, scheduler=self.scheduler)
+        self.trace_recorder = None
+        if trace_path is not None:
+            from ..trace import TraceRecorder
+
+            seeds = {"workload_seed": seed}
+            if fault_plan is not None:
+                seeds["fault_plan_seed"] = getattr(fault_plan, "seed", None)
+            self.trace_recorder = TraceRecorder(
+                trace_path,
+                source="sim",
+                config=self.config,
+                seeds=seeds,
+                meta={"backend": backend, "cycle_interval": cycle_interval},
+            )
+            self.scheduler.attach_trace_recorder(self.trace_recorder)
 
         self._runtimes: dict[str, float] = {}
         self.executors: list[FakeExecutor] = []
@@ -240,6 +259,13 @@ class Simulator:
         self._pending_submissions.sort(key=lambda x: x[0])
 
     def run(self) -> SimResult:
+        try:
+            return self._run()
+        finally:
+            if self.trace_recorder is not None:
+                self.trace_recorder.close()
+
+    def _run(self) -> SimResult:
         t = 0.0
         cycles = 0
         preemptions = 0

@@ -1,0 +1,229 @@
+"""The flight recorder: append scheduler rounds to an `.atrace` bundle.
+
+One recorder = one bundle. The first write emits a header carrying the
+trace format version, the `utils/platform.py` target signature (host
+CPU features + effective XLA target + x64 mode — a replay on a foreign
+host refuses instead of silently comparing against decisions compiled
+for different arithmetic), the scheduling-config fingerprint, and any
+RNG / fault-plan seeds the caller supplies. Every round record then
+holds the bit-exact padded DeviceRound the solver saw plus the decision
+stream it produced.
+
+Hooked into `services/scheduler.py` (attach_trace_recorder),
+`sim/simulator.py` (trace_path=...) and `bench.py` (BENCH_TRACE=...).
+Recording must never fail a round: callers wrap record_round in a
+try/except and log.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+
+import numpy as np
+
+from .codec import FORMAT, encode_device_round, encode_field, encode_record
+
+# The decision-stream keys a replayed solve is compared against. These
+# are exactly solver/kernel.solve_round's array outputs: masks/nodes/
+# priorities over the padded job axis, shares over the padded queue
+# axis, the market spot price, and the pass-1 loop count (the loop
+# stream — host-driven and fused drivers run loop-for-loop identical,
+# tests/test_hotwindow.py).
+DECISION_KEYS = (
+    "assigned_node",
+    "scheduled_priority",
+    "scheduled_mask",
+    "preempted_mask",
+    "fair_share",
+    "demand_capped_fair_share",
+    "uncapped_fair_share",
+    "spot_price",
+    "num_loops",
+)
+
+# Above this many jobs the id vocabularies are dropped by default: a 1M
+# job round's id lists dwarf the tensor payload and replay equality is
+# index-based anyway (ids only prettify divergence reports).
+AUTO_IDS_MAX_JOBS = 100_000
+
+
+def config_fingerprint(config) -> str:
+    """Stable digest of the scheduling config. repr of the (frozen)
+    dataclass tree is deterministic per process and content-addressed
+    enough for replay bookkeeping — the round inputs themselves are
+    recorded bit-exactly, the fingerprint only labels them."""
+    return hashlib.sha256(repr(config).encode()).hexdigest()[:16]
+
+
+def _target_signature() -> dict:
+    from ..utils import platform as plat
+
+    try:
+        import jax
+
+        x64 = bool(jax.config.jax_enable_x64)
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        x64 = False
+    return {
+        "host_cpu": plat.host_cpu_signature(),
+        "xla": plat.xla_target_signature(),
+        "x64": x64,
+    }
+
+
+class TraceRecorder:
+    def __init__(
+        self,
+        path: str,
+        *,
+        source: str = "scheduler",
+        config=None,
+        seeds: dict | None = None,
+        meta: dict | None = None,
+        record_ids: bool | None = None,
+        max_rounds: int | None = None,
+        append: bool = False,
+    ):
+        """One recorder = one bundle = one recording session. By default
+        an existing file at `path` is REPLACED at the first write: a
+        bundle holds exactly one header, and appending a new session
+        under an old header would replay later rounds against the wrong
+        target signature / config fingerprint / seeds (load_trace
+        refuses multi-header bundles). append=True is for resuming the
+        same logical session only."""
+        self.path = path
+        self.source = source
+        self.seeds = dict(seeds or {})
+        self.meta = dict(meta or {})
+        self.record_ids = record_ids
+        self.max_rounds = max_rounds
+        self.rounds_recorded = 0
+        self.bytes_written = 0
+        self._config = config
+        self._append = append
+        self._header_written = False
+        self._fh = None
+
+    # -- plumbing ------------------------------------------------------
+
+    def _open(self):
+        if self._fh is None:
+            parent = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(parent, exist_ok=True)
+            self._fh = open(self.path, "a" if self._append else "w")
+        return self._fh
+
+    def _write(self, record: dict, metrics=None, pool: str | None = None) -> int:
+        line = encode_record(record) + "\n"
+        fh = self._open()
+        fh.write(line)
+        fh.flush()
+        n = len(line.encode())
+        self.bytes_written += n
+        if metrics is not None and getattr(metrics, "registry", None) is not None:
+            metrics.trace_bytes_written.inc(n)
+            if record.get("kind") == "round":
+                metrics.trace_rounds_recorded.labels(pool=pool or "").inc()
+        return n
+
+    def _write_header(self, config, metrics=None):
+        cfg = config if config is not None else self._config
+        summary = {}
+        if cfg is not None:
+            summary = {
+                "market_driven": bool(cfg.market_driven),
+                "batch_fill_window": int(cfg.batch_fill_window),
+                "hot_window_slots": int(getattr(cfg, "hot_window_slots", 0)),
+                "priority_classes": sorted(cfg.priority_classes),
+            }
+        self._write(
+            {
+                "kind": "header",
+                "format": FORMAT,
+                "created": time.time(),
+                "source": self.source,
+                "target": _target_signature(),
+                "config_fingerprint": (
+                    config_fingerprint(cfg) if cfg is not None else None
+                ),
+                "config_summary": summary,
+                "seeds": self.seeds,
+                "meta": self.meta,
+            },
+            metrics=metrics,
+        )
+        self._header_written = True
+
+    def wants_ids(self, num_jobs: int) -> bool:
+        """Whether this bundle records id vocabularies at this round
+        size — callers can skip BUILDING the O(J) id lists entirely."""
+        if self.record_ids is None:
+            return num_jobs <= AUTO_IDS_MAX_JOBS
+        return bool(self.record_ids)
+
+    # -- recording -----------------------------------------------------
+
+    def record_round(
+        self,
+        *,
+        pool: str,
+        dev,
+        decisions: dict,
+        num_jobs: int,
+        num_queues: int,
+        config=None,
+        cycle: int | None = None,
+        now: float | None = None,
+        solver: dict | None = None,
+        truncated: bool = False,
+        profile: dict | None = None,
+        solve_s: float | None = None,
+        ids: dict | None = None,
+        metrics=None,
+    ) -> bool:
+        """Append one round. `dev` is the padded DeviceRound exactly as
+        handed to the solver; `decisions` the solver's output dict (any
+        superset of DECISION_KEYS — extra keys like `profile` are taken
+        from the explicit kwargs instead). Returns False when the
+        bundle's max_rounds cap is reached."""
+        if self.max_rounds is not None and self.rounds_recorded >= self.max_rounds:
+            return False
+        if not self._header_written:
+            self._write_header(config, metrics=metrics)
+        record_ids = self.wants_ids(num_jobs)
+        record = {
+            "kind": "round",
+            "i": self.rounds_recorded,
+            "pool": pool,
+            "cycle": cycle,
+            "now": now,
+            "num_jobs": int(num_jobs),
+            "num_queues": int(num_queues),
+            "solver": dict(solver or {}),
+            "truncated": bool(truncated),
+            "profile": dict(profile) if profile else None,
+            "solve_s": solve_s,
+            "dev": encode_device_round(dev),
+            "decisions": {
+                k: encode_field(np.asarray(decisions[k]))
+                for k in DECISION_KEYS
+                if k in decisions
+            },
+            "ids": dict(ids) if (ids and record_ids) else None,
+        }
+        self._write(record, metrics=metrics, pool=pool)
+        self.rounds_recorded += 1
+        return True
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,362 @@
+"""Deterministic replay of recorded rounds + divergence classification.
+
+The replayer reconstructs a recorded round's DeviceRound bit-for-bit
+and re-solves it under any solver spec:
+
+  - "LOCAL"          — the fused single-device kernel (solve_round)
+  - "hotwindow[:W]"  — hot-window compacted pass 1 (window_min_slots=0
+                       so compaction engages at any scale it can shrink)
+  - "2x4", "8", 4    — the node-sharded mesh solve (parallel/multihost
+                       resolve_solver spellings; 2D = HierarchicalDist)
+
+and compares the decision stream against the recorded one. Divergences
+classify as:
+
+  placement          — any decision array differs (placements, evictions,
+                       priorities, fair shares, spot price)
+  loop_stream        — decisions identical but the pass-1 loop count
+                       differs (the solver took a different path to the
+                       same answer; kernel-recorded rounds only)
+  profile_regression — replayed solve wall clock beyond
+                       `profile_threshold` x the recorded solve time
+                       (opt-in: wall clocks only compare on one host)
+
+Replay REFUSES a bundle whose target signature (host CPU features,
+effective XLA target, x64 mode) differs from this process unless
+explicitly overridden: silently diffing against decisions produced by
+different arithmetic reports phantom divergences. The override is sound
+for x64-recorded traces — exact int64/float64 decisions are
+host-independent (the oracle-parity contract) — which is why committed
+fixture traces replay everywhere; an x64-mode mismatch always refuses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from .codec import TraceFormatError, decode_device_round, decode_field, decode_record
+from .recorder import DECISION_KEYS
+
+_JOB_KEYS = (
+    "assigned_node",
+    "scheduled_priority",
+    "scheduled_mask",
+    "preempted_mask",
+)
+_QUEUE_KEYS = ("fair_share", "demand_capped_fair_share", "uncapped_fair_share")
+
+PERTURBATIONS = ("tiebreak",)
+
+
+class TraceTargetMismatch(RuntimeError):
+    """The bundle was recorded on a different target than this process."""
+
+
+@dataclasses.dataclass
+class RoundRecord:
+    raw: dict
+
+    def __getitem__(self, key):
+        return self.raw[key]
+
+    @property
+    def pool(self) -> str:
+        return self.raw.get("pool", "")
+
+    @property
+    def num_jobs(self) -> int:
+        return int(self.raw["num_jobs"])
+
+    @property
+    def num_queues(self) -> int:
+        return int(self.raw["num_queues"])
+
+    @property
+    def truncated(self) -> bool:
+        return bool(self.raw.get("truncated", False))
+
+    @property
+    def backend(self) -> str:
+        return str(self.raw.get("solver", {}).get("backend", "kernel"))
+
+    def device_round(self):
+        return decode_device_round(self.raw["dev"])
+
+    def decisions(self) -> dict:
+        return {k: decode_field(v) for k, v in self.raw["decisions"].items()}
+
+
+@dataclasses.dataclass
+class Trace:
+    path: str
+    header: dict
+    rounds: list
+
+
+def load_trace(path: str) -> Trace:
+    header = None
+    rounds = []
+    with open(path) as f:
+        for ln, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            record = decode_record(line)
+            kind = record.get("kind")
+            if kind == "header":
+                if header is not None:
+                    raise TraceFormatError(
+                        f"{path}:{ln + 1}: second header record — the file "
+                        "holds multiple recording sessions appended "
+                        "together; later rounds would compare against the "
+                        "first session's target/config/seeds. Re-record to "
+                        "a fresh bundle (TraceRecorder replaces existing "
+                        "files unless append=True)."
+                    )
+                header = record
+                continue
+            if kind == "round":
+                rounds.append(RoundRecord(record))
+                continue
+            raise TraceFormatError(f"{path}:{ln + 1}: unknown record kind {kind!r}")
+    if header is None:
+        raise TraceFormatError(f"{path}: no header record — not an .atrace bundle")
+    return Trace(path=path, header=header, rounds=rounds)
+
+
+def check_target(header: dict, *, allow_foreign: bool = False) -> None:
+    """Raise TraceTargetMismatch unless this process matches the
+    bundle's recorded target signature (see module docstring)."""
+    from .recorder import _target_signature
+
+    recorded = header.get("target") or {}
+    current = _target_signature()
+    if bool(recorded.get("x64")) != current["x64"]:
+        raise TraceTargetMismatch(
+            f"trace was recorded with x64={recorded.get('x64')} but this "
+            f"process runs x64={current['x64']}: decision arithmetic "
+            "differs (approximate float32 vs exact float64 costs) — "
+            "replay comparison would be meaningless. Re-record, or match "
+            "ARMADA_TPU_X64."
+        )
+    mismatched = [
+        k
+        for k in ("host_cpu", "xla")
+        if recorded.get(k) is not None and recorded.get(k) != current[k]
+    ]
+    if mismatched and not allow_foreign:
+        detail = ", ".join(
+            f"{k}: recorded {recorded.get(k)!r} != current {current[k]!r}"
+            for k in mismatched
+        )
+        raise TraceTargetMismatch(
+            f"trace target signature mismatch ({detail}): this bundle was "
+            "recorded on a different host/toolchain, so its compiled "
+            "decisions may be stale for this target. Pass "
+            "allow_foreign=True (--allow-foreign) only for x64-recorded "
+            "traces, whose exact decisions are host-independent."
+        )
+
+
+def replay_solver(spec, header: dict | None = None):
+    """(label, dev -> numpy output dict) for one solver spec string."""
+    from ..solver.kernel import solve_round
+
+    label = str(spec)
+    if label.upper() == "LOCAL":
+        return "LOCAL", lambda dev: solve_round(dev)
+    if label.lower().startswith("hotwindow"):
+        if ":" in label:
+            window = int(label.split(":", 1)[1])
+        else:
+            summary = (header or {}).get("config_summary") or {}
+            window = int(summary.get("hot_window_slots") or 0) or max(
+                4, 2 * int(summary.get("batch_fill_window") or 2)
+            )
+        return (
+            f"hotwindow:{window}",
+            lambda dev: solve_round(dev, window=window, window_min_slots=0),
+        )
+    # Anything else is a mesh spelling ("2x4", "8", an int, a tuple).
+    from ..parallel.mesh import pad_nodes
+    from ..parallel.multihost import resolve_solver
+
+    run = resolve_solver(int(spec) if isinstance(spec, str) and spec.isdigit() else spec)
+
+    def solve(dev):
+        out = run(pad_nodes(dev, run.n_shards))
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    return f"mesh:{label}", solve
+
+
+def perturb_device_round(dev, kind: str):
+    """A deliberately-buggy candidate kernel, simulated at the input
+    seam: 'tiebreak' reverses the node-id tie-break ranking, the kind
+    of silent ordering regression the replay gate exists to catch.
+    Placements move wherever two nodes tie on the best-fit key."""
+    if kind == "tiebreak":
+        rank = np.asarray(dev.node_id_rank)
+        return dataclasses.replace(
+            dev, node_id_rank=(rank.max() - rank).astype(rank.dtype)
+        )
+    raise ValueError(f"unknown perturbation {kind!r}; have {PERTURBATIONS}")
+
+
+def _first_diffs(a, b, limit=4):
+    idx = np.flatnonzero(np.asarray(a) != np.asarray(b))[:limit]
+    return [int(i) for i in idx]
+
+
+def compare_round(rec: RoundRecord, out: dict, *, compare_loops: bool | None = None):
+    """Divergences between a recorded round's decisions and a replayed
+    output dict. Arrays compare on the UNPADDED prefix (the recorded
+    round and the replay may pad differently); returns a list of
+    {kind, key, detail} dicts, empty when bit-exact."""
+    recorded = rec.decisions()
+    J, Q = rec.num_jobs, rec.num_queues
+    oracle = rec.backend == "oracle"
+    if compare_loops is None:
+        # Oracle loop accounting is not the kernel's (the parity suite
+        # excludes num_loops); only kernel-recorded rounds pin the stream.
+        compare_loops = not oracle
+    ids = rec.raw.get("ids") or {}
+    job_ids = ids.get("jobs")
+    divergences = []
+    for key in _JOB_KEYS + _QUEUE_KEYS:
+        if key not in recorded or key not in out:
+            continue
+        n = J if key in _JOB_KEYS else Q
+        want = np.asarray(recorded[key])[:n]
+        got = np.asarray(out[key])[:n]
+        if not np.array_equal(want, got, equal_nan=True):
+            where = _first_diffs(want, got)
+            detail = f"{key}[:{n}] differs at indices {where}"
+            if key in _JOB_KEYS and job_ids:
+                names = [job_ids[i] for i in where if i < len(job_ids)]
+                detail += f" (jobs {names})"
+            divergences.append({"kind": "placement", "key": key, "detail": detail})
+    if "spot_price" in recorded and "spot_price" in out and not oracle:
+        want = float(np.asarray(recorded["spot_price"]))
+        got = float(np.asarray(out["spot_price"]))
+        if not (want == got or (np.isnan(want) and np.isnan(got))):
+            divergences.append(
+                {
+                    "kind": "placement",
+                    "key": "spot_price",
+                    "detail": f"spot_price {want} != {got}",
+                }
+            )
+    if compare_loops and "num_loops" in recorded and "num_loops" in out:
+        want = int(np.asarray(recorded["num_loops"]))
+        got = int(np.asarray(out["num_loops"]))
+        if want != got:
+            same = "identical decisions via " if not divergences else ""
+            divergences.append(
+                {
+                    "kind": "loop_stream",
+                    "key": "num_loops",
+                    "detail": f"{same}a different loop stream: recorded "
+                    f"{want} loops, replayed {got}",
+                }
+            )
+    return divergences
+
+
+def replay_trace(
+    trace: Trace,
+    *,
+    solvers=("LOCAL",),
+    max_rounds: int | None = None,
+    profile_threshold: float | None = None,
+    perturb: str | None = None,
+    allow_foreign: bool = False,
+    metrics=None,
+    log=None,
+) -> dict:
+    """Replay a bundle under each solver spec; returns the gate report:
+
+      {"rounds": n_replayed, "skipped": n, "results": [...],
+       "divergences": {kind: count}, "ok": bool}
+
+    Truncated rounds are skipped (a budget-cut decision stream is a
+    wall-clock-dependent prefix, not a deterministic target). `metrics`
+    (services.metrics.SchedulerMetrics) gets the replay-divergence
+    counter bumped per divergence kind."""
+    check_target(trace.header, allow_foreign=allow_foreign)
+    resolved = [replay_solver(s, trace.header) for s in solvers]
+    results = []
+    by_kind: dict[str, int] = {}
+    replayed = skipped = 0
+    for rec in trace.rounds:
+        if max_rounds is not None and replayed >= max_rounds:
+            break
+        if rec.truncated:
+            skipped += 1
+            if log:
+                log(f"round {rec.raw.get('i')}: skipped (budget-truncated)")
+            continue
+        dev = rec.device_round()
+        if perturb:
+            dev = perturb_device_round(dev, perturb)
+        replayed += 1
+        for label, solve in resolved:
+            t0 = time.monotonic()
+            out = solve(dev)
+            replay_s = time.monotonic() - t0
+            divergences = compare_round(rec, out)
+            if profile_threshold and rec.raw.get("solve_s") is not None:
+                # The first solve of a (solver, shape) pays JIT compile;
+                # the recorded solve_s is a warm steady-state number. Time
+                # a SECOND solve so the comparison is warm-vs-warm, and
+                # floor tiny recorded times so sub-ms rounds don't trip
+                # on scheduler noise.
+                t1 = time.monotonic()
+                solve(dev)
+                warm_s = time.monotonic() - t1
+                base = max(float(rec.raw["solve_s"]), 0.01)
+                if warm_s > base * profile_threshold:
+                    divergences.append(
+                        {
+                            "kind": "profile_regression",
+                            "key": "solve_s",
+                            "detail": f"warm replay {warm_s:.3f}s > "
+                            f"{profile_threshold:.2f}x recorded "
+                            f"{base:.3f}s",
+                        }
+                    )
+            for d in divergences:
+                by_kind[d["kind"]] = by_kind.get(d["kind"], 0) + 1
+                if (
+                    metrics is not None
+                    and getattr(metrics, "registry", None) is not None
+                ):
+                    metrics.trace_replay_divergences.labels(kind=d["kind"]).inc()
+            results.append(
+                {
+                    "round": rec.raw.get("i"),
+                    "pool": rec.pool,
+                    "solver": label,
+                    "replay_s": round(replay_s, 4),
+                    "divergences": divergences,
+                }
+            )
+            if log:
+                status = "OK" if not divergences else (
+                    "DIVERGED " + "; ".join(d["detail"] for d in divergences)
+                )
+                log(
+                    f"round {rec.raw.get('i')} pool={rec.pool} "
+                    f"solver={label}: {status}"
+                )
+    return {
+        "trace": trace.path,
+        "rounds": replayed,
+        "skipped": skipped,
+        "results": results,
+        "divergences": by_kind,
+        "ok": not by_kind,
+    }

@@ -158,7 +158,7 @@ def _put(dev):
 
 
 def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
-               hot_window=None):
+               hot_window=None, trace_path=None):
     """Cold build, one shape-settling warm cycle, then >=5 measured warm
     cycles (BENCH_WARM_CYCLES): the headline is the MEDIAN cycle with its
     spread (min/max + IQR), not a single sample — a single warm cycle can
@@ -306,8 +306,41 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
                 else None
             ),
         }
+    trace_extra = {}
+    if trace_path:
+        # Flight recorder (armada_tpu/trace): one extra, UNMEASURED warm
+        # cycle appended to the .atrace bundle — the recorded round is
+        # exactly the steady-state solve the headline median describes,
+        # replayable forever by tools/replay_gate.py. The recorder
+        # replaces any stale bundle at the path.
+        from armada_tpu.trace import TraceRecorder
+
+        snap = inc.snapshot()
+        dev_np = pad_device_round(inc.device_round())
+        out_rec = solve_round(_put(dev_np))
+        solver_info = {"backend": "kernel", "mesh": str(mesh) if mesh else None,
+                       "window": hot_window or 0, "budget": bool(budget_s)}
+        with TraceRecorder(
+            trace_path, source="bench", config=inputs[0],
+            seeds={"workload_seed": 0},
+            meta={"n_jobs": n_jobs, "n_nodes": n_nodes, "burst": burst},
+        ) as rec:
+            rec.record_round(
+                pool="default", dev=dev_np,
+                decisions={k: np.asarray(v) for k, v in out_rec.items()
+                           if k not in ("profile", "truncated")},
+                num_jobs=snap.num_jobs, num_queues=snap.num_queues,
+                config=inputs[0], solver=solver_info,
+                truncated=bool(out_rec.get("truncated", False)),
+                profile=out_rec.get("profile"),
+            )
+        # Marker consumed by tools/bench_trend.py — set ONLY when THIS
+        # run recorded the bundle (a stale file from an earlier revision
+        # must not be advertised as this artifact's trace).
+        trace_extra["trace_path"] = os.path.basename(trace_path)
     return {
         **mesh_extra,
+        **trace_extra,
         "cycle_s": round(median, 4),
         **{k: v for k, v in rep.items() if k != "cycle_s"},
         "warm_cycles_measured": len(times),
@@ -393,11 +426,19 @@ def _run_matrix(partial=None):
     )
     if partial is None:
         partial = {}
+    # Flight recorder (off by default): BENCH_TRACE=<path> (or =1 for
+    # BENCH_trace.atrace next to the BENCH_r*.json artifacts) records the
+    # flagship/custom config's warm cycle to an .atrace bundle.
+    trace_path = os.environ.get("BENCH_TRACE") or None
+    if trace_path == "1":
+        trace_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_trace.atrace"
+        )
     tracking = burst50k = None
     if custom:
         n_jobs = int(os.environ.get("BENCH_JOBS", 100_000))
         n_nodes = int(os.environ.get("BENCH_NODES", 5000))
-        flag = run_config(n_jobs, n_nodes, mesh=mesh)
+        flag = run_config(n_jobs, n_nodes, mesh=mesh, trace_path=trace_path)
     else:
         n_jobs, n_nodes = 1_000_000, 50_000
         # Like-for-like vs earlier rounds: the historical 512 fill
@@ -408,7 +449,7 @@ def _run_matrix(partial=None):
         )
         partial["tracking_100k"] = tracking
         if os.environ.get("BENCH_FLAGSHIP", "1") == "1":
-            flag = run_config(n_jobs, n_nodes, mesh=mesh)
+            flag = run_config(n_jobs, n_nodes, mesh=mesh, trace_path=trace_path)
             partial["flagship"] = flag
             if os.environ.get("BENCH_BURST50K", "1") == "1":
                 burst50k = run_config(

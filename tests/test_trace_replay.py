@@ -1,0 +1,368 @@
+"""Flight recorder: round-trace capture + deterministic replay
+(armada_tpu/trace, tools/replay_gate.py).
+
+A round recorded from a whole-sim service run must replay bit-exactly
+— identical placements, evictions, fair shares, and pass-1 loop stream
+— under the fused LOCAL kernel, the "2x4" HierarchicalDist mesh, and
+hot-window compaction (the 2x4 and hot-window variants ride the slow
+marker; LOCAL is tier-1). The recorder must not lose the mixed-fleet
+fields (away pools, market bids, gang membership) the dryrun scenarios
+exercise, a bundle recorded on a foreign target must refuse to replay,
+and the replay gate must trip on a deliberately perturbed kernel while
+passing HEAD.
+
+Regenerate the committed fixture after a DeviceRound schema change:
+
+    python tests/test_trace_replay.py --regen
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import (
+    DeviceRound,
+    pad_device_round,
+    prep_device_round,
+)
+from armada_tpu.trace import (
+    TraceRecorder,
+    TraceTargetMismatch,
+    check_target,
+    load_trace,
+    replay_trace,
+)
+from armada_tpu.trace.codec import decode_record, encode_record
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "sim_steady.atrace")
+
+
+def record_sim_trace(path, *, backend="kernel", max_rounds=None, max_time=1500.0):
+    """A small whole-sim service run (the test_sim_differential pattern:
+    steady queue + gang bursts on a shared fleet) with the flight
+    recorder attached; returns the SimResult."""
+    from armada_tpu.sim import (
+        ClusterSpec,
+        JobTemplate,
+        QueueSpecSim,
+        Simulator,
+        WorkloadSpec,
+    )
+    from armada_tpu.sim.simulator import NodeTemplate, ShiftedExponential
+
+    cfg = SchedulingConfig(
+        priority_classes={
+            "high": PriorityClass("high", 30000, preemptible=False),
+            "low": PriorityClass("low", 1000, preemptible=True),
+        },
+        default_priority_class="low",
+        protected_fraction_of_fair_share=0.5,
+        batch_fill_window=2,
+    )
+    sim = Simulator(
+        [
+            ClusterSpec(
+                "c1",
+                node_templates=(NodeTemplate(count=6, cpu="16", memory="64Gi"),),
+            )
+        ],
+        WorkloadSpec(
+            queues=(
+                QueueSpecSim(
+                    "steady",
+                    job_templates=(
+                        JobTemplate(
+                            id="long", number=24, cpu="2", memory="4Gi",
+                            runtime=ShiftedExponential(minimum=200.0),
+                        ),
+                    ),
+                ),
+                QueueSpecSim(
+                    "bursty",
+                    job_templates=(
+                        JobTemplate(
+                            id="gangs", number=8, cpu="4", memory="4Gi",
+                            gang_cardinality=4, submit_time=50.0,
+                            runtime=ShiftedExponential(minimum=100.0),
+                        ),
+                    ),
+                ),
+            )
+        ),
+        config=cfg,
+        backend=backend,
+        seed=0,
+        max_time=max_time,
+        trace_path=path,
+    )
+    if max_rounds is not None:
+        sim.trace_recorder.max_rounds = max_rounds
+    return sim.run()
+
+
+@pytest.fixture(scope="module")
+def sim_trace(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("trace") / "sim.atrace")
+    res = record_sim_trace(path)
+    assert res.finished_jobs > 0
+    return path
+
+
+def test_fixture_replays_bit_exact_local():
+    """Tier-1 smoke on the COMMITTED fixture bundle: bit-exact LOCAL
+    replay of real recorded rounds. allow_foreign is sound here — the
+    header pins x64 exact-cost mode, whose int64/float64 decisions are
+    host-independent (check_target still refuses any x64 mismatch)."""
+    assert os.path.getsize(FIXTURE) < 100_000, "fixture must stay tiny"
+    trace = load_trace(FIXTURE)
+    assert trace.header["target"]["x64"] is True
+    assert trace.header["source"] == "sim"
+    report = replay_trace(trace, solvers=("LOCAL",), allow_foreign=True)
+    assert report["ok"], report["divergences"]
+    assert report["rounds"] >= 2
+    # Non-vacuous: the fixture carries a round that actually scheduled.
+    scheduled = sum(
+        int(np.asarray(r.decisions()["scheduled_mask"]).sum())
+        for r in trace.rounds
+    )
+    assert scheduled > 0
+
+
+def test_recorded_sim_rounds_replay_bit_exact_local(sim_trace):
+    """Rounds recorded live from the service loop replay bit-exactly
+    under the fused LOCAL kernel — placements, evictions, shares, AND
+    the pass-1 loop stream (compare_round checks num_loops)."""
+    trace = load_trace(sim_trace)
+    assert len(trace.rounds) >= 5
+    assert trace.header["seeds"] == {"workload_seed": 0}
+    assert trace.header["config_fingerprint"]
+    report = replay_trace(trace, solvers=("LOCAL",))
+    assert report["ok"], report["divergences"]
+    assert report["rounds"] == len(trace.rounds)
+
+
+@pytest.mark.slow
+def test_recorded_sim_rounds_replay_two_level_mesh(sim_trace):
+    """The same recorded rounds re-solved on the 2x4 HierarchicalDist
+    mesh must match the recorded decision stream bit-for-bit."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    trace = load_trace(sim_trace)
+    report = replay_trace(trace, solvers=("2x4",), max_rounds=4)
+    assert report["ok"], report["divergences"]
+    assert report["rounds"] == 4
+
+
+@pytest.mark.slow
+def test_recorded_sim_rounds_replay_hot_window(sim_trace):
+    """Hot-window compaction on vs off over recorded rounds: both must
+    reproduce the recorded decisions and loop stream."""
+    trace = load_trace(sim_trace)
+    report = replay_trace(trace, solvers=("hotwindow:2", "LOCAL"), max_rounds=4)
+    assert report["ok"], report["divergences"]
+
+
+def test_oracle_recorded_trace_replays_on_kernel(tmp_path):
+    """Record once from an ORACLE-backed service, replay on the kernel:
+    the bundle's DeviceRound is the same device prep, so the kernel's
+    decisions must match the oracle's (the parity contract, now via the
+    trace seam; oracle spot/loop accounting is skipped by the compare)."""
+    path = str(tmp_path / "oracle.atrace")
+    record_sim_trace(path, backend="oracle", max_rounds=6, max_time=400.0)
+    trace = load_trace(path)
+    assert trace.rounds and trace.rounds[0].backend == "oracle"
+    report = replay_trace(trace, solvers=("LOCAL",))
+    assert report["ok"], report["divergences"]
+
+
+def test_mixed_fleet_fields_round_trip(tmp_path):
+    """Away/market pools and gang membership survive a recorded trace:
+    every DeviceRound field decodes bit-identical for the dryrun
+    scenario set (home/away borrowed tainted nodes, market bids, mixed
+    2/4/8 gangs), and the decoded round re-solves bit-exactly."""
+    from armada_tpu.parallel.scenarios import mixed_fleet_rounds
+
+    for label, snap in mixed_fleet_rounds(24, 96):
+        snap = dataclasses.replace(
+            snap, config=dataclasses.replace(snap.config, batch_fill_window=4)
+        )
+        dev = pad_device_round(prep_device_round(snap))
+        out = solve_round(dev)
+        path = str(tmp_path / f"{label}.atrace")
+        with TraceRecorder(path, source="test", config=snap.config) as rec:
+            rec.record_round(
+                pool=snap.pool, dev=dev, decisions=out,
+                num_jobs=snap.num_jobs, num_queues=snap.num_queues,
+                config=snap.config, solver={"backend": "kernel"},
+                ids={"jobs": list(snap.job_ids)},
+            )
+        trace = load_trace(path)
+        dev2 = trace.rounds[0].device_round()
+        for f in dataclasses.fields(DeviceRound):
+            a, b = getattr(dev, f.name), getattr(dev2, f.name)
+            if isinstance(a, tuple) or not hasattr(a, "shape"):
+                assert a == b, f"{label}: {f.name} changed type/value"
+            else:
+                a, b = np.asarray(a), np.asarray(b)
+                assert a.dtype == b.dtype, f"{label}: {f.name} dtype drifted"
+                assert np.array_equal(a, b), f"{label}: {f.name} not bit-exact"
+        # The mixed-fleet signal is actually present in the bundle.
+        if label == "home_away":
+            assert bool(dev2.has_away)
+            assert np.asarray(dev2.pc_away_count).any(), "away tables lost"
+            assert np.asarray(dev2.node_taints).any(), "borrowed gpu taints lost"
+            assert (np.asarray(dev2.slot_count) > 1).any(), "gangs lost"
+        if label == "market":
+            assert bool(dev2.market_driven)
+            assert np.asarray(dev2.job_bid).any(), "market bids lost"
+        report = replay_trace(trace, solvers=("LOCAL",))
+        assert report["ok"], (label, report["divergences"])
+
+
+def test_foreign_target_refuses_with_clear_error(tmp_path, sim_trace):
+    """A bundle whose target signature names a different host must
+    refuse to replay (stale-compiled decisions), and an x64-mode
+    mismatch must refuse even with allow_foreign."""
+    trace = load_trace(sim_trace)
+    foreign = dict(trace.header)
+    foreign["target"] = dict(foreign["target"], host_cpu="feedface00000000")
+    with pytest.raises(TraceTargetMismatch, match="different host"):
+        check_target(foreign)
+    check_target(foreign, allow_foreign=True)  # explicit override works
+    wrong_mode = dict(trace.header)
+    wrong_mode["target"] = dict(wrong_mode["target"], x64=False)
+    with pytest.raises(TraceTargetMismatch, match="x64"):
+        check_target(wrong_mode, allow_foreign=True)
+    # End to end through a tampered file: replay_trace refuses too.
+    tampered = tmp_path / "foreign.atrace"
+    with open(sim_trace) as f, open(tampered, "w") as out:
+        for i, line in enumerate(f):
+            record = decode_record(line)
+            if i == 0:
+                record["target"]["host_cpu"] = "feedface00000000"
+            out.write(encode_record(record) + "\n")
+    with pytest.raises(TraceTargetMismatch):
+        replay_trace(load_trace(str(tampered)))
+
+
+def test_second_recording_session_replaces_bundle(tmp_path, sim_trace):
+    """A new recorder on an existing path REPLACES the bundle (one
+    bundle = one session); a hand-concatenated multi-session file is
+    refused rather than replayed under the first session's header."""
+    from armada_tpu.trace import TraceFormatError
+
+    rec0 = load_trace(sim_trace).rounds[0]
+    path = tmp_path / "b.atrace"
+    for _ in range(2):
+        with TraceRecorder(str(path), source="test") as recorder:
+            recorder.record_round(
+                pool="default", dev=rec0.device_round(),
+                decisions=rec0.decisions(), num_jobs=rec0.num_jobs,
+                num_queues=rec0.num_queues,
+            )
+    assert len(load_trace(str(path)).rounds) == 1  # replaced, not merged
+    doubled = tmp_path / "doubled.atrace"
+    doubled.write_text(path.read_text() * 2)
+    with pytest.raises(TraceFormatError, match="second header"):
+        load_trace(str(doubled))
+
+
+def test_truncated_rounds_are_skipped(sim_trace):
+    """A budget-truncated decision stream is a wall-clock-dependent
+    prefix, not a deterministic replay target: skipped, not compared."""
+    trace = load_trace(sim_trace)
+    for rec in trace.rounds:
+        rec.raw["truncated"] = True
+    report = replay_trace(trace, solvers=("LOCAL",))
+    assert report["rounds"] == 0
+    assert report["skipped"] == len(trace.rounds)
+
+
+def test_replay_divergence_metrics_counter(sim_trace):
+    """The replayer surfaces divergences through the scheduler metrics
+    registry (scheduler_trace_replay_divergences by kind), and the
+    recorder's capture counters moved during the sim recording."""
+    from armada_tpu.services.metrics import HAVE_PROMETHEUS, SchedulerMetrics
+
+    if not HAVE_PROMETHEUS:
+        pytest.skip("prometheus_client unavailable")
+    trace = load_trace(sim_trace)
+    metrics = SchedulerMetrics()
+    report = replay_trace(
+        trace, solvers=("LOCAL",), max_rounds=2, perturb="tiebreak",
+        metrics=metrics,
+    )
+    assert not report["ok"]
+    rendered = metrics.render().decode()
+    assert 'scheduler_trace_replay_divergences_total{kind="placement"}' in rendered
+    # Capture counters: re-record one decoded round with metrics attached.
+    rec0 = trace.rounds[0]
+    with TraceRecorder(os.devnull, source="test") as recorder:
+        recorder.record_round(
+            pool="default", dev=rec0.device_round(),
+            decisions=rec0.decisions(), num_jobs=rec0.num_jobs,
+            num_queues=rec0.num_queues, metrics=metrics,
+        )
+    rendered = metrics.render().decode()
+    assert 'scheduler_trace_rounds_recorded_total{pool="default"}' in rendered
+    assert "scheduler_trace_bytes_written_total" in rendered
+
+
+def test_replay_gate_cli(sim_trace, tmp_path):
+    """tools/replay_gate.py: exit 0 on HEAD, non-zero on a deliberately
+    perturbed kernel, 2 on an unusable bundle."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("BENCH_MESH", None)
+    gate = os.path.join(REPO, "tools", "replay_gate.py")
+
+    clean = subprocess.run(
+        [sys.executable, gate, sim_trace, "--max-rounds", "2", "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    summary = json.loads(clean.stdout.strip().splitlines()[-1])["summary"]
+    assert summary["ok"] and summary["rounds"] == 2
+
+    perturbed = subprocess.run(
+        [sys.executable, gate, sim_trace, "--max-rounds", "2",
+         "--perturb", "tiebreak"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900,
+    )
+    assert perturbed.returncode == 1, perturbed.stdout + perturbed.stderr
+    assert "DIVERGED" in perturbed.stdout
+
+    bogus = tmp_path / "not_a_trace.atrace"
+    bogus.write_text("this is not a bundle\n")
+    broken = subprocess.run(
+        [sys.executable, gate, str(bogus)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900,
+    )
+    assert broken.returncode == 2, broken.stdout + broken.stderr
+
+
+if __name__ == "__main__":
+    # Fixture regeneration: record a short sim trace and trim it to the
+    # first rounds so the committed bundle stays well under 100 KB.
+    if "--regen" in sys.argv:
+        os.makedirs(os.path.dirname(FIXTURE), exist_ok=True)
+        if os.path.exists(FIXTURE):
+            os.remove(FIXTURE)
+        tmp = FIXTURE + ".tmp"
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        record_sim_trace(tmp, max_rounds=6)
+        os.replace(tmp, FIXTURE)
+        print(f"wrote {FIXTURE} ({os.path.getsize(FIXTURE)} bytes)")
+    else:
+        print(__doc__)

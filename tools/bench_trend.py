@@ -31,7 +31,8 @@ def rows(search_dir: str) -> list[dict]:
         glob.glob(os.path.join(search_dir, "BENCH_r*.json")), key=_round_num
     ):
         row = {"round": os.path.basename(path), "warm": None,
-               "tracking": None, "burst": None, "solve": None}
+               "tracking": None, "burst": None, "solve": None,
+               "trace": False}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -46,6 +47,11 @@ def rows(search_dir: str) -> list[dict]:
             extra.get("solve_s"), (int, float)
         ):
             row["solve"] = float(extra["solve_s"])
+        if isinstance(extra, dict) and extra.get("trace_path"):
+            # The run recorded a flight-recorder bundle (BENCH_TRACE):
+            # this artifact's workload is replayable by
+            # tools/replay_gate.py against any candidate kernel.
+            row["trace"] = True
         out.append(row)
     return out
 
@@ -58,13 +64,17 @@ def main(argv=None) -> int:
     if not table:
         print("no BENCH_r*.json artifacts found")
         return 1
-    header = f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} {'burst_s':>8}"
+    header = (
+        f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} "
+        f"{'burst_s':>8} {'trace':>6}"
+    )
     print(header)
     print("-" * len(header))
     for r in table:
         print(
             f"{r['round']:<18} {_fmt(r['warm']):>8} {_fmt(r['solve']):>8} "
-            f"{_fmt(r['tracking']):>10} {_fmt(r['burst']):>8}"
+            f"{_fmt(r['tracking']):>10} {_fmt(r['burst']):>8} "
+            f"{'yes' if r.get('trace') else '-':>6}"
         )
     return 0
 
