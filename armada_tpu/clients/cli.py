@@ -2,7 +2,8 @@
 
 Command surface mirrors /root/reference/internal/armadactl: queue CRUD and
 cordon, submit (YAML job files), cancel, reprioritize, watch, job queries,
-scheduling reports, plus `server` to run a local control plane.
+scheduling reports, per-job journey traces (`job-trace`), plus `server`
+to run a local control plane.
 
   python -m armada_tpu.clients.cli --server 127.0.0.1:50051 <command> ...
 """
@@ -160,6 +161,18 @@ def cmd_report(args):
         print(client.job_report(args.name))
 
 
+def cmd_job_trace(args):
+    """Print one job's end-to-end journey: submit, every round it was
+    unschedulable (aggregated by reason), lease, run lifecycle — with
+    the trace id the submit RPC carried (services/job_timeline.py)."""
+    client = connect(args.server, ca_cert=args.ca_cert or None)
+    trace = client.job_trace(args.job_id)
+    if args.json:
+        _print(trace["journey"])
+    else:
+        print(trace["rendered"])
+
+
 def cmd_server(args):
     from ..core.config import SchedulingConfig
     from ..services.server import ControlPlane
@@ -198,8 +211,8 @@ def cmd_server(args):
         tls=tls,
     ).start()
     extras = []
-    if args.metrics_port:
-        extras.append(f"metrics on :{args.metrics_port}")
+    if plane.metrics_port is not None:
+        extras.append(f"metrics on :{plane.metrics_port}")
     if plane.lookout:
         extras.append(f"lookout UI on :{plane.lookout.port}")
     print(", ".join([f"serving on {plane.address}"] + extras))
@@ -285,6 +298,16 @@ def build_parser():
     rep.add_argument("kind", choices=["scheduling", "queue", "job"])
     rep.add_argument("name", nargs="?", default="")
     rep.set_defaults(fn=cmd_report)
+
+    jt = sub.add_parser(
+        "job-trace",
+        help="print a job's end-to-end journey (transitions + "
+        "unschedulable-round history + trace id)",
+    )
+    jt.add_argument("job_id")
+    jt.add_argument("--json", action="store_true",
+                    help="raw journey record instead of the rendered text")
+    jt.set_defaults(fn=cmd_job_trace)
 
     srv = sub.add_parser("server", help="run a local control plane")
     srv.add_argument("--port", type=int, default=50051)

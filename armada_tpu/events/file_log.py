@@ -225,6 +225,7 @@ class FileEventLog(EventLog):
                                     _decode_event(e) for e in payload["e"]
                                 ),
                                 user=payload.get("u", ""),
+                                traceparent=payload.get("tp", ""),
                             )
                     except (json.JSONDecodeError, KeyError, TypeError) as e:
                         bad = f"undecodable record: {e!r}"
@@ -294,6 +295,10 @@ class FileEventLog(EventLog):
                 "u": sequence.user,
                 "e": [_encode_event(e) for e in sequence.events],
             }
+            if sequence.traceparent:
+                # Written only when set: untraced publishers keep the
+                # historical record shape (and crc) byte-for-byte.
+                payload["tp"] = sequence.traceparent
             rec = {
                 "o": offset,
                 "c": zlib.crc32(json.dumps(payload).encode()),

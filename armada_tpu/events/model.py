@@ -207,10 +207,18 @@ class EventSequence:
     jobset: str
     events: tuple = ()
     user: str = ""
+    # W3C trace context of the operation that produced this batch
+    # (utils/tracing.py): submit RPCs stamp their server span here, the
+    # scheduler continues the submitting trace onto lease events, and
+    # executors echo it on run reports — so one trace id follows a job
+    # across every process boundary. "" = untraced publisher.
+    traceparent: str = ""
 
     @staticmethod
-    def of(queue: str, jobset: str, *events: Event, user: str = "") -> "EventSequence":
-        return EventSequence(queue=queue, jobset=jobset, events=tuple(events), user=user)
+    def of(queue: str, jobset: str, *events: Event, user: str = "",
+           traceparent: str = "") -> "EventSequence":
+        return EventSequence(queue=queue, jobset=jobset, events=tuple(events),
+                             user=user, traceparent=traceparent)
 
 
 def now() -> float:

@@ -178,9 +178,12 @@ class SchedulerIngester:
         # materialized settings stay current on the same cursor as the
         # jobdb — a standby catches up on its first post-failover sync.
         self.settings_handler = settings_handler
-        # Optional hook (txn, event) called BEFORE each job event applies:
-        # feeds state-transition metrics with time-in-previous-state
-        # (metrics/state_metrics.go checkpoint intervals).
+        # Optional hook (txn, event, sequence) called BEFORE each job
+        # event applies: feeds state-transition metrics with
+        # time-in-previous-state (metrics/state_metrics.go checkpoint
+        # intervals) and the per-job journey ledger
+        # (services/job_timeline.py) — the sequence carries the
+        # publisher's trace context.
         self.transition_observer = transition_observer
         self.cursor = 0
 
@@ -196,7 +199,9 @@ class SchedulerIngester:
                 for entry in entries:
                     if self.transition_observer is not None:
                         for event in entry.sequence.events:
-                            self.transition_observer(txn, event)
+                            self.transition_observer(
+                                txn, event, entry.sequence
+                            )
                     apply_entry(txn, entry, self.error_rules)
                     if self.settings_handler is not None:
                         for event in entry.sequence.events:

@@ -103,6 +103,9 @@ class _PodRuntime:
                 "queue": pod["queue"],
                 "jobset": pod["jobset"],
                 "created": now,
+                # Echo the lease's trace context so the run's lifecycle
+                # reports join the job's submit trace.
+                "traceparent": pod.get("traceparent", ""),
             }
             if pod["phase"] == "created":
                 events.append({"type": "pending", **base})
@@ -230,6 +233,7 @@ class SubprocessPodRuntime:
                 "queue": pod["queue"],
                 "jobset": pod["jobset"],
                 "created": now,
+                "traceparent": pod.get("traceparent", ""),
             }
             if pod["phase"] == "created":
                 try:
@@ -430,7 +434,17 @@ class ExecutorAgent:
         return reply
 
     def tick(self, now: float | None = None) -> dict:
+        """One agent heartbeat, traced: the tick span's context rides the
+        lease/report RPC metadata (ApiClient injects `traceparent`), so
+        the server can stitch executor exchanges into cross-process
+        traces."""
         now = time.time() if now is None else now
+        from ..utils.tracing import TRACER
+
+        with TRACER.span("executor.tick", executor=self.name):
+            return self._tick(now)
+
+    def _tick(self, now: float) -> dict:
         self._inject_faults(now)
         was_expired = self.lease_expired(now)
         if was_expired:
@@ -512,6 +526,7 @@ class ExecutorAgent:
                     "created": now,
                     "error": f"pod issue: {issue['message']}",
                     "retryable": issue["retryable"],
+                    "traceparent": pod.get("traceparent", ""),
                     # Pod-state dump for the lookout debug drilldown
                     # (job_run.debug, getjobrundebugmessage.go).
                     "debug": _pod_debug(pod, now),

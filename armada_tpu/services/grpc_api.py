@@ -29,6 +29,7 @@ from ..core.types import (
     Toleration,
 )
 from ..jobdb import JobState
+from ..utils.tracing import TRACEPARENT_HEADER, TRACER
 from .queryapi import JobFilter, Order
 
 SERVICE = "armada_tpu.Api"
@@ -56,6 +57,29 @@ def is_fenced_error(exc) -> bool:
         return callable(code) and code() == grpc.StatusCode.FAILED_PRECONDITION
     except Exception:
         return False
+
+
+def _rpc_span(method: str, context):
+    """Server span for one RPC, joined to the caller's trace via the
+    W3C `traceparent` call metadata (the server-interceptor half of
+    trace propagation; ApiClient/ProtoApiClient inject the header).
+    Handlers run inside it, so anything they publish — e.g. a submit's
+    EventSequence — can stamp the same trace id."""
+    md = {k.lower(): v for k, v in (context.invocation_metadata() or ())}
+    return TRACER.span(
+        f"rpc.{method}",
+        remote_parent=md.get(TRACEPARENT_HEADER),
+        rpc=method,
+    )
+
+
+def _inject_traceparent(metadata: list | None) -> list | None:
+    """Client-side half: append the current span's traceparent to the
+    outgoing call metadata (no-op outside any span)."""
+    tp = TRACER.current_traceparent()
+    if not tp:
+        return metadata
+    return list(metadata or []) + [(TRACEPARENT_HEADER, tp)]
 
 
 def _encode(obj) -> bytes:
@@ -504,6 +528,25 @@ class ApiServer:
             return proxied
         return {"report": self.scheduler.reports.job_report(req["job_id"])}
 
+    def _job_trace(self, req):
+        """One job's end-to-end journey (services/job_timeline.py):
+        every state transition plus the aggregated unschedulable-round
+        history and the submit trace id. Proxied to the leader like the
+        reports — the ledger describes the leader's rounds."""
+        proxied = self._proxy_to_leader("JobTrace", req)
+        if proxied is not None:
+            return proxied
+        timeline = getattr(self.scheduler, "timeline", None)
+        if timeline is None:
+            raise KeyError("job timeline not enabled")
+        doc = timeline.get(req["job_id"])
+        if doc is None:
+            raise KeyError(f"no journey recorded for job {req['job_id']!r}")
+        return {
+            "journey": doc,
+            "rendered": timeline.render(req["job_id"], doc=doc),
+        }
+
     def _set_priority_override(self, req):
         self.scheduler.set_priority_override(
             req["queue"], req.get("priority_factor")
@@ -696,6 +739,15 @@ class ApiServer:
                 and job.latest_run.executor == name
             ):
                 cancels.append({"run_id": rid, "job_id": job.id})
+        # The jobs' submit trace contexts, batched (one ledger lock for
+        # the whole reply): the agent echoes each lease's traceparent on
+        # that run's lifecycle reports so run events join the job's
+        # trace (JSON wire only; the proto LeaseResponse drops it).
+        timeline = getattr(self.scheduler, "timeline", None)
+        if timeline is not None and leases:
+            tps = timeline.traceparents([lease["job_id"] for lease in leases])
+            for lease in leases:
+                lease["traceparent"] = tps[lease["job_id"]]
         fence_of = getattr(self.scheduler, "executor_fence", None)
         config = getattr(self.scheduler, "config", None)
         return {
@@ -768,7 +820,12 @@ class ApiServer:
         for item in items:
             events = type_map[item["type"]](item)
             self.log.publish(
-                EventSequence.of(item["queue"], item["jobset"], *events)
+                EventSequence.of(
+                    item["queue"], item["jobset"], *events,
+                    # Run reports re-join the job's trace: the agent
+                    # echoes the traceparent its lease carried.
+                    traceparent=item.get("traceparent", ""),
+                )
             )
         return {}
 
@@ -1050,16 +1107,19 @@ class ApiServer:
             gate(method, req, context)
             from .chaos import CircuitOpenError
 
-            try:
-                out = fn(req) or {}
-            except KeyError as e:
-                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
-            except ValueError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            except CircuitOpenError as e:
-                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-            except FencedError as e:
-                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            with _rpc_span(method, context):
+                try:
+                    out = fn(req) or {}
+                except KeyError as e:
+                    context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                except ValueError as e:
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                except CircuitOpenError as e:
+                    context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                except FencedError as e:
+                    context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION, str(e)
+                    )
             resp_tf = resp_transforms.get(method)
             if resp_tf is not None:
                 out = resp_tf(out)
@@ -1099,6 +1159,7 @@ class ApiServer:
             "SchedulingReport": self._scheduling_report,
             "QueueReport": self._queue_report,
             "JobReport": self._job_report,
+            "JobTrace": self._job_trace,
             "GetJobLogs": self._get_logs,
             "CordonNode": self._cordon_node,
             "SetPriorityOverride": self._set_priority_override,
@@ -1188,18 +1249,21 @@ class ApiServer:
 
                     req = _decode(request)
                     gate(method, req, context)
-                    try:
-                        return _encode(fn(req))
-                    except KeyError as e:
-                        context.abort(grpc.StatusCode.NOT_FOUND, str(e))
-                    except ValueError as e:
-                        context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-                    except CircuitOpenError as e:
-                        context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-                    except FencedError as e:
-                        context.abort(
-                            grpc.StatusCode.FAILED_PRECONDITION, str(e)
-                        )
+                    with _rpc_span(method, context):
+                        try:
+                            return _encode(fn(req))
+                        except KeyError as e:
+                            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                        except ValueError as e:
+                            context.abort(
+                                grpc.StatusCode.INVALID_ARGUMENT, str(e)
+                            )
+                        except CircuitOpenError as e:
+                            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                        except FencedError as e:
+                            context.abort(
+                                grpc.StatusCode.FAILED_PRECONDITION, str(e)
+                            )
 
                 return grpc.unary_unary_rpc_method_handler(
                     unary, request_deserializer=bytes, response_serializer=bytes
@@ -1268,7 +1332,12 @@ class ApiClient:
             request_serializer=bytes,
             response_deserializer=bytes,
         )
-        return _decode(fn(_encode(request), metadata=self._metadata or None))
+        return _decode(
+            fn(
+                _encode(request),
+                metadata=_inject_traceparent(self._metadata) or None,
+            )
+        )
 
     def submit_jobs(self, queue, jobset, jobs: list[dict]):
         return self._call(
@@ -1348,6 +1417,11 @@ class ApiClient:
     def job_report(self, job_id):
         return self._call("JobReport", {"job_id": job_id})["report"]
 
+    def job_trace(self, job_id):
+        """The job's end-to-end journey: {"journey": <dict>, "rendered":
+        <text timeline>} (services/job_timeline.py)."""
+        return self._call("JobTrace", {"job_id": job_id})
+
     def set_priority_override(self, queue, priority_factor):
         self._call(
             "SetPriorityOverride",
@@ -1381,7 +1455,7 @@ class ApiClient:
                 {"queue": queue, "jobset": jobset, "from_offset": from_offset,
                  "watch": watch}
             ),
-            metadata=self._metadata or None,
+            metadata=_inject_traceparent(self._metadata) or None,
         )
         for msg in stream:
             yield _decode(msg)
@@ -1433,7 +1507,9 @@ class ProtoApiClient:
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=resp_type.FromString,
         )
-        return fn(request, metadata=self._metadata or None)
+        return fn(
+            request, metadata=_inject_traceparent(self._metadata) or None
+        )
 
     def submit_jobs(self, queue: str, jobset: str, items) -> list[str]:
         from ..proto import armada_pb2 as pb

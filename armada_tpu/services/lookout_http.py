@@ -18,6 +18,7 @@ view, scheduling report.
   GET /api/errors
   GET /api/logs/<job_id>?tail=N   (binoculars log fetch, when wired)
   GET /api/runs/<run_id>/error|debug|termination
+  GET /api/jobtrace/<job_id>     (job journey: transitions + reasons)
   GET /api/details/<job_id>      (row + runs incl. debug)
   GET /api/job/<id>              (spec + runs)
   GET /                          (the UI)
@@ -319,6 +320,18 @@ class LookoutHttpServer:
                                    404)
                         return
                     self._json({"job_id": job_id, "lines": lines})
+                elif parsed.path.startswith("/api/jobtrace/"):
+                    # Job journey (services/job_timeline.py): transitions
+                    # + aggregated unschedulable-round history + trace id.
+                    # Local view, like every lookout read (a follower's
+                    # ledger lacks round reasons — the leader runs the
+                    # rounds; the gRPC JobTrace method leader-proxies).
+                    job_id = parsed.path.rsplit("/", 1)[1]
+                    trace = outer.query.job_trace(job_id)
+                    if trace is None:
+                        self._json({"error": "no journey recorded"}, 404)
+                    else:
+                        self._json(trace)
                 elif parsed.path.startswith("/api/details/"):
                     job_id = parsed.path.rsplit("/", 1)[1]
                     details = outer.query.job_details(job_id)

@@ -304,6 +304,29 @@ class SchedulerMetrics:
             ["kind"],
             registry=r,
         )
+        # ---- job-journey surface (services/job_timeline.py): how long
+        # jobs wait and WHY — per-decision attribution instead of only
+        # aggregate shares.
+        self.job_rounds_to_schedule = Histogram(
+            "scheduler_job_rounds_to_schedule",
+            "Scheduling rounds from submission through lease, per leased "
+            "job (1 = leased in its first round)",
+            buckets=(1, 2, 3, 5, 8, 13, 21, 34, 55, 89, 144, 233),
+            registry=r,
+        )
+        self.job_queue_wait = Histogram(
+            "scheduler_job_queue_wait_seconds",
+            "Submission-to-lease wall clock per leased job, by queue",
+            ["queue"],
+            buckets=(0.1, 1, 5, 15, 60, 300, 1800, 7200, 86400),
+            registry=r,
+        )
+        self.unschedulable_reason = Counter(
+            "scheduler_unschedulable_reason_total",
+            "Per-round per-job unschedulable verdicts, by reason",
+            ["reason"],
+            registry=r,
+        )
         self.anti_entropy_resolutions = Counter(
             "scheduler_anti_entropy_resolutions_total",
             "Run resolutions produced by post-partition ExecutorSync "
@@ -319,7 +342,11 @@ class SchedulerMetrics:
 
 
 def serve_metrics(metrics: SchedulerMetrics, port: int):
-    """Tiny HTTP endpoint serving /metrics (common.ServeMetrics)."""
+    """Tiny HTTP endpoint serving /metrics (common.ServeMetrics).
+
+    Returns (server, bound_port): port 0 binds an ephemeral port (tests
+    stop hard-coding ports and racing each other for them), the same
+    contract as health.serve_health."""
     import http.server
     import threading
 
@@ -338,4 +365,4 @@ def serve_metrics(metrics: SchedulerMetrics, port: int):
     server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
-    return server
+    return server, server.server_address[1]

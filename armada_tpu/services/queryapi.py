@@ -180,10 +180,16 @@ class QueryApi:
     serves lookout queries from its own Postgres view, never the scheduler
     DB (internal/lookout/repository)."""
 
-    def __init__(self, jobdb: JobDb | None = None, lookout=None):
+    def __init__(self, jobdb: JobDb | None = None, lookout=None,
+                 timeline=None):
         assert jobdb is not None or lookout is not None
         self.jobdb = jobdb
         self.lookout = lookout
+        # Optional job-journey ledger (services/job_timeline.py): the
+        # per-job transition + unschedulable-round history behind
+        # job_trace(); None on deployments without a scheduler in
+        # process (pure lookout readers).
+        self.timeline = timeline
         # One accessor bound per backend (no per-row type sniffing on the
         # query hot path).
         self._value = _value_lookout if lookout is not None else _value_job
@@ -473,6 +479,19 @@ class QueryApi:
                 }
                 for r in job.runs
             ],
+        }
+
+    def job_trace(self, job_id: str) -> dict | None:
+        """The job's journey (timeline + rendered text), or None when no
+        ledger is attached or the job was never observed."""
+        if self.timeline is None:
+            return None
+        doc = self.timeline.get(job_id)
+        if doc is None:
+            return None
+        return {
+            "journey": doc,
+            "rendered": self.timeline.render(job_id, doc=doc),
         }
 
     def get_job_spec(self, job_id: str):

@@ -129,6 +129,19 @@ class SchedulerService:
 
         self.reports = SchedulingReportsRepository()
         self.metrics = None  # set via attach_metrics
+        # Job-journey ledger (services/job_timeline.py): per-job state
+        # transitions + per-round unschedulable reasons, bounded; the
+        # backing store for `armadactl job-trace` / GET /api/jobtrace.
+        from .job_timeline import JobTimelineStore
+
+        self.timeline = JobTimelineStore()
+        # In-process tracer (utils/tracing.py): cycle/round spans with
+        # the solve profile as child spans. Defaults to the process-wide
+        # tracer; attach_tracer swaps in one with an exporter
+        # (Simulator(span_path=...), tools/trace2perfetto.py).
+        from ..utils.tracing import TRACER
+
+        self.tracer = TRACER
         # Flight recorder (armada_tpu/trace): when attached, every pool
         # round's solver inputs + decision stream append to an .atrace
         # bundle for deterministic replay (attach_trace_recorder).
@@ -190,6 +203,11 @@ class SchedulerService:
     def attach_metrics(self, metrics):
         self.metrics = metrics
 
+    def attach_tracer(self, tracer):
+        """Replace the process-default tracer (e.g. with one exporting
+        OTLP/JSON for tools/trace2perfetto.py)."""
+        self.tracer = tracer
+
     def attach_trace_recorder(self, recorder):
         """Start appending every scheduling round (padded DeviceRound
         inputs + decision stream) to the recorder's .atrace bundle."""
@@ -228,10 +246,12 @@ class SchedulerService:
                 "flight-recorder append failed: %r", e
             )
 
-    def _observe_transition(self, txn, event):
+    def _observe_transition(self, txn, event, sequence=None):
         """State-transition metrics with time-in-previous-state
         (metrics/state_metrics.go): called before each event applies, so
-        the previous state's entry time is still on the record."""
+        the previous state's entry time is still on the record. Also
+        feeds the per-job journey ledger (services/job_timeline.py) —
+        the sequence carries the publisher's trace context."""
         from ..events import (
             JobErrors as _JE,
             JobRunLeased as _JRL,
@@ -247,6 +267,14 @@ class SchedulerService:
             and event.job is not None
         ):
             self._unpriced_jobs.add(event.job.id)
+        # Captured BEFORE the ledger records this event: the journey
+        # metrics below fire only on a job's FIRST lease (re-leases
+        # after preemption/requeue would multi-count ever-growing
+        # submit-anchored waits).
+        first_lease = isinstance(event, _JRL) and not self.timeline.has_leased(
+            event.job_id
+        )
+        self.timeline.observe_event(event, sequence)
         m = self.metrics
         if m is None or m.registry is None:
             return
@@ -256,6 +284,17 @@ class SchedulerService:
         if isinstance(event, _JRL):
             name, transition = "leased", "queued_to_leased"
             since = job.submitted if job else None
+            if first_lease:
+                # Journey metrics at the first lease: rounds from submit
+                # through lease (1 = leased in its first round), and
+                # submit-to-lease queue wait.
+                m.job_rounds_to_schedule.observe(
+                    self.timeline.rounds_unschedulable(event.job_id) + 1
+                )
+                if job is not None and event.created >= job.submitted:
+                    m.job_queue_wait.labels(queue=job.queue).observe(
+                        event.created - job.submitted
+                    )
         elif isinstance(event, _JRR):
             name, transition = "running", "leased_to_running"
             run = job.latest_run if job else None
@@ -451,6 +490,18 @@ class SchedulerService:
             self._last_token_id = token_id
             self.started_at = now
             self._orphan_sweep_done = False
+        with self._span("scheduler.cycle", cycle=self.cycle_count):
+            return self._cycle_body(now, token)
+
+    def _span(self, name: str, **attrs):
+        """A tracer span, or a no-op when tracing is detached."""
+        if self.tracer is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **attrs)
+
+    def _cycle_body(self, now: float, token) -> list[EventSequence]:
         self.ingester.sync()
         self._refresh_bid_prices()
         sequences: list[EventSequence] = []
@@ -574,11 +625,17 @@ class SchedulerService:
         # away pools, so id-exclusion alone would double-book nodes).
         pending_leases: dict[str, tuple] = {}
         for pool in sorted(pools):
-            pool_seqs = self._schedule_pool(
-                pool, now, exclude=leased_this_cycle,
-                executors=executors, cordoned=cordoned, overrides=overrides,
-                skipped=skipped, pending_leases=pending_leases,
-            )
+            # Per-pool round span: the solve profile (setup/pass1/gather/
+            # finish) lands as child spans from _solve; the summary attrs
+            # are set on this span when the round completes.
+            with self._span("scheduler.round", pool=pool,
+                            cycle=self.cycle_count):
+                pool_seqs = self._schedule_pool(
+                    pool, now, exclude=leased_this_cycle,
+                    executors=executors, cordoned=cordoned,
+                    overrides=overrides,
+                    skipped=skipped, pending_leases=pending_leases,
+                )
             for seq in pool_seqs:
                 for event in seq.events:
                     if isinstance(event, JobRunLeased):
@@ -1013,6 +1070,7 @@ class SchedulerService:
         pending_leases: dict | None = None,
     ) -> list[EventSequence]:
         inc = None
+        t_build = _time.monotonic()
         txn = self.jobdb.read_txn()
         if self._cycle_incremental_ok and not exclude and not pending_leases:
             inc = self._incremental_round(
@@ -1063,6 +1121,10 @@ class SchedulerService:
                 short_job_penalty=self._short_job_penalties(txn, pool, now),
                 global_rate_tokens=g_tokens,
                 queue_rate_tokens=q_tokens,
+            )
+        if self.metrics is not None and self.metrics.registry is not None:
+            self.metrics.snapshot_build_seconds.labels(pool=pool).observe(
+                _time.monotonic() - t_build
             )
         solve_started = _time.time()
         result = self._solve(snap, inc=inc)
@@ -1186,6 +1248,16 @@ class SchedulerService:
             "scheduled": int(result["scheduled_mask"].sum()),
             "preempted": int(result["preempted_mask"].sum()),
         }
+        if self.tracer is not None:
+            round_span = self.tracer.current_span()
+            if round_span is not None and round_span.name == "scheduler.round":
+                round_span.attrs.update(
+                    jobs=snap.num_jobs,
+                    nodes=snap.num_nodes,
+                    scheduled=self.last_cycle_stats["scheduled"],
+                    preempted=self.last_cycle_stats["preempted"],
+                    truncated=truncated,
+                )
         self.log_.with_fields(
             cycle=self.cycle_count, pool=pool, stage="scheduling-round",
             jobs=snap.num_jobs, nodes=snap.num_nodes,
@@ -1195,7 +1267,7 @@ class SchedulerService:
         ).info("scheduling round complete")
         self._record_round(
             pool, snap, result, solve_started, indicative,
-            idealised=idealised, realised=realised,
+            idealised=idealised, realised=realised, now=now,
         )
 
         by_jobset: dict[tuple, list] = {}
@@ -1232,10 +1304,22 @@ class SchedulerService:
             )
             by_jobset.setdefault((job.queue, job.jobset), []).append(event)
 
-        return [
-            EventSequence.of(queue, jobset, *events)
-            for (queue, jobset), events in by_jobset.items()
-        ]
+        # Continue each job's submit trace onto its lease/preempt events:
+        # the journey ledger holds the SubmitJobs batch's traceparent, so
+        # the whole jobset shares one context in the common case. Mixed
+        # groups (jobs from different submit traces batched into one
+        # sequence) stay unstamped rather than mis-attributed.
+        tps = self.timeline.traceparents(
+            [e.job_id for events in by_jobset.values() for e in events]
+        )
+        sequences = []
+        for (queue, jobset), events in by_jobset.items():
+            contexts = {tps[e.job_id] for e in events}
+            tp = contexts.pop() if len(contexts) == 1 else ""
+            sequences.append(
+                EventSequence.of(queue, jobset, *events, traceparent=tp)
+            )
+        return sequences
 
     def _resolve_sharded_run(self):
         """Lazily build the sharded solve runner for self.mesh: an int or
@@ -1285,6 +1369,41 @@ class SchedulerService:
                 stats.per_select_dcn_scalars
             )
         self.metrics.shard_solve_time.labels(pool=pool).observe(solve_s)
+
+    def _emit_solve_spans(self, pool: str, profile: dict | None,
+                          solve_s: float):
+        """Child spans of the open round span for the hot-window solve
+        profile: setup/pass1/gather/finish laid out sequentially over
+        the measured solve window, plus the loop mix and rewindow count
+        as attrs on the round span itself — so a Perfetto view of the
+        exported spans shows WHERE a round spent its time."""
+        tracer = self.tracer
+        if tracer is None:
+            return
+        parent = tracer.current_span()
+        if parent is not None and parent.name == "scheduler.round":
+            parent.attrs.update(
+                solve_s=round(solve_s, 4),
+                backend=self.backend,
+            )
+        if not profile:
+            return
+        if parent is not None:
+            parent.attrs.update(
+                gang_loops=profile.get("gang_loops", 0),
+                fill_loops=profile.get("fill_loops", 0),
+                merged_fill_loops=profile.get("merged_fill_loops", 0),
+                rewindows=profile.get("rewindows", 0),
+                window_slots=profile.get("window_slots", 0),
+            )
+        import time as _t
+
+        from ..utils.tracing import add_segment_spans
+
+        add_segment_spans(
+            tracer, parent, _t.time_ns() - int(solve_s * 1e9), profile,
+            pool=pool,
+        )
 
     def _note_solve_profile(self, pool: str, profile: dict | None):
         """Per-segment solve timings + pass-1 loop mix from the
@@ -1631,6 +1750,9 @@ class SchedulerService:
                     profile=out.get("profile"),
                 )
             self._note_solve_profile(snap.pool, out.get("profile"))
+            self._emit_solve_spans(
+                snap.pool, out.get("profile"), _t.monotonic() - t_solve
+            )
             J, Q = snap.num_jobs, snap.num_queues
             return {
                 "assigned_node": out["assigned_node"][:J],
@@ -1685,6 +1807,7 @@ class SchedulerService:
                 truncated=bool(res.truncated),
                 solve_s=round(_t.monotonic() - t_solve, 4),
             )
+        self._emit_solve_spans(snap.pool, None, _t.monotonic() - t_solve)
         return {
             "spot_price": res.spot_price,
             "assigned_node": res.assigned_node,
@@ -1700,7 +1823,7 @@ class SchedulerService:
         }
 
     def _record_round(self, pool, snap, result, started, indicative=None,
-                      idealised=None, realised=None):
+                      idealised=None, realised=None, now=None):
         import numpy as np
 
         from ..solver.drf import unweighted_cost
@@ -1751,6 +1874,21 @@ class SchedulerService:
                 for j in range(snap.num_jobs)
                 if reasons[j]
             }
+            # Job-journey ledger: fold this round's verdicts into each
+            # job's bounded reason aggregates (the history reports.py
+            # used to discard every round), and count them by reason.
+            # Stamped with the CYCLE clock (virtual in the simulator),
+            # the same time base as the transition entries — wall clock
+            # here would misorder sim journeys.
+            reason_totals = self.timeline.note_round_reasons(
+                pool, now if now is not None else finished,
+                report.job_reasons,
+            )
+            if self.metrics is not None and self.metrics.registry is not None:
+                for reason, count in reason_totals.items():
+                    self.metrics.unschedulable_reason.labels(
+                        reason=reason
+                    ).inc(count)
             # Per-queue unschedulable-reason histogram (queue report depth).
             for j in range(snap.num_jobs):
                 if not reasons[j]:

@@ -102,7 +102,9 @@ class ControlPlane:
                     0, self.log.end_offset - self.scheduler.ingester.cursor
                 ),
             )
-        self.query = QueryApi(self.scheduler.jobdb)
+        self.query = QueryApi(
+            self.scheduler.jobdb, timeline=self.scheduler.timeline
+        )
         self.metrics = SchedulerMetrics()
         self.scheduler.attach_metrics(self.metrics)
         self.submit_checker = (
@@ -153,8 +155,10 @@ class ControlPlane:
             store_health=self.store_health,
         )
         self.grpc_server, self.grpc_port = self.api.serve(grpc_port, tls=tls)
-        self.metrics_server = (
-            serve_metrics(self.metrics, metrics_port) if metrics_port else None
+        self.metrics_server, self.metrics_port = (
+            serve_metrics(self.metrics, metrics_port)
+            if metrics_port is not None
+            else (None, None)
         )
         # Independent lookout materialization (the reference's third
         # ingester): its own cursor + rows, synced in the loop; the lookout
@@ -177,7 +181,10 @@ class ControlPlane:
             from .lookout_http import LookoutHttpServer
 
             self.lookout = LookoutHttpServer(
-                QueryApi(lookout=self.lookout_store),
+                QueryApi(
+                    lookout=self.lookout_store,
+                    timeline=self.scheduler.timeline,
+                ),
                 self.scheduler,
                 self.submit,
                 lookout_port,

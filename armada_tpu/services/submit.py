@@ -228,7 +228,19 @@ class SubmitService:
             job_ids.append(job.id)
             events.append(SubmitJob(created=now, job=job, deduplication_id=dedup_id))
         if events:
-            self.log.publish(EventSequence.of(queue, jobset, *events))
+            # Stamp the caller's trace context (the gRPC server span the
+            # transport opened around this handler, utils/tracing.py):
+            # the ingester's journey ledger records it per job and the
+            # scheduler continues it onto lease events — one trace id
+            # from submit RPC through lease.
+            from ..utils.tracing import TRACER
+
+            self.log.publish(
+                EventSequence.of(
+                    queue, jobset, *events,
+                    traceparent=TRACER.current_traceparent(),
+                )
+            )
         return job_ids
 
     def _validate_and_default(

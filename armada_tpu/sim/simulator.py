@@ -124,6 +124,11 @@ class Simulator:
         # round's DeviceRound inputs + decision stream to this .atrace
         # bundle, seeds included, for deterministic replay.
         trace_path: str | None = None,
+        # Span export (utils/tracing.py): write the run's cycle/round/
+        # solve-segment spans as OTLP-JSON lines to this path —
+        # tools/trace2perfetto.py turns them into a Perfetto-loadable
+        # timeline of the whole run.
+        span_path: str | None = None,
     ):
         self.config = config or SchedulingConfig()
         self.rng = np.random.default_rng(seed)
@@ -172,6 +177,16 @@ class Simulator:
             snapshot_mode=snapshot_mode, is_leader=is_leader,
         )
         self.submit = SubmitService(self.config, self.log, scheduler=self.scheduler)
+        self.span_tracer = None
+        if span_path is not None:
+            from ..utils.tracing import OtlpJsonFileExporter, Tracer
+
+            open(span_path, "w").close()  # one run = one span file
+            self.span_tracer = Tracer(
+                exporter=OtlpJsonFileExporter(span_path, service_name="armada-tpu-sim"),
+                export_every=256,
+            )
+            self.scheduler.attach_tracer(self.span_tracer)
         self.trace_recorder = None
         if trace_path is not None:
             from ..trace import TraceRecorder
@@ -264,6 +279,8 @@ class Simulator:
         finally:
             if self.trace_recorder is not None:
                 self.trace_recorder.close()
+            if self.span_tracer is not None:
+                self.span_tracer.flush()
 
     def _run(self) -> SimResult:
         t = 0.0

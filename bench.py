@@ -33,7 +33,9 @@ truncation — the burst_50k config with BENCH_ROUND_BUDGET_S=5 is the
 round-deadline acceptance scenario; BENCH_HOT_WINDOW sets the per-queue
 hot-window compaction size (0 disables; default: 2x the fill window);
 BENCH_FILL_WINDOW sets batch_fill_window (wide windows amortize the
-per-group candidate sort, the dominant per-loop cost at 50k nodes).
+per-group candidate sort, the dominant per-loop cost at 50k nodes);
+BENCH_SPANS=<path> exports every measured warm cycle's phase spans as
+OTLP-JSON lines (tools/trace2perfetto.py renders the run in Perfetto).
 
 The LAST stdout line is always one JSON object with an "ok" flag — on
 any failure it carries ok=false and the error instead of silently dying
@@ -157,8 +159,39 @@ def _put(dev):
     return out
 
 
+def _emit_cycle_spans(tracer, config_name, timings, profile):
+    """One warm cycle -> a span tree with the measured phase durations
+    (delta apply / device prep / h2d / solve, plus the solve profile's
+    segments when the host-driven driver ran)."""
+    end_ns = time.time_ns()
+    cycle_s = timings["cycle_s"]
+    start_ns = end_ns - int(cycle_s * 1e9)
+    parent = tracer.add_span(
+        "bench.warm_cycle",
+        start_unix_ns=start_ns,
+        duration_s=cycle_s,
+        config=config_name,
+        scheduled_jobs=timings["scheduled_jobs"],
+        loops=timings["loops"],
+    )
+    from armada_tpu.utils.tracing import add_segment_spans
+
+    at = start_ns
+    for phase in ("delta_s", "prep_s", "h2d_s", "solve_s"):
+        dur = float(timings[phase])
+        tracer.add_span(
+            f"bench.{phase[:-2]}",
+            start_unix_ns=at,
+            duration_s=dur,
+            parent=parent,
+        )
+        if phase == "solve_s" and profile:
+            add_segment_spans(tracer, parent, at, profile)
+        at += int(dur * 1e9)
+
+
 def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
-               hot_window=None, trace_path=None):
+               hot_window=None, trace_path=None, span_tracer=None):
     """Cold build, one shape-settling warm cycle, then >=5 measured warm
     cycles (BENCH_WARM_CYCLES): the headline is the MEDIAN cycle with its
     spread (min/max + IQR), not a single sample — a single warm cycle can
@@ -273,6 +306,14 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
             # Per-segment solve profile (setup / pass-1 / gather /
             # finish wall clock + loop mix) from the host-driven driver.
             timings["segments"] = out["profile"]
+        if span_tracer is not None:
+            # BENCH_SPANS: the cycle and its component phases as
+            # post-hoc spans — tools/trace2perfetto.py renders the whole
+            # bench run as a Perfetto timeline.
+            _emit_cycle_spans(
+                span_tracer, f"{n_jobs}x{n_nodes}", timings,
+                out.get("profile"),
+            )
         return timings, out
 
     first, out = warm_cycle(out)  # may pay a shape-change compile once
@@ -434,31 +475,52 @@ def _run_matrix(partial=None):
         trace_path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "BENCH_trace.atrace"
         )
+    # BENCH_SPANS=<path>: export every measured warm cycle's phase spans
+    # as OTLP-JSON lines; tools/trace2perfetto.py converts the file into
+    # a Perfetto-loadable timeline of the bench run.
+    span_tracer = None
+    spans_path = os.environ.get("BENCH_SPANS") or None
+    if spans_path:
+        from armada_tpu.utils.tracing import OtlpJsonFileExporter, Tracer
+
+        open(spans_path, "w").close()  # one bench run = one span file
+        span_tracer = Tracer(
+            exporter=OtlpJsonFileExporter(
+                spans_path, service_name="armada-tpu-bench"
+            ),
+            export_every=256,
+        )
     tracking = burst50k = None
     if custom:
         n_jobs = int(os.environ.get("BENCH_JOBS", 100_000))
         n_nodes = int(os.environ.get("BENCH_NODES", 5000))
-        flag = run_config(n_jobs, n_nodes, mesh=mesh, trace_path=trace_path)
+        flag = run_config(n_jobs, n_nodes, mesh=mesh, trace_path=trace_path,
+                          span_tracer=span_tracer)
     else:
         n_jobs, n_nodes = 1_000_000, 50_000
         # Like-for-like vs earlier rounds: the historical 512 fill
         # window, no hot-window compaction (a 100k round cannot
         # amortize the host-driven driver's fixed overhead).
         tracking = run_config(
-            100_000, 5000, mesh=mesh, fill_window=512, hot_window=0
+            100_000, 5000, mesh=mesh, fill_window=512, hot_window=0,
+            span_tracer=span_tracer,
         )
         partial["tracking_100k"] = tracking
         if os.environ.get("BENCH_FLAGSHIP", "1") == "1":
-            flag = run_config(n_jobs, n_nodes, mesh=mesh, trace_path=trace_path)
+            flag = run_config(n_jobs, n_nodes, mesh=mesh, trace_path=trace_path,
+                              span_tracer=span_tracer)
             partial["flagship"] = flag
             if os.environ.get("BENCH_BURST50K", "1") == "1":
                 burst50k = run_config(
-                    n_jobs, n_nodes, burst=50_000, mesh=mesh
+                    n_jobs, n_nodes, burst=50_000, mesh=mesh,
+                    span_tracer=span_tracer,
                 )
                 partial["burst_50k"] = burst50k
         else:
             flag, (n_jobs, n_nodes) = tracking, (100_000, 5000)
             tracking = None
+    if span_tracer is not None:
+        span_tracer.flush()
 
     extra = dict(flag)
     cycle_s = extra.pop("cycle_s")

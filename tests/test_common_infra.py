@@ -131,6 +131,102 @@ def test_otlp_json_file_exporter(tmp_path):
     }
 
 
+class _CollectExporter:
+    def __init__(self):
+        self.batches = []
+
+    def export(self, spans):
+        self.batches.append(list(spans))
+
+
+class _BoomExporter:
+    def export(self, spans):
+        raise RuntimeError("collector down")
+
+
+def test_tracer_flush_on_interval():
+    """A low-traffic process must not hold spans hostage to the batch
+    size: once export_interval_s elapses, the next finished span
+    triggers a flush even far below export_every."""
+    import time as _t
+
+    exporter = _CollectExporter()
+    tracer = Tracer(exporter=exporter, export_every=1000,
+                    export_interval_s=0.05)
+    with tracer.span("early"):
+        pass
+    assert exporter.batches == []  # within the interval, batch too small
+    _t.sleep(0.06)
+    with tracer.span("late"):
+        pass
+    assert len(exporter.batches) == 1
+    assert [s.name for s in exporter.batches[0]] == ["early", "late"]
+
+
+def test_tracer_atexit_drains_final_batch(monkeypatch):
+    """Building a Tracer with an exporter registers its flush with
+    atexit, so the final sub-batch is not lost at process exit."""
+    import atexit
+
+    registered = []
+    monkeypatch.setattr(atexit, "register", registered.append)
+    exporter = _CollectExporter()
+    tracer = Tracer(exporter=exporter, export_every=1000)
+    with tracer.span("tail"):
+        pass
+    assert exporter.batches == []
+    assert registered == [tracer.flush]
+    registered[0]()  # what atexit runs at interpreter shutdown
+    assert [s.name for s in exporter.batches[0]] == ["tail"]
+
+
+def test_tracer_exporter_failure_caps_pending_and_recovers():
+    """A raising exporter must not grow _pending without bound (capped,
+    oldest dropped) nor lose the batch silently once it heals; the
+    finished ring buffer stays authoritative throughout."""
+    tracer = Tracer(exporter=_BoomExporter(), export_every=1, keep=100,
+                    max_pending=3)
+    for i in range(8):
+        with tracer.span(f"s{i}"):
+            pass
+    assert tracer.export_failures >= 1
+    assert len(tracer._pending) == 3  # capped, not 8
+    assert len(tracer.finished) == 8  # ring buffer unaffected
+    # Collector heals: the retained tail drains on the next flush.
+    healed = _CollectExporter()
+    tracer.exporter = healed
+    tracer.flush()
+    assert [s.name for s in healed.batches[0]] == ["s5", "s6", "s7"]
+    assert tracer._pending == []
+
+
+def test_traceparent_parse_and_format():
+    from armada_tpu.utils.tracing import (
+        format_traceparent,
+        parse_traceparent,
+    )
+
+    tp = format_traceparent("ab" * 16, "cd" * 8)
+    assert tp == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    assert parse_traceparent(tp) == ("ab" * 16, "cd" * 8)
+    assert parse_traceparent("") is None
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("00-short-bad-01") is None
+    # all-zero ids are invalid per the W3C spec
+    assert parse_traceparent(f"00-{'0' * 32}-{'cd' * 8}-01") is None
+    # a remote parent is adopted only when there is no local parent
+    tracer = Tracer()
+    with tracer.span("root", remote_parent=tp) as root:
+        assert root.trace_id == "ab" * 16
+        assert root.parent_id == "cd" * 8
+        with tracer.span("child", remote_parent=None) as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+    # malformed headers start a fresh trace instead of failing the RPC
+    with tracer.span("fresh", remote_parent="garbage") as fresh:
+        assert fresh.trace_id not in ("", "ab" * 16)
+
+
 def test_background_task_manager():
     """common/task BackgroundTaskManager semantics: interval between
     RETURNS, panic containment per task, join-on-stop with straggler

@@ -1,0 +1,341 @@
+"""Job-journey observability: cross-process trace propagation (W3C
+traceparent over gRPC metadata -> EventSequence), the per-job timeline
+ledger (services/job_timeline.py), and its query surfaces (JobTrace RPC,
+armadactl job-trace, lookout /api/jobtrace)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.services.grpc_api import ApiClient
+from armada_tpu.services.job_timeline import JobTimelineStore
+from armada_tpu.services.server import ControlPlane
+from armada_tpu.utils.tracing import TRACER, parse_traceparent
+
+
+def _wait(predicate, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---- timeline store unit behavior -----------------------------------
+
+
+def test_timeline_aggregates_unschedulable_rounds():
+    """Per-round reasons fold into bounded per-reason aggregates: 10k
+    pending rounds cost reason buckets, not 10k entries."""
+    from armada_tpu.events import JobRunLeased, SubmitJob
+    from armada_tpu.core.types import JobSpec
+    from armada_tpu.events.model import EventSequence
+
+    store = JobTimelineStore()
+    seq = EventSequence.of(
+        "team", "s1",
+        traceparent="00-" + "ab" * 16 + "-" + "cd" * 8 + "-01",
+    )
+    store.observe_event(
+        SubmitJob(created=100.0, job=JobSpec(id="j1", queue="team", jobset="s1")),
+        seq,
+    )
+    for i in range(11):
+        store.note_round_reasons(
+            "default", 110.0 + i, {"j1": "insufficient-capacity"}
+        )
+    for i in range(3):
+        store.note_round_reasons("default", 130.0 + i, {"j1": "fair-share"})
+    store.observe_event(
+        JobRunLeased(created=221.0, job_id="j1", run_id="r1",
+                     executor="ex", node_id="node-281", pool="default"),
+        None,
+    )
+    doc = store.get("j1")
+    assert doc["rounds_unschedulable"] == 14
+    assert doc["reasons"]["insufficient-capacity"]["count"] == 11
+    assert doc["reasons"]["fair-share"]["count"] == 3
+    assert doc["trace_id"] == "ab" * 16
+    assert len(doc["entries"]) == 2  # submitted + leased, not 14 rounds
+    rendered = store.render("j1")
+    assert "14 rounds unschedulable" in rendered
+    assert "insufficient-capacity ×11" in rendered
+    assert "fair-share ×3" in rendered
+    assert "node-281" in rendered
+    assert "trace " + "ab" * 16 in rendered
+    # The unschedulable summary renders between submit and lease.
+    lines = rendered.splitlines()
+    assert lines.index(
+        next(l for l in lines if "rounds unschedulable" in l)
+    ) > lines.index(next(l for l in lines if "submitted" in l))
+
+
+def test_timeline_bounded_eviction_prefers_terminal_then_leased():
+    from armada_tpu.events import JobRunLeased, JobSucceeded, SubmitJob
+    from armada_tpu.core.types import JobSpec
+
+    store = JobTimelineStore(max_jobs=3)
+    for jid in ("pending", "leased", "done"):
+        store.observe_event(
+            SubmitJob(created=1.0, job=JobSpec(id=jid, queue="q")), None
+        )
+    store.observe_event(
+        JobRunLeased(created=2.0, job_id="leased", run_id="r"), None
+    )
+    store.observe_event(JobSucceeded(created=2.0, job_id="done"), None)
+    # Terminal journeys go first...
+    store.observe_event(
+        SubmitJob(created=3.0, job=JobSpec(id="j4", queue="q")), None
+    )
+    assert store.get("done") is None
+    # ...then ones that at least reached a lease...
+    store.observe_event(
+        SubmitJob(created=4.0, job=JobSpec(id="j5", queue="q")), None
+    )
+    assert store.get("leased") is None
+    # ...and an all-pending ledger keeps the LONG-pending journeys,
+    # leaving the newest job untracked instead.
+    store.observe_event(
+        SubmitJob(created=5.0, job=JobSpec(id="j6", queue="q")), None
+    )
+    assert store.get("j6") is None
+    assert store.get("pending") is not None
+    assert store.get("j4") is not None and store.get("j5") is not None
+    # has_leased gates the first-lease-only metrics.
+    store.observe_event(
+        JobRunLeased(created=6.0, job_id="pending", run_id="r2"), None
+    )
+    assert store.has_leased("pending") and not store.has_leased("j4")
+
+
+def test_timeline_entry_cap_keeps_terminal_visible():
+    from armada_tpu.events import JobErrors, JobRequeued, SubmitJob
+    from armada_tpu.core.types import JobSpec
+
+    store = JobTimelineStore(max_entries=4)
+    store.observe_event(
+        SubmitJob(created=0.0, job=JobSpec(id="j1", queue="q")), None
+    )
+    for i in range(10):
+        store.observe_event(JobRequeued(created=1.0 + i, job_id="j1"), None)
+    store.observe_event(
+        JobErrors(created=99.0, job_id="j1", error="max retries"), None
+    )
+    doc = store.get("j1")
+    assert len(doc["entries"]) == 4
+    assert doc["entries"][-1]["kind"] == "failed"
+
+
+# ---- cross-process propagation (the socket acceptance test) ---------
+
+
+def test_one_trace_id_spans_submit_to_lease_over_grpc():
+    """One trace id follows a job across real gRPC: the client's
+    traceparent metadata reaches the server interceptor (asserted via
+    the server-side rpc span it opens), the submit EventSequence carries
+    it, the scheduler continues it onto the lease, and the remote
+    executor agent echoes it on the run lifecycle reports."""
+    from armada_tpu.services.executor_agent import ExecutorAgent, _PodRuntime
+
+    p = ControlPlane(SchedulingConfig(), cycle_period=0.05).start()
+    try:
+        client = ApiClient(p.address)
+        client.create_queue("team")
+        agent = ExecutorAgent(
+            ApiClient(p.address),
+            "trace-exec",
+            nodes=[{"id": "tn-0",
+                    "total_resources": {"cpu": "8", "memory": "32Gi"}}],
+            runtime=_PodRuntime(runtime_s=0.5),
+        )
+        agent.tick()
+        with TRACER.span("test.submit") as client_span:
+            ids = client.submit_jobs(
+                "team", "traced",
+                [{"requests": {"cpu": "2", "memory": "1Gi"}}],
+            )
+            trace_id = client_span.trace_id
+        jid = ids[0]
+
+        def done():
+            agent.tick()
+            j = p.scheduler.jobdb.get(jid)
+            return j is not None and j.state.value == "succeeded"
+
+        assert _wait(done)
+        # Interceptor metadata: the server span opened around the
+        # SubmitJobs handler joined the CLIENT's trace — the traceparent
+        # crossed the socket.
+        rpc_spans = [
+            s for s in TRACER.finished
+            if s.name == "rpc.SubmitJobs" and s.trace_id == trace_id
+        ]
+        assert rpc_spans, "no server-side rpc span joined the client trace"
+        assert rpc_spans[0].span_id != client_span.span_id
+        # The journey ledger recorded the same trace id...
+        assert p.scheduler.timeline.get(jid)["trace_id"] == trace_id
+        # ...and every hop's published events carry it: submit (client ->
+        # server), lease (scheduler round), run lifecycle (executor agent
+        # echoing over ReportEvents — a second real gRPC hop).
+        by_event = {}
+        for entry in p.log.read(0, 10**6):
+            for ev in entry.sequence.events:
+                named = getattr(ev, "job_id", "") == jid or (
+                    getattr(ev, "job", None) is not None and ev.job.id == jid
+                )
+                if named:
+                    by_event.setdefault(type(ev).__name__, set()).add(
+                        entry.sequence.traceparent
+                    )
+        for name in ("SubmitJob", "JobRunLeased", "JobRunPending",
+                     "JobRunRunning", "JobRunSucceeded"):
+            parsed = {parse_traceparent(tp) for tp in by_event[name]}
+            assert {p_[0] for p_ in parsed if p_} == {trace_id}, (
+                name, by_event[name]
+            )
+        # The JobTrace RPC surfaces it.
+        trace = client.job_trace(jid)
+        assert trace["journey"]["trace_id"] == trace_id
+        assert trace_id in trace["rendered"]
+    finally:
+        p.stop()
+
+
+# ---- multi-round unschedulable history + CLI/HTTP surfaces ----------
+
+
+@pytest.fixture()
+def stuck_plane():
+    """A control plane with a job that can never fit: every oracle round
+    reports it unschedulable, building a multi-round history."""
+    p = ControlPlane(
+        SchedulingConfig(),
+        cycle_period=0.05,
+        fake_executors=[{"name": "small", "nodes": 2, "cpu": "8"}],
+    ).start()
+    try:
+        client = ApiClient(p.address)
+        client.create_queue("team")
+        (jid,) = client.submit_jobs(
+            "team", "stuck", [{"requests": {"cpu": "999", "memory": "1Gi"}}]
+        )
+        assert _wait(
+            lambda: p.scheduler.timeline.rounds_unschedulable(jid) >= 3
+        )
+        yield p, client, jid
+    finally:
+        p.stop()
+
+
+def test_job_trace_cli_renders_multiround_history(stuck_plane, capsys):
+    from armada_tpu.clients.cli import main
+
+    p, client, jid = stuck_plane
+    main(["--server", p.address, "job-trace", jid])
+    out = capsys.readouterr().out
+    assert "rounds unschedulable" in out
+    assert "job does not fit on any node ×" in out
+    assert "submitted" in out
+    # --json prints the raw journey record
+    main(["--server", p.address, "job-trace", jid, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["rounds_unschedulable"] >= 3
+    assert doc["reasons"]["job does not fit on any node"]["count"] >= 3
+
+
+def test_job_trace_query_and_lookout_http(stuck_plane):
+    from armada_tpu.services.lookout_http import LookoutHttpServer
+
+    p, client, jid = stuck_plane
+    # queryapi surface
+    trace = p.query.job_trace(jid)
+    assert trace["journey"]["rounds_unschedulable"] >= 3
+    # lookout HTTP surface
+    lk = LookoutHttpServer(p.query, p.scheduler, p.submit, 0)
+    try:
+        doc = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{lk.port}/api/jobtrace/{jid}"
+        ))
+        assert doc["journey"]["job_id"] == jid
+        assert "rounds unschedulable" in doc["rendered"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{lk.port}/api/jobtrace/ghost"
+            )
+        assert exc.value.code == 404
+    finally:
+        lk.stop()
+
+
+def test_job_trace_unknown_job_is_not_found(stuck_plane):
+    import grpc
+
+    p, client, jid = stuck_plane
+    with pytest.raises(grpc.RpcError) as exc:
+        client.job_trace("no-such-job")
+    assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+# ---- round report reason aggregation (satellite) --------------------
+
+
+def test_round_report_top_reasons_match_job_reason_map():
+    """QueueReport.top_reasons is exactly the histogram of the round's
+    per-job reason map, per queue, on a mixed-fleet round (fitting jobs,
+    no-fit jobs, two queues)."""
+    from armada_tpu.core.types import JobSpec, QueueSpec
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    config = SchedulingConfig()
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log, backend="oracle")
+    submit = SubmitService(config, log, scheduler=sched)
+    submit.create_queue(QueueSpec("qa"))
+    submit.create_queue(QueueSpec("qb"))
+    FakeExecutor(
+        "c", log, sched,
+        nodes=make_nodes("c", count=2, cpu="8", memory="32Gi"),
+        runtime_for=lambda j: 1000.0,
+    ).tick(0.0)
+
+    def job(i, queue, cpu):
+        return JobSpec(id=f"{queue}-{i}", queue="",
+                       requests={"cpu": cpu, "memory": "1Gi"})
+
+    submit.submit("qa", "s", [job(i, "qa", "999") for i in range(3)], now=0.0)
+    submit.submit(
+        "qb", "s",
+        [job(0, "qb", "999"), job(1, "qb", "999"), job(2, "qb", "1")],
+        now=0.0,
+    )
+    sched.cycle(now=1.0)
+    report = sched.reports.latest_reports()["default"]
+    assert report.job_reasons, "expected unschedulable jobs in the round"
+    # Rebuild the per-queue histogram from the per-job map and compare.
+    txn = sched.jobdb.read_txn()
+    expected: dict = {}
+    for job_id, reason in report.job_reasons.items():
+        queue = txn.get(job_id).queue
+        expected.setdefault(queue, {})
+        expected[queue][reason] = expected[queue].get(reason, 0) + 1
+    actual = {
+        name: dict(qr.top_reasons)
+        for name, qr in report.queues.items()
+        if qr.top_reasons
+    }
+    assert actual == expected
+    assert expected["qa"] == {"job does not fit on any node": 3}
+    assert expected["qb"]["job does not fit on any node"] == 2
+    # The queue report surfaces the counts.
+    rendered = sched.reports.queue_report("qa")
+    assert "3 jobs: job does not fit on any node" in rendered
+    # And the journey ledger absorbed the same history.
+    assert sched.timeline.rounds_unschedulable("qa-0") == 1

@@ -1,0 +1,176 @@
+"""Metric liveness: every labeled metric family in SchedulerMetrics
+gets samples from a short sim (dead/never-set families fail loudly), and
+serve_metrics binds ephemeral ports."""
+
+import urllib.request
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.services.metrics import (
+    HAVE_PROMETHEUS,
+    SchedulerMetrics,
+    serve_metrics,
+)
+
+pytestmark = pytest.mark.skipif(
+    not HAVE_PROMETHEUS, reason="prometheus_client unavailable"
+)
+
+
+def test_serve_metrics_port_zero_returns_bound_port():
+    """Port 0 binds an ephemeral port and returns it, so tests stop
+    hard-coding (and racing for) fixed ports; the text endpoint serves
+    the exposition format."""
+    m = SchedulerMetrics()
+    server, port = serve_metrics(m, 0)
+    try:
+        assert port > 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ) as resp:
+            body = resp.read()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+        # Exposition text names every registered family, including the
+        # job-journey additions.
+        for family in (
+            b"scheduler_job_rounds_to_schedule",
+            b"scheduler_job_queue_wait_seconds",
+            b"scheduler_unschedulable_reason_total",
+            b"scheduler_cycle_seconds",
+        ):
+            assert family in body, family
+    finally:
+        server.shutdown()
+
+
+# Labeled families legitimately silent in this test's sims — each needs a
+# mode the short oracle run does not exercise. The test asserts these stay
+# sample-FREE here, so an entry whose feature lands in the sim path must
+# be removed (the list cannot rot into hiding dead metrics).
+EXEMPT_LABELED = {
+    # market mode only
+    "scheduler_queue_idealised_value",
+    "scheduler_queue_realised_value",
+    "scheduler_indicative_gang_price",
+    "scheduler_indicative_gang_schedulable",
+    # sharded-solve (mesh) only
+    "scheduler_solve_mesh_extent",
+    "scheduler_solve_collective_sites",
+    "scheduler_solve_collective_bytes",
+    "scheduler_shard_solve_seconds",
+    # partition / fencing chaos only (tests/test_netchaos.py covers)
+    "scheduler_fence_rejections",
+    "scheduler_executor_fence",
+    "scheduler_executor_reconnects",
+    "scheduler_anti_entropy_resolutions",
+    # replay gate only (tests/test_trace_replay.py covers)
+    "scheduler_trace_replay_divergences",
+    # round-deadline truncation only (tests/test_round_deadline.py)
+    "scheduler_rounds_truncated",
+    # preemption rounds only (tests/test_fill.py etc. cover)
+    "scheduler_jobs_preempted",
+    "scheduler_jobs_preempted_by_type",
+}
+
+
+def _labeled_sample_counts(m: SchedulerMetrics) -> dict:
+    """family name -> sample count, for every LABELED metric attribute
+    (unlabeled metrics always render a zero-valued sample, so presence
+    tells nothing; labeled ones render samples only once .labels() was
+    actually exercised — exactly the dead-wiring signal)."""
+    counts = {}
+    for attr, metric in vars(m).items():
+        labelnames = getattr(metric, "_labelnames", None)
+        if not labelnames:
+            continue
+        for family in metric.collect():
+            counts[family.name] = counts.get(family.name, 0) + len(
+                family.samples
+            )
+    return counts
+
+
+def test_every_labeled_family_live_after_short_sim(tmp_path):
+    """A short oracle sim (fitting jobs + a can-never-fit job for the
+    unschedulable path + an attached flight recorder) must put samples
+    in every labeled family except the explicitly exempted mode-gated
+    ones — catching families that are registered but never set (the
+    seed shipped scheduler_snapshot_build_seconds exactly that way)."""
+    from armada_tpu.sim.simulator import (
+        ClusterSpec,
+        JobTemplate,
+        NodeTemplate,
+        QueueSpecSim,
+        ShiftedExponential,
+        Simulator,
+        WorkloadSpec,
+    )
+    from armada_tpu.trace import TraceRecorder
+
+    sim = Simulator(
+        [ClusterSpec(name="c", node_templates=(NodeTemplate(count=4, cpu="8"),))],
+        WorkloadSpec(
+            queues=(
+                QueueSpecSim(
+                    name="qa",
+                    job_templates=(
+                        JobTemplate(
+                            id="fit", number=6, cpu="2",
+                            # t>0: time-in-state observation treats a
+                            # zero previous-state timestamp as unknown.
+                            submit_time=5.0,
+                            runtime=ShiftedExponential(minimum=20.0),
+                        ),
+                    ),
+                ),
+                QueueSpecSim(
+                    name="qb",
+                    job_templates=(
+                        # Never fits: every round reports it unschedulable.
+                        JobTemplate(id="huge", number=1, cpu="999"),
+                    ),
+                ),
+            )
+        ),
+        backend="oracle",
+        cycle_interval=10.0,
+        max_time=200.0,
+        trace_path=str(tmp_path / "liveness.atrace"),
+    )
+    m = SchedulerMetrics()
+    sim.scheduler.attach_metrics(m)
+    sim.run()
+    # The solve-profile wiring (scheduler._note_solve_profile) is fed by
+    # the kernel's host-driven driver; exercise the wiring itself with a
+    # profile dict of the shape solver/kernel.solve_round emits so the
+    # profile gauges/histograms prove they are connected without a jit
+    # compile in this tier-1 test.
+    sim.scheduler._note_solve_profile(
+        "default",
+        {
+            "setup_s": 0.01, "pass1_s": 0.1, "gather_s": 0.02,
+            "finish_s": 0.01, "gang_loops": 1, "fill_loops": 2,
+            "merged_fill_loops": 3, "rewindows": 1, "window_slots": 4096,
+            "compacted": True,
+        },
+    )
+    counts = _labeled_sample_counts(m)
+    dead = sorted(
+        name for name, n in counts.items()
+        if n == 0 and name not in EXEMPT_LABELED
+    )
+    assert not dead, f"labeled metric families never set by the sim: {dead}"
+    live_exempt = sorted(
+        name for name, n in counts.items()
+        if n > 0 and name in EXEMPT_LABELED
+    )
+    assert not live_exempt, (
+        "exempted families now get samples in the sim — remove them from "
+        f"EXEMPT_LABELED so they stay guarded: {live_exempt}"
+    )
+    # Every family (labeled or not) appears in the rendered exposition.
+    rendered = m.render().decode()
+    for attr, metric in vars(m).items():
+        for family in getattr(metric, "collect", lambda: [])():
+            assert family.name in rendered, family.name
