@@ -191,6 +191,21 @@ class SchedulingConfig:
     # amortize. The default is the flagship/burst regime (>=512k slots);
     # solve_round's parameter default references this same constant.
     hot_window_min_slots: int = HOT_WINDOW_MIN_SLOTS_DEFAULT
+    # Solver autopilot (armada_tpu/autotune): when enabled, perf-only
+    # solve knobs (hot window, budgeted chunk stride) come from the
+    # tuning store — seeded by `autotuneProfile` (a tools/autotune.py
+    # output file) and the persisted checkpoint — and the online
+    # controller hill-climbs the per-pool window between rounds from
+    # the live solve profile. Placement is structurally unaffected:
+    # every tunable knob is bit-exact with the uncompacted kernel.
+    autotune_enabled: bool = False
+    autotune_profile: str = ""
+    # Consecutive same-signal rounds required before the online
+    # controller adopts a change (and the cooldown after one).
+    autotune_hysteresis_rounds: int = 3
+    # Bounds of the online hill-climb's window moves (pow2 steps).
+    autotune_min_window_slots: int = 64
+    autotune_max_window_slots: int = 1 << 16
     executor_timeout_s: float = 600.0
     # Lease TTL advertised to executor agents in every lease reply: an
     # agent that cannot complete a lease exchange for this long must
@@ -275,6 +290,19 @@ class SchedulingConfig:
             )
             object.__setattr__(self, "_factory", cached)
         return cached
+
+    def window_lookahead(self) -> int:
+        """Slots the pass-1 kernel may read ahead of a queue's head
+        pointer — the config-level mirror of
+        solver/hotwindow.window_lookahead (which reads the prepped
+        DeviceRound): the fill window in the batched modes, one slot in
+        serial/market mode. The kernel clamps the effective hot window
+        up to this (Ws = pow2(max(window, lookahead))), so validation
+        and the autotune controller share this one rule instead of
+        re-deriving it."""
+        if self.batch_fill_window > 0 and not self.market_driven:
+            return int(self.batch_fill_window)
+        return 1
 
     def priority_class(self, name: str | None) -> PriorityClass:
         """Resolve a priority-class name, falling back to the default class
@@ -446,6 +474,11 @@ class SchedulingConfig:
             ("batchFillWindow", "batch_fill_window", int),
             ("hotWindowSlots", "hot_window_slots", int),
             ("hotWindowMinSlots", "hot_window_min_slots", int),
+            ("autotuneEnabled", "autotune_enabled", bool),
+            ("autotuneProfile", "autotune_profile", str),
+            ("autotuneHysteresisRounds", "autotune_hysteresis_rounds", int),
+            ("autotuneMinWindowSlots", "autotune_min_window_slots", int),
+            ("autotuneMaxWindowSlots", "autotune_max_window_slots", int),
             ("enableFastFill", "enable_fast_fill", bool),
             ("fillGroupMax", "fill_group_max", int),
         ]:
@@ -531,6 +564,40 @@ def validate_config(config: SchedulingConfig):
         problems.append("hotWindowSlots must be >= 0")
     if config.hot_window_min_slots < 0:
         problems.append("hotWindowMinSlots must be >= 0")
+    if config.hot_window_slots > 0 and config.hot_window_min_slots > 0:
+        # Compaction engages only when the padded slot axis S clears
+        # BOTH hotWindowMinSlots and 2*Q*Ws (the window must actually
+        # shrink the round; solver/kernel._window_precheck). Ws is the
+        # configured window clamped up to the kernel's head lookahead
+        # (the fill window in batched modes) and rounded to a power of
+        # two, so if even a single-queue round at the floor cannot
+        # engage (2*Ws >= floor) the floor is unreachable and every
+        # round in [floor, 2*Q*Ws) silently runs uncompacted — the
+        # window the operator configured is dead exactly where they
+        # told it to start working.
+        ws_base = max(int(config.hot_window_slots), config.window_lookahead())
+        ws_pow2 = 1 << max(0, (ws_base - 1).bit_length())
+        if 2 * ws_pow2 >= config.hot_window_min_slots:
+            import warnings
+
+            warnings.warn(
+                f"hotWindowSlots={config.hot_window_slots} cannot engage at "
+                f"the hotWindowMinSlots={config.hot_window_min_slots} "
+                "engagement floor: compaction needs the slot axis above "
+                f"2 x queues x {ws_pow2} (the pow2-bucketed window), so "
+                "rounds at the floor always run uncompacted. Raise "
+                "hotWindowMinSlots above 2x the window or shrink "
+                "hotWindowSlots.",
+                stacklevel=2,
+            )
+    if config.autotune_hysteresis_rounds < 1:
+        problems.append("autotuneHysteresisRounds must be >= 1")
+    if config.autotune_min_window_slots < 1:
+        problems.append("autotuneMinWindowSlots must be >= 1")
+    if config.autotune_max_window_slots < config.autotune_min_window_slots:
+        problems.append(
+            "autotuneMaxWindowSlots must be >= autotuneMinWindowSlots"
+        )
     if config.fill_group_max < 1:
         problems.append("fillGroupMax must be >= 1")
     if config.max_scheduling_duration_s < 0:

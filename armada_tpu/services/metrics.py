@@ -327,6 +327,37 @@ class SchedulerMetrics:
             ["reason"],
             registry=r,
         )
+        # ---- solver autopilot (armada_tpu/autotune): the perf-only
+        # parameter vector each pool currently runs with, and every
+        # adopted online change — so an operator can see exactly when
+        # and why the closed loop moved a knob.
+        self.autotune_window_slots = Gauge(
+            "scheduler_autotune_window_slots",
+            "Hot-window size the autotune controller currently applies "
+            "(per-queue slots; 0 = compaction disabled)",
+            ["pool"],
+            registry=r,
+        )
+        self.autotune_chunk_loops = Gauge(
+            "scheduler_autotune_chunk_loops",
+            "Budgeted pass-1 starting chunk stride the autotune "
+            "controller currently applies",
+            ["pool"],
+            registry=r,
+        )
+        self.autotune_adjustments = Counter(
+            "scheduler_autotune_adjustments_total",
+            "Online parameter changes adopted by the autotune "
+            "controller, by direction",
+            ["pool", "direction"],
+            registry=r,
+        )
+        self.autotune_store_entries = Gauge(
+            "scheduler_autotune_store_entries",
+            "Entries in the persisted tuning store (offline profiles + "
+            "online adoptions)",
+            registry=r,
+        )
         self.anti_entropy_resolutions = Counter(
             "scheduler_anti_entropy_resolutions_total",
             "Run resolutions produced by post-partition ExecutorSync "

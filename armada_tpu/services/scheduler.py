@@ -146,6 +146,12 @@ class SchedulerService:
         # round's solver inputs + decision stream append to an .atrace
         # bundle for deterministic replay (attach_trace_recorder).
         self.trace_recorder = None
+        # Solver autopilot (armada_tpu/autotune): when attached, each
+        # kernel round runs with the controller's per-pool perf-only
+        # vector (hot window / engagement floor / budgeted chunk) and
+        # feeds its solve profile back so the bounded hill-climb can
+        # adjust between rounds (attach_autotune).
+        self.autotune = None
         # Round-deadline guardrail (maxSchedulingDuration): wall-clock
         # deadline for the current cycle's rounds, armed per cycle in
         # _schedule_all_pools; pools share the budget in round order.
@@ -212,6 +218,14 @@ class SchedulerService:
         """Start appending every scheduling round (padded DeviceRound
         inputs + decision stream) to the recorder's .atrace bundle."""
         self.trace_recorder = recorder
+
+    def attach_autotune(self, controller):
+        """Close the tuning loop (armada_tpu/autotune): the controller's
+        per-pool parameter vector overrides the static hot-window/chunk
+        config for every kernel solve, and each solve's profile feeds
+        the controller's hysteresis'd hill-climb. Only perf-only knobs
+        ever move — placements are bit-exact regardless."""
+        self.autotune = controller
 
     def _trace_round(self, snap, dev, decisions, *, solver, truncated,
                      solve_s, profile=None):
@@ -1726,17 +1740,32 @@ class SchedulerService:
                 hosts, chips = shape if len(shape) == 2 else (1, shape[0])
                 solver_info = {"backend": "kernel", "mesh": f"{hosts}x{chips}"}
             else:
+                tuned = (
+                    self.autotune.params_for(snap.pool)
+                    if self.autotune is not None
+                    else None
+                )
+                if tuned is not None:
+                    window = tuned.hot_window_slots or None
+                    window_min_slots = tuned.hot_window_min_slots
+                    chunk_loops = tuned.chunk_loops
+                else:
+                    window = snap.config.hot_window_slots or None
+                    window_min_slots = snap.config.hot_window_min_slots
+                    chunk_loops = 1
                 out = solve_round(
                     dev,
                     budget_s=budget_s,
-                    window=snap.config.hot_window_slots or None,
-                    window_min_slots=snap.config.hot_window_min_slots,
+                    chunk_loops=chunk_loops,
+                    window=window,
+                    window_min_slots=window_min_slots,
                 )
                 solver_info = {
                     "backend": "kernel",
                     "mesh": None,
-                    "window": int(snap.config.hot_window_slots or 0),
+                    "window": int(window or 0),
                     "budget": bool(budget_s),
+                    "autotuned": tuned is not None,
                 }
             truncated = bool(out.get("truncated", False))
             if self.trace_recorder is not None:
@@ -1750,6 +1779,19 @@ class SchedulerService:
                     profile=out.get("profile"),
                 )
             self._note_solve_profile(snap.pool, out.get("profile"))
+            if self.autotune is not None and self.mesh is None:
+                # Between-rounds adjustment. Only rounds the
+                # single-device kernel actually solved feed the loop:
+                # the sharded (mesh) solve takes no window vector, so
+                # its profile-less rounds would read as a false
+                # disengagement signal.
+                self.autotune.observe_round(
+                    snap.pool,
+                    out.get("profile"),
+                    solve_s=_t.monotonic() - t_solve,
+                    metrics=self.metrics,
+                    log=self.log_,
+                )
             self._emit_solve_spans(
                 snap.pool, out.get("profile"), _t.monotonic() - t_solve
             )

@@ -82,6 +82,31 @@ class ControlPlane:
             self.config, self.log, backend=backend, mesh=mesh,
             is_leader=self.leader, checkpoint=_ckpt("scheduler"),
         )
+        # Solver autopilot (armada_tpu/autotune): the tuning store is
+        # restored from its checkpoint first, then any config-named
+        # offline profile (tools/autotune.py output) overlays it — the
+        # config is the operator's override. The scheduler then adopts
+        # the store's per-pool vector at its first round.
+        self.autotune = None
+        if self.config.autotune_enabled:
+            from ..autotune import AutotuneController
+
+            self.autotune = AutotuneController(self.config)
+            ck = _ckpt("autotune")
+            if ck is not None:
+                self.autotune.store.load(ck[1])
+            if self.config.autotune_profile:
+                try:
+                    # operator=True: the config-named profile outranks
+                    # checkpoint-restored online adoptions in lookup —
+                    # config is the operator's override, every boot it
+                    # is configured.
+                    self.autotune.store.merge_json(
+                        self.config.autotune_profile, operator=True
+                    )
+                except Exception as e:  # noqa: BLE001 - tuning is advisory
+                    print(f"autotune profile load failed: {e!r}")
+            self.scheduler.attach_autotune(self.autotune)
         # Submit-side shedding consumes store capacity AND round-deadline
         # pressure (repeated maxSchedulingDuration truncations) through one
         # gate: sustained overload sheds intake instead of growing the
@@ -285,7 +310,17 @@ class ControlPlane:
         with self._maintenance_lock:
             self.submit.sync()
             self.event_index.sync()
+            self._save_autotune()
             self.checkpoints.checkpoint_and_compact()
+
+    def _save_autotune(self):
+        """Persist the tuning store next to the view checkpoints. NOT a
+        registered view: it consumes no log events, so its (meaningless)
+        cursor must never hold back log compaction."""
+        if self.autotune is not None and self.checkpoints is not None:
+            self.checkpoints.store.save(
+                "autotune", 0, self.autotune.store.dump()
+            )
 
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -324,6 +359,7 @@ class ControlPlane:
                 with self._maintenance_lock:
                     self.submit.sync()
                     self.event_index.sync()
+                    self._save_autotune()
                     self.checkpoints.save_all()
             except Exception as e:
                 print(f"final checkpoint failed: {e!r}")

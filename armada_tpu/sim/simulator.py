@@ -129,6 +129,12 @@ class Simulator:
         # tools/trace2perfetto.py turns them into a Perfetto-loadable
         # timeline of the whole run.
         span_path: str | None = None,
+        # Solver autopilot (armada_tpu/autotune): attach an online
+        # controller (opt-in regardless of config.autotune_enabled, so
+        # differential tests can force the closed loop on). Pass True
+        # for a fresh controller or a prebuilt AutotuneController (e.g.
+        # with a pre-seeded tuning store).
+        autotune=False,
     ):
         self.config = config or SchedulingConfig()
         self.rng = np.random.default_rng(seed)
@@ -202,6 +208,16 @@ class Simulator:
                 meta={"backend": backend, "cycle_interval": cycle_interval},
             )
             self.scheduler.attach_trace_recorder(self.trace_recorder)
+        self.autotune = None
+        if autotune:
+            from ..autotune import AutotuneController
+
+            self.autotune = (
+                autotune
+                if isinstance(autotune, AutotuneController)
+                else AutotuneController(self.config, enabled=True)
+            )
+            self.scheduler.attach_autotune(self.autotune)
 
         self._runtimes: dict[str, float] = {}
         self.executors: list[FakeExecutor] = []

@@ -136,6 +136,11 @@ class TraceRecorder:
                 "market_driven": bool(cfg.market_driven),
                 "batch_fill_window": int(cfg.batch_fill_window),
                 "hot_window_slots": int(getattr(cfg, "hot_window_slots", 0)),
+                # The offline tuner's baseline vector needs the floor
+                # too; older bundles lack the key (readers default it).
+                "hot_window_min_slots": int(
+                    getattr(cfg, "hot_window_min_slots", 0)
+                ),
                 "priority_classes": sorted(cfg.priority_classes),
             }
         self._write(

@@ -24,7 +24,8 @@ extra.platform_probe records why (e.g. the TPU tunnel relay being down —
 docs/tpu_tunnel_postmortem.md).
 
 Env overrides: BENCH_JOBS/BENCH_NODES/BENCH_QUEUES/BENCH_RUNNING pick a
-single custom config instead; BENCH_FLAGSHIP=0 skips the 1M x 50k runs;
+single custom config instead (BENCH_BURST raises its per-round
+scheduling burst — the forced-rewindow regime at custom scale); BENCH_FLAGSHIP=0 skips the 1M x 50k runs;
 BENCH_BURST50K=0 skips the burst run; BENCH_FAST_FILL=0 runs the serial
 parity-mode fill; BENCH_WARM_CYCLES sets the warm-sample count (>=2,
 default 5); BENCH_ROUND_BUDGET_S runs every solve through the
@@ -34,8 +35,14 @@ round-deadline acceptance scenario; BENCH_HOT_WINDOW sets the per-queue
 hot-window compaction size (0 disables; default: 2x the fill window);
 BENCH_FILL_WINDOW sets batch_fill_window (wide windows amortize the
 per-group candidate sort, the dominant per-loop cost at 50k nodes);
-BENCH_SPANS=<path> exports every measured warm cycle's phase spans as
-OTLP-JSON lines (tools/trace2perfetto.py renders the run in Perfetto).
+BENCH_TUNED=<tuned.json> applies the tools/autotune.py profile matching
+this host's target signature (hot window + budgeted chunk stride) to
+every config — the A/B against the static defaults is just the same
+bench run with and without the variable; the effective (possibly tuned)
+parameters are always recorded under extra.params so artifacts are
+self-describing either way; BENCH_SPANS=<path> exports every measured
+warm cycle's phase spans as OTLP-JSON lines (tools/trace2perfetto.py
+renders the run in Perfetto).
 
 The LAST stdout line is always one JSON object with an "ok" flag — on
 any failure it carries ok=false and the error instead of silently dying
@@ -65,6 +72,35 @@ def resolve_fill_window(fill_window=None) -> int:
     build_inputs and run_config's hot-window sizing so the '~2x the fill
     window' invariant cannot drift between the two sites."""
     return int(os.environ.get("BENCH_FILL_WINDOW", fill_window or 2048))
+
+
+def tuned_params():
+    """The BENCH_TUNED profile entry matching this host's target
+    signature, as a TunedParams, or None (no profile / no match).
+    Resolved once per process."""
+    global _TUNED
+    if _TUNED is not _UNSET:
+        return _TUNED
+    _TUNED = None
+    path = os.environ.get("BENCH_TUNED")
+    if path:
+        from armada_tpu.autotune import TunedParams, TuningStore, current_target
+
+        store = TuningStore()
+        store.merge_json(path)
+        entry = store.lookup(current_target(), "default")
+        if entry is None:
+            print(f"# BENCH_TUNED: no entry in {path} matches this target; "
+                  "running static defaults")
+        else:
+            _TUNED = TunedParams.from_dict(entry["params"])
+            print(f"# BENCH_TUNED: applying {entry['params']} "
+                  f"(source={entry.get('source')})")
+    return _TUNED
+
+
+_UNSET = object()
+_TUNED = _UNSET
 
 
 def build_inputs(n_jobs, n_nodes, burst=None, fill_window=None):
@@ -205,11 +241,28 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
 
     budget_s = float(os.environ.get("BENCH_ROUND_BUDGET_S", 0) or 0) or None
     raw_window = os.environ.get("BENCH_HOT_WINDOW")
+    tuned = tuned_params()
+    chunk_loops = 1
+    # Historical bench behavior: no engagement floor (window choice is
+    # per bench config). A tuned profile overrides the WHOLE vector,
+    # floor included — the A/B must measure exactly what production
+    # would run, not a floor-stripped variant of it.
+    window_min_slots = 0
+    applied_tuned = False
     if raw_window is not None:
         hot_window = int(raw_window)
     elif hot_window is None:
-        # 2x the fill window: one gather covers ~two merged fill loops.
-        hot_window = 2 * resolve_fill_window(fill_window)
+        if tuned is not None:
+            # BENCH_TUNED profile — only for configs that don't pin
+            # their own window (tracking keeps its historical fixed
+            # parameters for like-for-like comparability).
+            hot_window = tuned.hot_window_slots
+            window_min_slots = tuned.hot_window_min_slots
+            chunk_loops = tuned.chunk_loops
+            applied_tuned = True
+        else:
+            # 2x the fill window: one gather covers ~two merged fill loops.
+            hot_window = 2 * resolve_fill_window(fill_window)
     sharded = None
     if mesh:
         # mesh is a spec: int (1D chip count) or "HxC" (two-level
@@ -228,11 +281,14 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
         # big enough to pay (solver/hotwindow.py), the budget-aware
         # chunked pass 1 when BENCH_ROUND_BUDGET_S is set, the fused
         # program otherwise — all in solver/kernel.solve_round. The
-        # min-slots floor is 0: window choice is per bench config.
+        # min-slots floor is 0 (window choice is per bench config)
+        # UNLESS a BENCH_TUNED profile supplied the full vector, floor
+        # included — the A/B must measure what production would run.
         def solve_round(dev):
             return _single_solve(
-                dev, budget_s=budget_s, window=hot_window or None,
-                window_min_slots=0,
+                dev, budget_s=budget_s, chunk_loops=chunk_loops,
+                window=hot_window or None,
+                window_min_slots=window_min_slots,
             )
 
     t_setup = time.time()
@@ -379,9 +435,24 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
         # run recorded the bundle (a stale file from an earlier revision
         # must not be advertised as this artifact's trace).
         trace_extra["trace_path"] = os.path.basename(trace_path)
+    params_extra = {}
+    if sharded is None:
+        # The EFFECTIVE solver parameters this config ran with (possibly
+        # tuned via BENCH_TUNED) — artifacts are self-describing, and
+        # tools/bench_trend.py shows the vector across rounds. Mesh runs
+        # record none: the sharded solve takes no window/chunk vector,
+        # so claiming one was in effect would make the artifact lie.
+        params_extra["params"] = {
+            "hot_window_slots": int(hot_window or 0),
+            "hot_window_min_slots": int(window_min_slots),
+            "chunk_loops": int(chunk_loops),
+            "fill_window": resolve_fill_window(fill_window),
+            "tuned": applied_tuned,
+        }
     return {
         **mesh_extra,
         **trace_extra,
+        **params_extra,
         "cycle_s": round(median, 4),
         **{k: v for k, v in rep.items() if k != "cycle_s"},
         "warm_cycles_measured": len(times),
@@ -494,8 +565,12 @@ def _run_matrix(partial=None):
     if custom:
         n_jobs = int(os.environ.get("BENCH_JOBS", 100_000))
         n_nodes = int(os.environ.get("BENCH_NODES", 5000))
-        flag = run_config(n_jobs, n_nodes, mesh=mesh, trace_path=trace_path,
-                          span_tracer=span_tracer)
+        # BENCH_BURST raises the per-round scheduling burst on the
+        # custom config (the burst_50k regime at custom scale — the
+        # autotune A/B's forced-rewindow scenario).
+        burst = int(os.environ.get("BENCH_BURST", 0) or 0) or None
+        flag = run_config(n_jobs, n_nodes, burst=burst, mesh=mesh,
+                          trace_path=trace_path, span_tracer=span_tracer)
     else:
         n_jobs, n_nodes = 1_000_000, 50_000
         # Like-for-like vs earlier rounds: the historical 512 fill

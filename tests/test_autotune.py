@@ -1,0 +1,472 @@
+"""Solver autopilot (armada_tpu/autotune): offline corpus tuning,
+the online hill-climb controller, and the persisted tuning store.
+
+Tier-1 keeps the committed-fixture smoke fast (tiny candidate grid over
+tests/fixtures/sim_steady.atrace, both via the library and the
+tools/autotune.py CLI); the full default-grid search rides the slow
+marker. The store round-trips through services/checkpoint.CheckpointStore
+across a simulated restart, and a kernel-backend sim proves the
+scheduler actually ADOPTS the restored vector (the flight-recorder
+bundle's per-round solver info carries the tuned window).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import pytest
+
+from armada_tpu.autotune import (
+    AutotuneController,
+    TunedParams,
+    TuningStore,
+    current_target,
+    default_grid,
+    make_entry,
+    target_digest,
+    tune_corpus,
+    workload_fingerprint,
+)
+from armada_tpu.core.config import SchedulingConfig, validate_config
+from armada_tpu.trace import load_trace
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "sim_steady.atrace")
+
+
+# ---- satellite: config validation of the engagement floor ------------
+
+
+def test_config_warns_on_unreachable_engagement_floor():
+    """hotWindowSlots > 0 whose pow2 bucket can never engage at the
+    hotWindowMinSlots floor (2*Ws >= floor even for one queue) warns:
+    the operator configured a window that is silently dead exactly
+    where they told it to start working."""
+    with pytest.warns(UserWarning, match="cannot engage"):
+        validate_config(
+            SchedulingConfig(hot_window_slots=4096, hot_window_min_slots=4096)
+        )
+    # The kernel clamps the window up to the fill-window lookahead, so
+    # a small window with a big fill window is dead at the floor too.
+    with pytest.warns(UserWarning, match="cannot engage"):
+        validate_config(
+            SchedulingConfig(
+                hot_window_slots=128, hot_window_min_slots=512,
+                batch_fill_window=512,
+            )
+        )
+    # The shipped defaults (4096 window, 512k floor) are sound, as is a
+    # disabled floor (tests run with min_slots=0 deliberately).
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        validate_config(SchedulingConfig())
+        validate_config(
+            SchedulingConfig(hot_window_slots=4096, hot_window_min_slots=0)
+        )
+
+
+def test_config_autotune_knobs_parse_and_validate():
+    cfg = SchedulingConfig.from_dict(
+        {
+            "autotuneEnabled": True,
+            "autotuneProfile": "/tmp/tuned.json",
+            "autotuneHysteresisRounds": 5,
+            "autotuneMinWindowSlots": 128,
+            "autotuneMaxWindowSlots": 8192,
+        }
+    )
+    assert cfg.autotune_enabled is True
+    assert cfg.autotune_profile == "/tmp/tuned.json"
+    assert cfg.autotune_hysteresis_rounds == 5
+    validate_config(cfg)
+    with pytest.raises(ValueError, match="autotuneMaxWindowSlots"):
+        validate_config(
+            SchedulingConfig(
+                autotune_min_window_slots=1024, autotune_max_window_slots=64
+            )
+        )
+
+
+# ---- offline tuner ---------------------------------------------------
+
+
+def test_offline_tuner_fixture_corpus_smoke():
+    """Tier-1 smoke: a tiny candidate grid over the committed fixture
+    corpus tunes in seconds, every candidate (baseline included)
+    replays bit-exact, and the selected entry is keyed by this host's
+    target signature + the corpus's workload fingerprint."""
+    trace = load_trace(FIXTURE)
+    report = tune_corpus(
+        [trace],
+        [TunedParams(2, 0, 1), TunedParams(4, 0, 1)],
+        repeats=1,
+        allow_foreign=True,  # sound: the fixture pins x64 exact costs
+    )
+    assert report["ok"], report["results"]
+    assert report["rounds"] >= 2
+    assert all(r["bit_exact"] for r in report["results"])
+    # Baseline measured alongside the grid, from the bundle header.
+    assert report["baseline"]["label"] == "baseline"
+    assert report["baseline"]["params"]["hot_window_slots"] == 4096
+    selected = report["selected"]
+    assert selected is not None
+    assert selected["target"] == target_digest(current_target())
+    assert selected["workload"] == workload_fingerprint([trace])
+    assert selected["pool"] == "default"
+    assert selected["tuned_s"] is not None
+
+
+def test_offline_tuner_cli_smoke(tmp_path):
+    """tools/autotune.py over the committed corpus: exit 0, writes a
+    tuning-store profile this host can look up."""
+    out = tmp_path / "tuned.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("BENCH_MESH", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "autotune.py"),
+            FIXTURE, "--windows", "2,4", "--min-slots", "0",
+            "--repeats", "1", "--allow-foreign", "--out", str(out),
+            "--json",
+        ],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] and report["selected"] is not None
+    store = TuningStore()
+    assert store.merge_json(str(out)) == 1
+    entry = store.lookup(current_target(), "default")
+    assert entry is not None and entry["source"] == "offline"
+    params = TunedParams.from_dict(entry["params"])
+    assert params.hot_window_slots in (2, 4, 4096)
+
+
+def test_offline_tuner_refuses_unusable_corpus(tmp_path):
+    """A corpus with no replayable rounds (or an unreadable bundle)
+    exits 2 — unusable, distinct from a divergence failure (1)."""
+    bogus = tmp_path / "empty.atrace"
+    from armada_tpu.trace import TraceRecorder
+
+    rec = TraceRecorder(str(bogus), source="test")
+    rec._write_header(None)  # header-only bundle: no rounds
+    rec.close()
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "autotune.py"),
+            str(bogus), "--windows", "2",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "no replayable rounds" in proc.stdout
+
+
+@pytest.mark.slow
+def test_offline_tuner_full_default_grid():
+    """The full default grid (pow2 windows around the shipped 4096 with
+    the shipped floor) over the fixture corpus: slower, but every
+    candidate must still be bit-exact."""
+    trace = load_trace(FIXTURE)
+    report = tune_corpus(
+        [trace], default_grid(), repeats=2, allow_foreign=True
+    )
+    assert report["ok"], report["results"]
+    assert len(report["results"]) == len(default_grid()) + 1
+
+
+# ---- online controller ----------------------------------------------
+
+
+def _controller(**overrides):
+    kwargs = dict(
+        hot_window_slots=8,
+        hot_window_min_slots=0,
+        # Small fill window so the kernel lookahead (which floors the
+        # shrink moves) sits below the test's min bound.
+        batch_fill_window=2,
+        autotune_enabled=True,
+        autotune_hysteresis_rounds=2,
+        autotune_min_window_slots=4,
+        autotune_max_window_slots=16,
+    )
+    kwargs.update(overrides)
+    return AutotuneController(SchedulingConfig(**kwargs))
+
+
+GROW = {"compacted": True, "rewindows": 9, "gather_s": 0.02, "pass1_s": 0.5}
+SHRINK = {"compacted": True, "rewindows": 0, "gather_s": 0.3, "pass1_s": 0.1}
+STEADY = {"compacted": True, "rewindows": 1, "gather_s": 0.05, "pass1_s": 0.5}
+
+
+def test_online_hysteresis_cooldown_and_bounds():
+    ctl = _controller()
+    assert ctl.params_for("default") == TunedParams(8, 0, 1)
+    # One starved round is not a signal (hysteresis = 2)...
+    assert ctl.observe_round("default", GROW) is None
+    # ...and a steady round in between resets the streak.
+    assert ctl.observe_round("default", STEADY) is None
+    assert ctl.observe_round("default", GROW) is None
+    adopted = ctl.observe_round("default", GROW)
+    assert adopted["direction"] == "grow"
+    assert adopted["from"] == 8 and adopted["to"] == 16
+    assert ctl.params_for("default").hot_window_slots == 16
+    # Cooldown: the two rounds after an adoption are absorbed.
+    assert ctl.observe_round("default", GROW) is None
+    assert ctl.observe_round("default", GROW) is None
+    # At the max bound, a grow signal adopts nothing.
+    assert ctl.observe_round("default", GROW) is None
+    assert ctl.observe_round("default", GROW) is None
+    assert ctl.params_for("default").hot_window_slots == 16
+    # Shrink path halves down to the min bound, never below.
+    for _ in range(16):
+        ctl.observe_round("default", SHRINK)
+    assert ctl.params_for("default").hot_window_slots == 4
+    directions = [a["direction"] for a in ctl.adoptions]
+    assert directions == ["grow", "shrink", "shrink"]
+    # Every adoption persisted to the store as an online entry.
+    entry = ctl.store.lookup(current_target(), "default")
+    assert entry["source"] == "online"
+    assert entry["params"]["hot_window_slots"] == 4
+
+
+def test_online_disengaged_rounds_recover_toward_the_floor():
+    """A window the rounds never engage (e.g. grown past the kernel's
+    2*Q*Ws < S geometry — no compacted profile can ever arrive to say
+    'shrink') shrinks back toward the floor with the same hysteresis;
+    at the floor, disengaged rounds adopt nothing; a compacted round
+    resets the streak; a disabled controller ignores everything."""
+    ctl = _controller()  # window 8, floor 4, hysteresis 2
+    assert ctl.observe_round("default", None) is None
+    adopted = ctl.observe_round("default", {"compacted": False})
+    assert adopted is not None and adopted["direction"] == "shrink"
+    assert adopted["signal"]["disengaged"] is True
+    assert ctl.params_for("default").hot_window_slots == 4
+    for _ in range(6):  # at the floor: never adopts, never goes below
+        assert ctl.observe_round("default", None) is None
+    assert ctl.params_for("default").hot_window_slots == 4
+    ctl2 = _controller()
+    assert ctl2.observe_round("default", None) is None
+    assert ctl2.observe_round("default", STEADY) is None  # resets streak
+    assert ctl2.observe_round("default", None) is None
+    assert ctl2.observe_round("default", None) is not None
+    off = AutotuneController(SchedulingConfig())
+    assert off.params_for("default") is None
+    assert off.observe_round("default", dict(GROW)) is None
+
+
+def test_online_controller_pools_are_independent():
+    ctl = _controller(autotune_hysteresis_rounds=1)
+    ctl.observe_round("a", GROW)
+    assert ctl.params_for("a").hot_window_slots == 16
+    assert ctl.params_for("b").hot_window_slots == 8
+
+
+def test_online_bounds_never_move_against_the_signal():
+    """Clamping must not invert the climb: a window below the min bound
+    shrinks nowhere (never UP to the bound), grows by one doubling (not
+    a jump past the bound), and a store-seeded window above the max
+    bound never 'grows' downward."""
+    ctl = _controller(autotune_hysteresis_rounds=1, hot_window_slots=16)
+    st = ctl._state("p")
+    st.params = TunedParams(2, 0, 1)  # below autotune_min_window_slots=4
+    assert ctl.observe_round("p", SHRINK) is None
+    assert ctl.params_for("p").hot_window_slots == 2
+    adopted = ctl.observe_round("p", GROW)
+    assert adopted["to"] == 4  # one doubling, not min*2=8
+    st.params = TunedParams(64, 0, 1)  # above autotune_max_window_slots=16
+    st.cooldown = 0
+    assert ctl.observe_round("p", GROW) is None
+    assert ctl.params_for("p").hot_window_slots == 64
+
+
+def test_online_shrink_floors_at_the_kernel_lookahead():
+    """The kernel runs Ws = pow2(max(window, fill-window lookahead)):
+    shrinking the configured window below the lookahead is a no-op the
+    profile can never confirm, so the climb stops there instead of
+    marching to the min bound adopting ineffective moves."""
+    ctl = _controller(
+        batch_fill_window=512, autotune_hysteresis_rounds=1,
+        hot_window_slots=2048, autotune_min_window_slots=4,
+        autotune_max_window_slots=1 << 14,
+    )
+    assert ctl.window_floor == 512
+    ctl.observe_round("p", SHRINK)
+    assert ctl.params_for("p").hot_window_slots == 1024
+    st = ctl._state("p")
+    st.cooldown = 0
+    ctl.observe_round("p", SHRINK)
+    assert ctl.params_for("p").hot_window_slots == 512
+    st.cooldown = 0
+    # At the lookahead: no further (ineffective) shrink is adopted.
+    assert ctl.observe_round("p", SHRINK) is None
+    assert ctl.params_for("p").hot_window_slots == 512
+    # Market mode has a 1-slot lookahead: only the operator bound floors.
+    market = _controller(market_driven=True, autotune_min_window_slots=4)
+    assert market.window_floor == 4
+
+
+# ---- persisted store + restart adoption -----------------------------
+
+
+def test_store_lookup_prefers_pool_workload_and_recency():
+    store = TuningStore()
+    t = current_target()
+    store.put(make_entry(TunedParams(1024), target=t, workload="w",
+                         pool="*", created=100.0))
+    store.put(make_entry(TunedParams(2048), target=t, workload="live",
+                         pool="default", created=50.0))
+    # Pool-specific beats the newer wildcard...
+    assert store.lookup(t, "default")["params"]["hot_window_slots"] == 2048
+    assert store.lookup(t, "other")["params"]["hot_window_slots"] == 1024
+    # ...and a foreign target matches nothing.
+    assert store.lookup({"host_cpu": "feedface", "xla": "x", "x64": True},
+                        "default") is None
+    # Two profiles for different workloads coexist (distinct keys); a
+    # caller that KNOWS its workload fingerprint gets the exact match,
+    # one that doesn't gets the newest.
+    store.put(make_entry(TunedParams(512), target=t, workload="burst",
+                         pool="*", created=200.0))
+    assert len(store) == 3
+    assert store.lookup(t, "other")["params"]["hot_window_slots"] == 512
+    assert store.lookup(t, "other", workload="w")["params"][
+        "hot_window_slots"] == 1024
+
+
+def test_operator_profile_outranks_checkpointed_online_entries(tmp_path):
+    """The config-named profile is the operator's override: merged with
+    operator=True it outranks a newer pool-specific online adoption —
+    but the flag never survives a checkpoint round-trip, so a boot
+    WITHOUT the config reverts to normal ranking."""
+    t = current_target()
+    store = TuningStore()
+    # A wildcard offline profile, as tools/autotune.py writes it.
+    profile = TuningStore()
+    profile.put(make_entry(TunedParams(4096), target=t, workload="w",
+                           pool="*", created=100.0))
+    path = str(tmp_path / "tuned.json")
+    profile.to_json(path)
+    # Checkpoint-restored online adoption: pool-specific AND newer.
+    store.put(make_entry(TunedParams(64), target=t, workload="live",
+                         pool="default", source="online", created=900.0))
+    store.merge_json(path, operator=True)
+    assert store.lookup(t, "default")["params"]["hot_window_slots"] == 4096
+    # Round-trip through a checkpoint: the flag is stripped, the online
+    # pool-specific entry wins again (the config no longer asserts it).
+    restored = TuningStore()
+    restored.load(store.dump())
+    assert restored.lookup(t, "default")["params"]["hot_window_slots"] == 64
+
+
+def test_offline_tuner_rejects_mixed_config_corpus(tmp_path):
+    """Bundles recorded under different scheduling configs cannot share
+    one baseline — the tuner refuses instead of mis-baselining."""
+    from armada_tpu.trace.replayer import Trace
+
+    trace = load_trace(FIXTURE)
+    other = Trace(
+        path="other", rounds=trace.rounds,
+        header=dict(trace.header, config_fingerprint="deadbeef"),
+    )
+    with pytest.raises(ValueError, match="different scheduling configs"):
+        tune_corpus([trace, other], [TunedParams(2, 0, 1)],
+                    allow_foreign=True)
+
+
+def test_tuning_store_checkpoint_roundtrip_across_restart(tmp_path):
+    """The store survives a simulated restart through CheckpointStore
+    (crc-guarded tmp+fsync+rename), and a fresh controller adopts the
+    restored vector at its first parameter resolution."""
+    from armada_tpu.services.checkpoint import CheckpointStore
+
+    store = TuningStore()
+    store.put(
+        make_entry(
+            TunedParams(7, 0, 2), target=current_target(),
+            workload="test-corpus", pool="default", source="offline",
+            baseline_s=1.0, tuned_s=0.5,
+        )
+    )
+    ck = CheckpointStore(str(tmp_path / "checkpoints"))
+    ck.save("autotune", 0, store.dump())
+
+    # ---- "restart": nothing shared but the checkpoint directory.
+    cursor, state = CheckpointStore(str(tmp_path / "checkpoints")).load(
+        "autotune"
+    )
+    restored = TuningStore()
+    restored.load(state)
+    assert len(restored) == 1
+    ctl = AutotuneController(
+        SchedulingConfig(autotune_enabled=True), store=restored
+    )
+    assert ctl.params_for("default") == TunedParams(7, 0, 2)
+    # A corrupt/absent checkpoint degrades to config defaults.
+    fresh = TuningStore()
+    fresh.load({"format": 999, "entries": {"x": {}}})
+    assert len(fresh) == 0
+
+
+def test_scheduler_adopts_restored_store_after_restart(tmp_path):
+    """End to end across the restart seam: seed a tuned vector, persist
+    it, reload it into a fresh controller, and drive a kernel-backend
+    sim — every recorded round's solver info must carry the tuned
+    window (the scheduler solved with the store's vector, not the
+    static config)."""
+    from armada_tpu.services.checkpoint import CheckpointStore
+    from armada_tpu.sim.simulator import (
+        ClusterSpec,
+        JobTemplate,
+        NodeTemplate,
+        QueueSpecSim,
+        ShiftedExponential,
+        Simulator,
+        WorkloadSpec,
+    )
+
+    ck = CheckpointStore(str(tmp_path / "checkpoints"))
+    seeded = TuningStore()
+    seeded.put(
+        make_entry(TunedParams(7, 0, 1), target=current_target(),
+                   workload="corpus", pool="default", source="offline")
+    )
+    ck.save("autotune", 0, seeded.dump())
+
+    # ---- restart: fresh store + controller from the checkpoint only.
+    restored = TuningStore()
+    restored.load(ck.load("autotune")[1])
+    cfg = SchedulingConfig(autotune_enabled=True)
+    ctl = AutotuneController(cfg, store=restored)
+    trace_path = str(tmp_path / "adopted.atrace")
+    sim = Simulator(
+        [ClusterSpec(name="c", node_templates=(NodeTemplate(count=2, cpu="8"),))],
+        WorkloadSpec(
+            queues=(
+                QueueSpecSim(
+                    name="q",
+                    job_templates=(
+                        JobTemplate(
+                            id="fit", number=4, cpu="2",
+                            runtime=ShiftedExponential(minimum=20.0),
+                        ),
+                    ),
+                ),
+            )
+        ),
+        config=cfg,
+        backend="kernel",
+        cycle_interval=10.0,
+        max_time=150.0,
+        trace_path=trace_path,
+        autotune=ctl,
+    )
+    res = sim.run()
+    assert res.finished_jobs == 4
+    trace = load_trace(trace_path)
+    assert trace.rounds, "no rounds recorded"
+    for rec in trace.rounds:
+        assert rec.raw["solver"]["autotuned"] is True
+        assert rec.raw["solver"]["window"] == 7

@@ -25,6 +25,12 @@ NEW_SCHEMA = {
         "value": 3.0,
         "extra": {
             "solve_s": 2.3,
+            # Headline per-segment solve profile (hot-window round on):
+            # pass1/gather gate alongside the cycle times.
+            "segments": {"pass1_s": 2.0, "gather_s": 0.2, "setup_s": 0.05},
+            # Effective solver parameters (autotune round on).
+            "params": {"hot_window_slots": 4096, "chunk_loops": 1,
+                       "fill_window": 2048, "tuned": False},
             "tracking_100k": {"cycle_s": 0.27},
             "burst_50k": {"cycle_s": 18.7},
         },
@@ -41,12 +47,14 @@ FAILED_RUN = {"rc": 1, "parsed": {"ok": False, "error": "boom"}}
 
 def test_parse_both_schemas():
     new = extract_metrics(parse_artifact(NEW_SCHEMA))
-    assert new == {"warm": 3.0, "tracking": 0.27, "burst": 18.7}
+    assert new == {"warm": 3.0, "tracking": 0.27, "burst": 18.7,
+                   "pass1": 2.0, "gather": 0.2}
+    # Old artifacts predate extra.segments: the segment metrics are
+    # None, never a crash or a phantom gate.
     old = extract_metrics(parse_artifact(OLD_SCHEMA))
-    assert old == {"warm": 1.2, "tracking": None, "burst": None}
-    assert extract_metrics(parse_artifact(BROKEN)) == {
-        "warm": None, "tracking": None, "burst": None,
-    }
+    assert old == {"warm": 1.2, "tracking": None, "burst": None,
+                   "pass1": None, "gather": None}
+    assert all(v is None for v in extract_metrics(parse_artifact(BROKEN)).values())
     # ok=false parsed blocks are failures, not baselines.
     assert parse_artifact(FAILED_RUN) is None
 
@@ -55,20 +63,44 @@ def test_gate_passes_within_threshold_and_fails_on_regression():
     base = {"warm": 3.0, "tracking": 0.27, "burst": 18.7}
     ok_current = {"warm": 3.2, "tracking": 0.28, "burst": 9.0}
     regressions, notes = gate(ok_current, base, threshold=1.15)
-    assert not regressions and len(notes) == 3
+    assert not regressions and sum("OK" in n for n in notes) == 3
     bad_current = {"warm": 4.0, "tracking": 0.28, "burst": 9.0}
     regressions, _ = gate(bad_current, base, threshold=1.15)
     assert len(regressions) == 1 and regressions[0].startswith("warm")
 
 
 def test_gate_skips_incomparable_metrics():
-    """Old baselines without burst numbers must not gate burst."""
+    """Old baselines without burst/segment numbers must not gate them."""
     base = {"warm": 1.2, "tracking": None, "burst": None}
     regressions, notes = gate(
-        {"warm": 1.0, "tracking": 0.3, "burst": 50.0}, base, 1.15
+        {"warm": 1.0, "tracking": 0.3, "burst": 50.0, "pass1": 9.0}, base, 1.15
     )
     assert not regressions
-    assert sum("not comparable" in n for n in notes) == 2
+    assert sum("not comparable" in n for n in notes) == 4
+
+
+def test_gate_per_segment_medians():
+    """A pass-1 or gather regression inside the solve gates on its own,
+    even when the end-to-end cycle stays within threshold; a segment
+    missing on either side (old artifacts) never gates."""
+    base = extract_metrics(parse_artifact(NEW_SCHEMA))
+    ok = dict(base, warm=3.1, pass1=2.1, gather=0.21)
+    regressions, _ = gate(ok, base, threshold=1.15)
+    assert not regressions
+    bad = dict(base, pass1=4.0)  # cycle unchanged, pass 1 doubled
+    regressions, _ = gate(bad, base, threshold=1.15)
+    assert len(regressions) == 1 and regressions[0].startswith("pass1")
+    # Sub-ms segment baselines are floored: doubling 0.4ms of gather is
+    # scheduler noise, not a regression.
+    tiny = dict(base, gather=0.0009)
+    regressions, _ = gate(dict(tiny, gather=0.002), tiny, threshold=1.15)
+    assert not regressions
+    # Old baseline without segments: current segments report as
+    # incomparable, never gate.
+    old = extract_metrics(parse_artifact(OLD_SCHEMA))
+    regressions, notes = gate(dict(base, warm=old["warm"]), old, threshold=1.15)
+    assert not regressions
+    assert sum("not comparable" in n for n in notes) >= 2
 
 
 def test_gate_cli_fails_on_crashed_bench(tmp_path):
@@ -136,3 +168,30 @@ def test_trend_handles_every_checked_in_artifact(tmp_path):
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "BENCH_r01.json" in proc.stdout
+
+
+def test_trend_shows_effective_params_column(tmp_path):
+    """The trend table carries the effective solver-parameter vector
+    (window/chunk, starred when tuned) for artifacts that record it and
+    '-' for older schemas."""
+    tuned = json.loads(json.dumps(NEW_SCHEMA))
+    tuned["parsed"]["extra"]["params"] = {
+        "hot_window_slots": 8192, "chunk_loops": 4, "tuned": True,
+    }
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(OLD_SCHEMA))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(NEW_SCHEMA))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(tuned))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "bench_trend.py"),
+            "--dir", str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "win/chunk" in proc.stdout
+    lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
+    assert "4096/1" in lines["BENCH_r02.json"]
+    assert "8192/4*" in lines["BENCH_r03.json"]
+    assert "4096" not in lines["BENCH_r01.json"]

@@ -42,7 +42,8 @@ def _assert_bit_exact(a, b, label):
         ), f"{label}: {k} diverges"
 
 
-def _dev(fast_fill=False, n_running=24, n_jobs=120, bw=4, gangs=3):
+def _dev(fast_fill=False, n_running=24, n_jobs=120, bw=4, gangs=3,
+         with_snap=False):
     """A round exercising eviction + fair preemption (one hog queue over
     fair share), gangs with and without uniformity constraints, and
     enough queued stream per queue that a tiny window must rewindow."""
@@ -107,7 +108,8 @@ def _dev(fast_fill=False, n_running=24, n_jobs=120, bw=4, gangs=3):
         for i in range(n_running)
     ]
     snap = build_round_snapshot(cfg, "default", nodes, queues, running, queued)
-    return pad_device_round(prep_device_round(snap))
+    dev = pad_device_round(prep_device_round(snap))
+    return (dev, snap) if with_snap else dev
 
 
 @pytest.mark.parametrize(
@@ -273,6 +275,75 @@ def test_sim_differential_compacted_vs_uncompacted():
     on = run(2)
     assert off == on
     assert off["finished"] > 0
+
+
+def test_window_size_autotuning(tmp_path):
+    """Closer for the hot-window-autotune gap: window sizing is no
+    longer static config. OFFLINE, the tuner (armada_tpu/autotune)
+    searches candidate windows over a recorded corpus, requiring
+    bit-exact replay, and selects a vector; ONLINE, the controller
+    grows a starved window (high rewindow rate) and shrinks an
+    oversized one (gather-dominated, zero rewindows) with hysteresis —
+    and every adopted window still solves bit-exactly, because the
+    window is a perf-only knob by construction."""
+    from armada_tpu.autotune import AutotuneController, TunedParams, tune_corpus
+    from armada_tpu.autotune.controller import REWINDOW_HIGH
+    from armada_tpu.trace import TraceRecorder, load_trace
+
+    dev, snap = _dev(fast_fill=True, with_snap=True)
+    fused = solve_round(dev)
+
+    # ---- offline: record one real round, tune a tiny grid over it.
+    path = str(tmp_path / "corpus.atrace")
+    with TraceRecorder(path, source="test", config=snap.config) as rec:
+        rec.record_round(
+            pool=snap.pool, dev=dev, decisions=fused,
+            num_jobs=snap.num_jobs, num_queues=snap.num_queues,
+            config=snap.config, solver={"backend": "kernel"},
+        )
+    report = tune_corpus(
+        [load_trace(path)],
+        [TunedParams(2, 0, 1), TunedParams(8, 0, 1)],
+        repeats=1,
+    )
+    assert report["ok"], report["results"]
+    selected = TunedParams.from_dict(report["selected"]["params"])
+    tuned_out = solve_round(
+        dev,
+        window=selected.hot_window_slots or None,
+        window_min_slots=selected.hot_window_min_slots,
+    )
+    _assert_bit_exact(fused, tuned_out, "offline-selected")
+
+    # ---- online: the hill-climb reacts to REAL solve profiles.
+    ctl = AutotuneController(
+        SchedulingConfig(
+            hot_window_slots=2, hot_window_min_slots=0,
+            batch_fill_window=2,  # lookahead floor below the test range
+            autotune_enabled=True, autotune_hysteresis_rounds=2,
+            autotune_min_window_slots=2, autotune_max_window_slots=64,
+        )
+    )
+    starved = solve_round(dev, window=2, window_min_slots=0)["profile"]
+    assert starved["compacted"]
+    assert starved["rewindows"] >= REWINDOW_HIGH, starved
+    assert ctl.observe_round("default", starved) is None  # hysteresis
+    adopted = ctl.observe_round("default", starved)
+    assert adopted is not None and adopted["direction"] == "grow"
+    assert ctl.params_for("default").hot_window_slots == 4
+    # An oversized window (gather dominates, nothing rewinds) shrinks
+    # back — after the cooldown, with the same hysteresis.
+    fat = {"compacted": True, "rewindows": 0, "gather_s": 0.3, "pass1_s": 0.1}
+    observed = [ctl.observe_round("default", fat) for _ in range(4)]
+    shrunk = [a for a in observed if a is not None]
+    assert len(shrunk) == 1 and shrunk[0]["direction"] == "shrink"
+    assert ctl.params_for("default").hot_window_slots == 2
+    # The adopted window is still bit-exact with the fused kernel.
+    adopted_out = solve_round(
+        dev, window=ctl.params_for("default").hot_window_slots,
+        window_min_slots=0,
+    )
+    _assert_bit_exact(fused, adopted_out, "online-adopted")
 
 
 @pytest.mark.skipif(

@@ -155,6 +155,29 @@ def test_every_labeled_family_live_after_short_sim(tmp_path):
             "compacted": True,
         },
     )
+    # Same contract for the autotune surface (armada_tpu/autotune): the
+    # oracle sim never runs the kernel's host-driven driver, so drive
+    # the controller wiring itself with a profile of the shape
+    # solve_round emits — the scheduler_autotune_* families prove they
+    # are connected (an adoption must fire the adjustments counter, the
+    # params gauges update on every observation).
+    from armada_tpu.autotune import AutotuneController
+
+    ctl = AutotuneController(
+        SchedulingConfig(
+            hot_window_slots=8, hot_window_min_slots=0,
+            autotune_enabled=True, autotune_hysteresis_rounds=1,
+            autotune_min_window_slots=4, autotune_max_window_slots=64,
+        )
+    )
+    sim.scheduler.attach_autotune(ctl)
+    adopted = ctl.observe_round(
+        "default",
+        {"compacted": True, "rewindows": 8, "gather_s": 0.01,
+         "pass1_s": 0.2},
+        metrics=m,
+    )
+    assert adopted is not None and adopted["direction"] == "grow"
     counts = _labeled_sample_counts(m)
     dead = sorted(
         name for name, n in counts.items()

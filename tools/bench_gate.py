@@ -13,10 +13,15 @@ rounds — BENCH_r03.json has no `parsed` block at all):
   - a raw bench stdout line: {"metric": ..., "value": ..., "extra": ...}.
 
 Gated metrics: the warm headline cycle, tracking_100k and burst_50k
-cycle times. A metric regresses when current > baseline * threshold; a
-metric missing on either side is reported but never gates (old
-artifacts predate burst_50k). Exits 1 on regression, 2 when no
-comparable baseline exists, 0 otherwise.
+cycle times, plus the headline cycle's per-segment medians — pass1 and
+gather seconds from `extra.segments` (the median-representative warm
+cycle's solve profile), so a regression INSIDE the solve (a pass-1
+slowdown hidden by a faster host phase, a gather/scatter blowup from a
+bad window) gates even when the end-to-end number still squeaks under
+the threshold. A metric regresses when current > baseline * threshold;
+a metric missing on either side is reported but never gates (old
+artifacts predate burst_50k and the segment profile). Exits 1 on
+regression, 2 when no comparable baseline exists, 0 otherwise.
 """
 
 from __future__ import annotations
@@ -53,10 +58,15 @@ def parse_artifact(doc: dict) -> dict | None:
     return None
 
 
+GATED = ("warm", "tracking", "burst", "pass1", "gather")
+
+
 def extract_metrics(result: dict | None) -> dict:
-    """{"warm": s|None, "tracking": s|None, "burst": s|None} from a
-    bench result dict; tolerant of every historical shape."""
-    out = {"warm": None, "tracking": None, "burst": None}
+    """{name: seconds|None} for every GATED metric from a bench result
+    dict; tolerant of every historical shape (pass1/gather come from
+    the headline config's extra.segments solve profile, absent before
+    the hot-window round)."""
+    out = {name: None for name in GATED}
     if not isinstance(result, dict):
         return out
     if isinstance(result.get("value"), (int, float)):
@@ -69,6 +79,11 @@ def extract_metrics(result: dict | None) -> dict:
                 sub.get("cycle_s"), (int, float)
             ):
                 out[name] = float(sub["cycle_s"])
+        segments = extra.get("segments")
+        if isinstance(segments, dict):
+            for seg, name in (("pass1_s", "pass1"), ("gather_s", "gather")):
+                if isinstance(segments.get(seg), (int, float)):
+                    out[name] = float(segments[seg])
     return out
 
 
@@ -76,12 +91,14 @@ def gate(current: dict, baseline: dict, threshold: float) -> tuple[list, list]:
     """(regressions, notes) comparing extract_metrics dicts. A metric
     regresses when current > baseline * threshold."""
     regressions, notes = [], []
-    for name in ("warm", "tracking", "burst"):
+    for name in GATED:
         cur, base = current.get(name), baseline.get(name)
         if cur is None or base is None:
             notes.append(f"{name}: not comparable (current={cur} baseline={base})")
             continue
-        limit = base * threshold
+        # Sub-ms segment baselines are scheduler noise, not signal: a
+        # 0.4ms gather doubling to 0.9ms must not fail the gate.
+        limit = max(base, 0.01) * threshold
         line = f"{name}: current {cur:.4f}s vs baseline {base:.4f}s (limit {limit:.4f}s)"
         if cur > limit:
             regressions.append(line)
@@ -111,7 +128,7 @@ def latest_baseline(search_dir: str) -> tuple[str | None, dict]:
         metrics = extract_metrics(parse_artifact(doc))
         if any(v is not None for v in metrics.values()):
             return path, metrics
-    return None, {"warm": None, "tracking": None, "burst": None}
+    return None, {name: None for name in GATED}
 
 
 def main(argv=None) -> int:

@@ -3,8 +3,9 @@
     python tools/bench_trend.py [--dir REPO]
 
 One row per artifact — warm headline, tracking_100k and burst_50k cycle
-times plus the solve share of the warm cycle — tolerant of every
-historical schema (BENCH_r03.json has no `parsed` block; burst_50k only
+times, the solve share of the warm cycle, and the effective solver
+parameters (hot window / chunk, starred when a BENCH_TUNED profile
+supplied them) — tolerant of every historical schema (BENCH_r03.json has no `parsed` block; burst_50k only
 exists from r05): a metric an artifact does not carry prints as "-",
 and an artifact nothing can be recovered from still gets a row.
 """
@@ -32,7 +33,7 @@ def rows(search_dir: str) -> list[dict]:
     ):
         row = {"round": os.path.basename(path), "warm": None,
                "tracking": None, "burst": None, "solve": None,
-               "trace": False}
+               "trace": False, "params": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -52,6 +53,16 @@ def rows(search_dir: str) -> list[dict]:
             # this artifact's workload is replayable by
             # tools/replay_gate.py against any candidate kernel.
             row["trace"] = True
+        params = extra.get("params") if isinstance(extra, dict) else None
+        if isinstance(params, dict):
+            # Effective headline solver parameters (window/chunk, "*"
+            # when a BENCH_TUNED profile supplied them); artifacts from
+            # before the autotune round simply lack the block.
+            row["params"] = (
+                f"{params.get('hot_window_slots', 0)}"
+                f"/{params.get('chunk_loops', 1)}"
+                + ("*" if params.get("tuned") else "")
+            )
         out.append(row)
     return out
 
@@ -66,7 +77,7 @@ def main(argv=None) -> int:
         return 1
     header = (
         f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} "
-        f"{'burst_s':>8} {'trace':>6}"
+        f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6}"
     )
     print(header)
     print("-" * len(header))
@@ -74,6 +85,7 @@ def main(argv=None) -> int:
         print(
             f"{r['round']:<18} {_fmt(r['warm']):>8} {_fmt(r['solve']):>8} "
             f"{_fmt(r['tracking']):>10} {_fmt(r['burst']):>8} "
+            f"{r.get('params') or '-':>10} "
             f"{'yes' if r.get('trace') else '-':>6}"
         )
     return 0
