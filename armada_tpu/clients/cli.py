@@ -173,6 +173,133 @@ def cmd_job_trace(args):
         print(trace["rendered"])
 
 
+def _whatif_mutations(args) -> list[dict]:
+    """Mutation dicts from the repeatable whatif flags (the same
+    vocabulary every surface speaks, whatif/mutations.py)."""
+    mutations = []
+    for nid in args.cordon_node or []:
+        mutations.append({"kind": "cordon_node", "name": nid})
+    for nid in args.uncordon_node or []:
+        mutations.append({"kind": "uncordon_node", "name": nid})
+    for nid in args.remove_node or []:
+        mutations.append({"kind": "remove_node", "name": nid})
+    for name in args.cordon_executor or []:
+        mutations.append({"kind": "cordon_executor", "name": name})
+    for name in args.drain_executor or []:
+        mutations.append({"kind": "drain_executor", "name": name})
+    for spec in args.add_nodes or []:
+        # COUNT[:CPU[:MEMORY[:GPU]]]
+        parts = spec.split(":")
+        try:
+            m = {"kind": "add_nodes", "count": int(parts[0])}
+        except ValueError:
+            raise SystemExit(
+                "--add-nodes wants COUNT[:CPU[:MEMORY[:GPU]]], "
+                f"got {spec!r}"
+            ) from None
+        if len(parts) > 1:
+            m["cpu"] = parts[1]
+        if len(parts) > 2:
+            m["memory"] = parts[2]
+        if len(parts) > 3:
+            m["gpu"] = parts[3]
+        mutations.append(m)
+    for spec in args.inject_gang or []:
+        # QUEUE:CARDINALITY[:CPU[:MEMORY[:GPU]]]
+        parts = spec.split(":")
+        try:
+            m = {
+                "kind": "inject_gang",
+                "queue": parts[0],
+                "gang_cardinality": int(parts[1]),
+            }
+        except (IndexError, ValueError):
+            raise SystemExit(
+                "--inject-gang wants QUEUE:CARDINALITY[:CPU[:MEMORY"
+                f"[:GPU]]], got {spec!r}"
+            ) from None
+        if len(parts) > 2:
+            m["cpu"] = parts[2]
+        if len(parts) > 3:
+            m["memory"] = parts[3]
+        if len(parts) > 4:
+            m["gpu"] = parts[4]
+        mutations.append(m)
+    for spec in args.scale_queue or []:
+        name, _, weight = spec.partition("=")
+        try:
+            mutations.append(
+                {"kind": "scale_queue", "name": name,
+                 "weight": float(weight)}
+            )
+        except ValueError:
+            raise SystemExit(
+                f"--scale-queue wants NAME=WEIGHT, got {spec!r}"
+            ) from None
+    return mutations
+
+
+def cmd_whatif(args):
+    """Shadow-solve hypothetical fleet edits against the live round
+    fork: displaced jobs and their landings, injected-gang ETAs in
+    rounds, per-queue/per-pool headroom (armada_tpu/whatif)."""
+    client = connect(args.server, ca_cert=args.ca_cert or None)
+    mutations = _whatif_mutations(args)
+    out = client.what_if(
+        mutations, pool=args.pool, solver=args.solver, rounds=args.rounds
+    )
+    if args.json:
+        _print(out["plan"])
+    else:
+        print(out["rendered"])
+
+
+def cmd_drain(args):
+    """Drain an executor safely: `--dry-run` (default) predicts the
+    outcome via a forked shadow solve; `--execute` runs the REAL staged
+    drain (cordon -> voluntary completion -> gang-aware preempt-requeue
+    at the deadline); `--status` polls an active drain."""
+    client = connect(args.server, ca_cert=args.ca_cert or None)
+    if args.status:
+        status = client.execute_drain(args.executor, status_only=True)
+        _print(status) if args.json else print(_render_drain_status(status))
+        return
+    if args.execute:
+        status = client.execute_drain(
+            args.executor, deadline_s=args.deadline_s
+        )
+        _print(status) if args.json else print(_render_drain_status(status))
+        return
+    out = client.plan_drain(
+        args.executor,
+        pool=args.pool,
+        solver=args.solver,
+        rounds=args.rounds,
+        deadline_s=args.deadline_s,
+    )
+    if args.json:
+        _print(out["plan"])
+    else:
+        print(out["rendered"])
+
+
+def _render_drain_status(status: dict) -> str:
+    if not isinstance(status, dict) or "executor" not in status:
+        # status(None): every active drain keyed by executor.
+        return json.dumps(status, indent=2, default=str)
+    rounds = status.get("rounds_to_drain")
+    return (
+        f"drain {status['executor']}: {status.get('state')} "
+        f"(round {status.get('rounds', 0)}, deadline "
+        f"{status.get('deadline_s')}s)\n"
+        f"  completed {len(status.get('completed', []))} · preempted "
+        f"{len(status.get('preempted', []))} · blocked "
+        f"{len(status.get('blocked', []))} · landed "
+        f"{len(status.get('landings', {}))}"
+        + (f"\n  drained in {rounds} rounds" if rounds is not None else "")
+    )
+
+
 def cmd_server(args):
     from ..core.config import SchedulingConfig
     from ..services.server import ControlPlane
@@ -308,6 +435,52 @@ def build_parser():
     jt.add_argument("--json", action="store_true",
                     help="raw journey record instead of the rendered text")
     jt.set_defaults(fn=cmd_job_trace)
+
+    wi = sub.add_parser(
+        "whatif",
+        help="shadow-solve hypothetical fleet edits (cordon/drain/"
+        "inject-gang/...) against a fork of the live round",
+    )
+    wi.add_argument("--pool", default="")
+    wi.add_argument(
+        "--solver", default="",
+        help="shadow solver spec: oracle | LOCAL | hotwindow[:W] | 2x4",
+    )
+    wi.add_argument("--rounds", type=int, default=0,
+                    help="rollout horizon in scheduling rounds")
+    wi.add_argument("--json", action="store_true")
+    wi.add_argument("--cordon-node", action="append", metavar="NODE")
+    wi.add_argument("--uncordon-node", action="append", metavar="NODE")
+    wi.add_argument("--remove-node", action="append", metavar="NODE")
+    wi.add_argument("--cordon-executor", action="append", metavar="NAME")
+    wi.add_argument("--drain-executor", action="append", metavar="NAME")
+    wi.add_argument("--add-nodes", action="append",
+                    metavar="COUNT[:CPU[:MEM[:GPU]]]")
+    wi.add_argument("--inject-gang", action="append",
+                    metavar="QUEUE:CARD[:CPU[:MEM[:GPU]]]")
+    wi.add_argument("--scale-queue", action="append", metavar="NAME=WEIGHT")
+    wi.set_defaults(fn=cmd_whatif)
+
+    dr = sub.add_parser(
+        "drain",
+        help="drain an executor: --dry-run predicts (forked shadow "
+        "solve), --execute runs the staged drain for real",
+    )
+    dr.add_argument("executor")
+    group = dr.add_mutually_exclusive_group()
+    group.add_argument("--dry-run", action="store_true",
+                       help="predict the outcome (default)")
+    group.add_argument("--execute", action="store_true",
+                       help="start (or poll) the real drain")
+    group.add_argument("--status", action="store_true",
+                       help="poll the active drain's status")
+    dr.add_argument("--deadline-s", type=float, default=None,
+                    help="voluntary-completion window before preemption")
+    dr.add_argument("--pool", default="")
+    dr.add_argument("--solver", default="")
+    dr.add_argument("--rounds", type=int, default=0)
+    dr.add_argument("--json", action="store_true")
+    dr.set_defaults(fn=cmd_drain)
 
     srv = sub.add_parser("server", help="run a local control plane")
     srv.add_argument("--port", type=int, default=50051)

@@ -206,6 +206,20 @@ class SchedulingConfig:
     # Bounds of the online hill-climb's window moves (pow2 steps).
     autotune_min_window_slots: int = 64
     autotune_max_window_slots: int = 1 << 16
+    # What-if planner (armada_tpu/whatif): shadow solves over forked
+    # round state run on a bounded worker pool off the round thread.
+    # `whatif_workers` sizes the pool; `whatif_queue_depth` bounds the
+    # pending-plan backlog (excess requests are rejected with
+    # RESOURCE_EXHAUSTED — backpressure, never round-thread latency);
+    # `whatif_default_rounds` caps the bounded multi-round rollout a
+    # plan simulates (gang ETA / requeue landing horizon).
+    whatif_workers: int = 1
+    whatif_queue_depth: int = 8
+    whatif_default_rounds: int = 8
+    # Default drain deadline: cordon -> wait for voluntary completion ->
+    # preempt stragglers once this many seconds have passed
+    # (armada_tpu/whatif/drain.py; 0 = preempt immediately).
+    drain_deadline_s: float = 600.0
     executor_timeout_s: float = 600.0
     # Lease TTL advertised to executor agents in every lease reply: an
     # agent that cannot complete a lease exchange for this long must
@@ -458,6 +472,10 @@ class SchedulingConfig:
             ("spotPriceCutoff", "spot_price_cutoff", float),
             ("shortJobPenaltySeconds", "short_job_penalty_s", float),
             ("executorTimeout", "executor_timeout_s", float),
+            ("whatifWorkers", "whatif_workers", int),
+            ("whatifQueueDepth", "whatif_queue_depth", int),
+            ("whatifDefaultRounds", "whatif_default_rounds", int),
+            ("drainDeadlineSeconds", "drain_deadline_s", float),
             ("executorLeaseTTL", "executor_lease_ttl_s", float),
             ("maxSchedulingDuration", "max_scheduling_duration_s", float),
             (

@@ -111,9 +111,17 @@ class JobRunErrors(Event):
 
 @dataclass(frozen=True)
 class JobRunPreempted(Event):
+    """The run was preempted. By default the JOB is terminal too (the
+    reference's preemption semantics: the user resubmits). With
+    `requeue=True` only the RUN dies and the job returns to QUEUED —
+    the drain orchestrator's preempt-and-requeue path, where displaced
+    work must reschedule elsewhere instead of failing
+    (armada_tpu/whatif/drain.py)."""
+
     job_id: str = ""
     run_id: str = ""
     reason: str = ""
+    requeue: bool = False
 
 
 @dataclass(frozen=True)

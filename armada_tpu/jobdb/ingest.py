@@ -120,9 +120,16 @@ def _apply_event(txn, seq: ev.EventSequence, event, error_rules=()) -> None:
         run = job.latest_run
         if run and run.id == event.run_id and run.state in _LIVE_RUN:
             run = replace(run, state=RunState.PREEMPTED, finished=event.created)
-            txn.upsert(
-                job.with_(state=JobState.PREEMPTED, runs=job.runs[:-1] + (run,))
+            # requeue=True (drain orchestration): only the run dies; the
+            # job goes back to QUEUED to reschedule elsewhere — same
+            # job-level outcome as the JobRunErrors+JobRequeued expiry
+            # path, but the run records a preemption with its reason.
+            state = (
+                JobState.QUEUED
+                if getattr(event, "requeue", False)
+                else JobState.PREEMPTED
             )
+            txn.upsert(job.with_(state=state, runs=job.runs[:-1] + (run,)))
     elif isinstance(event, ev.JobRunErrors):
         run = job.latest_run
         if run and run.id == event.run_id and run.state in _LIVE_RUN:

@@ -346,10 +346,24 @@ class FakeExecutor:
             self.accept_leases(now)
         self._check_pod_issues(now)
         txn = self.scheduler.jobdb.read_txn()
+        from ..jobdb.jobdb import RunState as _RS
+
         for run in list(self.active.values()):
             job = txn.get(run.job_id)
-            if job is None or job.state.terminal:
-                # cancelled or preempted underneath us
+            latest = job.latest_run if job is not None else None
+            if (
+                job is None
+                or job.state.terminal
+                # Our run died while the JOB lives on: a drain's
+                # preempt-requeue (run PREEMPTED, job back QUEUED) or a
+                # supersession — the pod must be torn down here exactly
+                # like the real agent kills cancelled pods, or a
+                # requeued job would run twice.
+                or latest is None
+                or latest.id != run.run_id
+                or latest.state
+                not in (_RS.LEASED, _RS.PENDING, _RS.RUNNING)
+            ):
                 self.active.pop(run.run_id, None)
                 self._issues.pop(run.run_id, None)
                 continue

@@ -208,6 +208,32 @@ def _lease_resp_to_proto_dict(out: dict) -> dict:
     return {**out, "leases": leases}
 
 
+def _whatif_req_from_proto_dict(req: dict) -> dict:
+    """WhatIf/PlanDrain/ExecuteDrain json_format dict -> the JSON
+    handler's layout: zero-valued optional scalars mean "unset" on the
+    proto wire (proto3 has no presence for them), so strip them and the
+    handlers apply the configured defaults."""
+    out = {k: v for k, v in req.items() if v not in ("", 0, 0.0, False)}
+    mutations = [
+        {k: v for k, v in m.items() if v not in ("", 0, 0.0, False)}
+        for m in req.get("mutations", ())
+    ]
+    if mutations:
+        out["mutations"] = mutations
+    return out
+
+
+def _plan_resp_to_proto_dict(out: dict) -> dict:
+    return {
+        "plan_json": json.dumps(out.get("plan") or {}, default=str),
+        "rendered": out.get("rendered", ""),
+    }
+
+
+def _status_resp_to_proto_dict(out: dict) -> dict:
+    return {"status_json": json.dumps(out.get("status") or {}, default=str)}
+
+
 class ProtoExecutorClient:
     """Executor-agent connector over the binary-protobuf wire: implements
     the agent's `_call` surface (ExecutorLease / ReportEvents) with
@@ -380,6 +406,11 @@ class ApiServer:
             az.authorize_global(principal, A.DELETE_QUEUE)
         elif method in ("CordonNode", "CordonExecutor", "SetPriorityOverride"):
             az.authorize_global(principal, A.CORDON)
+        elif method == "ExecuteDrain":
+            # Draining cordons + preempts: the same privilege as cordon.
+            # WhatIf/PlanDrain are read-only shadow solves — any
+            # authenticated principal may ask.
+            az.authorize_global(principal, A.CORDON)
         elif method in ("ExecutorLease", "ReportEvents"):
             az.authorize_global(principal, A.EXECUTE_JOBS)
         elif method == "WatchJobSet":
@@ -546,6 +577,65 @@ class ApiServer:
             "journey": doc,
             "rendered": timeline.render(req["job_id"], doc=doc),
         }
+
+    # ---- what-if planner (armada_tpu/whatif) ----
+
+    def _whatif_service(self):
+        svc = getattr(self.scheduler, "whatif", None)
+        if svc is None:
+            raise KeyError("what-if planner not enabled on this server")
+        return svc
+
+    @staticmethod
+    def _opt_float(req, key):
+        value = req.get(key)
+        return float(value) if value is not None else None
+
+    def _what_if(self, req):
+        """Shadow-solve a mutated fork of the last round and return the
+        structured plan (displacements, gang ETAs, headroom). Runs on
+        the planner's bounded worker — a full backlog fails fast with
+        RESOURCE_EXHAUSTED instead of queueing."""
+        from ..whatif import mutations_from_dicts
+
+        svc = self._whatif_service()
+        plan = svc.plan(
+            mutations_from_dicts(req.get("mutations", [])),
+            pool=req.get("pool") or None,
+            solver=req.get("solver") or None,
+            rounds=int(req["rounds"]) if req.get("rounds") else None,
+        )
+        return {"plan": plan.to_dict(), "rendered": plan.render()}
+
+    def _plan_drain(self, req):
+        """Dry-run a drain: predicted voluntary completions, deadline
+        preemptions (gang-aware), requeue landings, rounds-to-drain —
+        produced by the SAME DrainController execution runs."""
+        svc = self._whatif_service()
+        plan = svc.plan_drain(
+            req["executor"],
+            pool=req.get("pool") or None,
+            solver=req.get("solver") or None,
+            rounds=int(req["rounds"]) if req.get("rounds") else None,
+            deadline_s=self._opt_float(req, "deadline_s"),
+        )
+        return {"plan": plan.to_dict(), "rendered": plan.render()}
+
+    def _execute_drain(self, req):
+        """Start (idempotent) or poll a REAL staged drain through the
+        control-plane event path."""
+        svc = self._whatif_service()
+        if req.get("status_only"):
+            status = svc.drain_status(req.get("executor") or None)
+            if status is None:
+                raise KeyError(
+                    f"no drain recorded for executor {req.get('executor')!r}"
+                )
+            return {"status": status}
+        status = svc.execute_drain(
+            req["executor"], deadline_s=self._opt_float(req, "deadline_s")
+        )
+        return {"status": status}
 
     def _set_priority_override(self, req):
         self.scheduler.set_priority_override(
@@ -730,14 +820,22 @@ class ApiServer:
         # gets its cancel then; and runs that never produced a pod never
         # trigger resends. Resolved per acked run id via the run index.
         for rid in acked:
-            job = txn.job_for_run(rid)
-            if (
-                job is not None
-                and job.state
-                in (JobState.CANCELLED, JobState.PREEMPTED, JobState.FAILED)
-                and job.latest_run is not None
-                and job.latest_run.executor == name
-            ):
+            job = txn.job_for_any_run(rid)
+            if job is None:
+                continue
+            owned = next((r for r in job.runs if r.id == rid), None)
+            if owned is None or owned.executor != name:
+                continue
+            from ..jobdb.jobdb import RunState as _RS
+
+            if job.state in (
+                JobState.CANCELLED,
+                JobState.PREEMPTED,
+                JobState.FAILED,
+            ) or owned.state == _RS.PREEMPTED:
+                # Job killed underneath the executor — or the RUN alone
+                # was preempt-requeued (a drain's deadline preemption:
+                # the job lives on elsewhere, THIS pod must die).
                 cancels.append({"run_id": rid, "job_id": job.id})
         # The jobs' submit trace contexts, batched (one ledger lock for
         # the whole reply): the agent echoes each lease's traceparent on
@@ -1056,9 +1154,30 @@ class ApiServer:
                 pb.ExecutorSyncRequest,
                 pb.ExecutorSyncResponse,
             ),
+            # What-if planner (armada_tpu/whatif): structured plans and
+            # drain statuses travel as JSON strings on this wire.
+            "WhatIf": (pb.WhatIfRequest, pb.WhatIfResponse),
+            "PlanDrain": (pb.PlanDrainRequest, pb.PlanDrainResponse),
+            "ExecuteDrain": (
+                pb.ExecuteDrainRequest,
+                pb.ExecuteDrainResponse,
+            ),
         }
-        req_transforms = {"ExecutorLease": _lease_req_from_proto_dict}
-        resp_transforms = {"ExecutorLease": _lease_resp_to_proto_dict}
+        req_transforms = {
+            "ExecutorLease": _lease_req_from_proto_dict,
+            # proto3 cannot distinguish unset from zero: a zero-valued
+            # deadline/rounds/solver from MessageToDict means "default"
+            # on this wire (the JSON wire keeps explicit 0 semantics).
+            "WhatIf": _whatif_req_from_proto_dict,
+            "PlanDrain": _whatif_req_from_proto_dict,
+            "ExecuteDrain": _whatif_req_from_proto_dict,
+        }
+        resp_transforms = {
+            "ExecutorLease": _lease_resp_to_proto_dict,
+            "WhatIf": _plan_resp_to_proto_dict,
+            "PlanDrain": _plan_resp_to_proto_dict,
+            "ExecuteDrain": _status_resp_to_proto_dict,
+        }
         if method == "WatchJobSet":
             def stream(request, context):
                 msg = pb.WatchRequest.FromString(request)
@@ -1105,6 +1224,7 @@ class ApiServer:
             if req_tf is not None:
                 req = req_tf(req)
             gate(method, req, context)
+            from ..whatif.planner import WhatIfBusyError
             from .chaos import CircuitOpenError
 
             with _rpc_span(method, context):
@@ -1116,6 +1236,10 @@ class ApiServer:
                     context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 except CircuitOpenError as e:
                     context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                except WhatIfBusyError as e:
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED, str(e)
+                    )
                 except FencedError as e:
                     context.abort(
                         grpc.StatusCode.FAILED_PRECONDITION, str(e)
@@ -1168,6 +1292,9 @@ class ApiServer:
             "ReportEvents": self._report_events,
             "ExecutorSync": self._executor_sync,
             "CordonExecutor": self._cordon_executor,
+            "WhatIf": self._what_if,
+            "PlanDrain": self._plan_drain,
+            "ExecuteDrain": self._execute_drain,
         }
 
     def serve(self, port: int = 0, max_workers: int = 16, max_watchers: int | None = None,
@@ -1245,6 +1372,7 @@ class ApiServer:
                     return None
 
                 def unary(request, context):
+                    from ..whatif.planner import WhatIfBusyError
                     from .chaos import CircuitOpenError
 
                     req = _decode(request)
@@ -1260,6 +1388,10 @@ class ApiServer:
                             )
                         except CircuitOpenError as e:
                             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                        except WhatIfBusyError as e:
+                            context.abort(
+                                grpc.StatusCode.RESOURCE_EXHAUSTED, str(e)
+                            )
                         except FencedError as e:
                             context.abort(
                                 grpc.StatusCode.FAILED_PRECONDITION, str(e)
@@ -1436,6 +1568,43 @@ class ApiClient:
             "lines"
         ]
 
+    def what_if(self, mutations, pool=None, solver=None, rounds=None):
+        """Shadow-solve hypothetical edits against the live round fork:
+        {"plan": <structured plan>, "rendered": <text>}. `mutations` is
+        a list of {"kind": ..., ...} dicts (whatif/mutations.py)."""
+        return self._call(
+            "WhatIf",
+            {
+                "mutations": list(mutations),
+                "pool": pool or "",
+                "solver": solver or "",
+                "rounds": rounds or 0,
+            },
+        )
+
+    def plan_drain(self, executor, pool=None, solver=None, rounds=None,
+                   deadline_s=None):
+        return self._call(
+            "PlanDrain",
+            {
+                "executor": executor,
+                "pool": pool or "",
+                "solver": solver or "",
+                "rounds": rounds or 0,
+                "deadline_s": deadline_s,
+            },
+        )
+
+    def execute_drain(self, executor, deadline_s=None, status_only=False):
+        return self._call(
+            "ExecuteDrain",
+            {
+                "executor": executor,
+                "deadline_s": deadline_s,
+                "status_only": bool(status_only),
+            },
+        )["status"]
+
     def cordon_node(self, node_id, uncordon=False):
         self._call("CordonNode", {"node_id": node_id, "uncordon": uncordon})
 
@@ -1545,6 +1714,77 @@ class ProtoApiClient:
             ),
             pb.JobReprioritizeResponse,
         )
+
+    @staticmethod
+    def _whatif_mutation_fields(m: dict) -> dict:
+        """JSON-vocabulary mutation dict -> WhatIfMutation field kwargs.
+        The proto message carries cpu/memory/gpu scalars instead of the
+        JSON wire's `requests` map; translate the common keys and refuse
+        anything the binary wire cannot express."""
+        m = dict(m)
+        requests = m.pop("requests", None) or {}
+        scalar_of = {"cpu": "cpu", "memory": "memory", "nvidia.com/gpu": "gpu"}
+        for key, value in requests.items():
+            field = scalar_of.get(key)
+            if field is None:
+                raise ValueError(
+                    f"the proto wire cannot express request {key!r}; use "
+                    "the JSON wire (ApiClient.what_if) for arbitrary "
+                    "resource maps"
+                )
+            m.setdefault(field, str(value))
+        for key in ("node_selector", "labels"):
+            if m.pop(key, None):
+                raise ValueError(
+                    f"the proto wire cannot express {key!r}; use the JSON "
+                    "wire (ApiClient.what_if)"
+                )
+        return m
+
+    def what_if(self, mutations, pool="", solver="", rounds=0) -> dict:
+        """WhatIf over the binary wire; returns the decoded plan dict
+        (the JSON wire's {"plan", "rendered"} shape)."""
+        from ..proto import armada_pb2 as pb
+
+        req = pb.WhatIfRequest(pool=pool, solver=solver, rounds=rounds)
+        for m in mutations:
+            req.mutations.add(**self._whatif_mutation_fields(m))
+        resp = self._unary("WhatIf", req, pb.WhatIfResponse)
+        return {
+            "plan": json.loads(resp.plan_json) if resp.plan_json else {},
+            "rendered": resp.rendered,
+        }
+
+    def plan_drain(self, executor, pool="", solver="", rounds=0,
+                   deadline_s=0.0) -> dict:
+        from ..proto import armada_pb2 as pb
+
+        resp = self._unary(
+            "PlanDrain",
+            pb.PlanDrainRequest(
+                executor=executor, pool=pool, solver=solver, rounds=rounds,
+                deadline_s=deadline_s,
+            ),
+            pb.PlanDrainResponse,
+        )
+        return {
+            "plan": json.loads(resp.plan_json) if resp.plan_json else {},
+            "rendered": resp.rendered,
+        }
+
+    def execute_drain(self, executor, deadline_s=0.0,
+                      status_only=False) -> dict:
+        from ..proto import armada_pb2 as pb
+
+        resp = self._unary(
+            "ExecuteDrain",
+            pb.ExecuteDrainRequest(
+                executor=executor, deadline_s=deadline_s,
+                status_only=status_only,
+            ),
+            pb.ExecuteDrainResponse,
+        )
+        return json.loads(resp.status_json) if resp.status_json else {}
 
     def watch_jobset(self, queue, jobset, from_offset=0, follow=True):
         """Yields (offset, events.model.EventSequence)."""

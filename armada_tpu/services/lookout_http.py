@@ -320,6 +320,52 @@ class LookoutHttpServer:
                                    404)
                         return
                     self._json({"job_id": job_id, "lines": lines})
+                elif parsed.path == "/api/whatif":
+                    # What-if planner (armada_tpu/whatif). Without
+                    # params: recent plans + active drain statuses.
+                    # With ?queue=Q&gang=N[&cpu=&memory=&gpu=][&solver=]
+                    # [&rounds=]: run a gang-injection what-if on the
+                    # bounded planner worker (503 on backpressure).
+                    svc = getattr(outer.scheduler, "whatif", None)
+                    if svc is None:
+                        self._json({"error": "what-if planner not "
+                                    "enabled"}, 503)
+                        return
+                    if params.get("queue") and params.get("gang"):
+                        from ..whatif import mutations_from_dicts
+                        from ..whatif.planner import WhatIfBusyError
+
+                        mutation = {
+                            "kind": "inject_gang",
+                            "queue": params["queue"],
+                            "gang_cardinality": int(params["gang"]),
+                        }
+                        for key in ("cpu", "memory", "gpu"):
+                            if params.get(key):
+                                mutation[key] = params[key]
+                        try:
+                            plan = svc.plan(
+                                mutations_from_dicts([mutation]),
+                                pool=params.get("pool") or None,
+                                solver=params.get("solver") or None,
+                                rounds=int(params["rounds"])
+                                if params.get("rounds")
+                                else None,
+                            )
+                        except WhatIfBusyError as e:
+                            self._json({"error": str(e)}, 503)
+                            return
+                        self._json(
+                            {"plan": plan.to_dict(),
+                             "rendered": plan.render()}
+                        )
+                        return
+                    self._json(
+                        {
+                            "plans": list(svc.recent),
+                            "drains": svc.drain_status() or {},
+                        }
+                    )
                 elif parsed.path.startswith("/api/jobtrace/"):
                     # Job journey (services/job_timeline.py): transitions
                     # + aggregated unschedulable-round history + trace id.

@@ -358,6 +358,42 @@ class SchedulerMetrics:
             "online adoptions)",
             registry=r,
         )
+        # ---- what-if planner (armada_tpu/whatif): plan volume/latency
+        # on the bounded off-round-thread worker, the pending backlog
+        # the backpressure cap guards, and drain progress through the
+        # staged cordon -> voluntary completion -> preempt-requeue path.
+        self.whatif_plans = Counter(
+            "whatif_plans_total",
+            "What-if plans completed, by kind (whatif / drain / parity)",
+            ["kind"],
+            registry=r,
+        )
+        self.whatif_plan_seconds = Histogram(
+            "whatif_plan_seconds",
+            "Wall clock of one what-if plan (fork + mutate + bounded "
+            "rollout + diff), by kind",
+            ["kind"],
+            buckets=(0.01, 0.05, 0.2, 1, 5, 20, 60, 300),
+            registry=r,
+        )
+        self.whatif_queue_depth = Gauge(
+            "whatif_queue_depth",
+            "What-if plans pending on the bounded planner worker",
+            registry=r,
+        )
+        self.drain_jobs_preempted = Counter(
+            "drain_jobs_preempted_total",
+            "Jobs preempt-requeued by a drain's deadline (gang-aware)",
+            ["executor"],
+            registry=r,
+        )
+        self.drain_jobs_completed = Counter(
+            "drain_jobs_completed_total",
+            "Drained-executor jobs that completed voluntarily before "
+            "the drain deadline",
+            ["executor"],
+            registry=r,
+        )
         self.anti_entropy_resolutions = Counter(
             "scheduler_anti_entropy_resolutions_total",
             "Run resolutions produced by post-partition ExecutorSync "

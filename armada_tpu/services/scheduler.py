@@ -152,6 +152,20 @@ class SchedulerService:
         # feeds its solve profile back so the bounded hill-climb can
         # adjust between rounds (attach_autotune).
         self.autotune = None
+        # What-if planner (armada_tpu/whatif): when a fork capture is
+        # attached, every REBUILD-path round hands it references to the
+        # already-built round inputs + decisions right after the solve
+        # (the flight-recorder seam) — forking costs no extra array
+        # builds on the round thread. `whatif` is the planner service
+        # the RPC surfaces reach through the scheduler.
+        self.fork_capture = None
+        self.whatif = None
+        # Staged executor drains (whatif/drain.py): cordon -> voluntary
+        # completion -> deadline preempt-requeue, stepped once per cycle
+        # through the same event path as every other transition.
+        from ..whatif.drain import DrainCoordinator
+
+        self.drains = DrainCoordinator(self)
         # Round-deadline guardrail (maxSchedulingDuration): wall-clock
         # deadline for the current cycle's rounds, armed per cycle in
         # _schedule_all_pools; pools share the budget in round order.
@@ -226,6 +240,17 @@ class SchedulerService:
         the controller's hysteresis'd hill-climb. Only perf-only knobs
         ever move — placements are bit-exact regardless."""
         self.autotune = controller
+
+    def attach_fork_capture(self, capture):
+        """Start handing every rebuild-path round's inputs + decisions
+        to the what-if fork capture (references only; see
+        armada_tpu/whatif/fork.py)."""
+        self.fork_capture = capture
+
+    def attach_whatif(self, service):
+        """Attach the what-if planner service (armada_tpu/whatif): the
+        gRPC/lookout surfaces reach it via `scheduler.whatif`."""
+        self.whatif = service
 
     def _trace_round(self, snap, dev, decisions, *, solver, truncated,
                      solve_s, profile=None):
@@ -522,6 +547,12 @@ class SchedulerService:
         sequences += self._expire_stale_executors(now)
         sequences += self._handle_failed_runs(now)
         sequences += self._reconcile_runs(now)
+        # Staged executor drains (whatif/drain.py): cordon is published
+        # by the controller itself; deadline preempt-requeues ride this
+        # cycle's sequences (leader-gated with everything else) and
+        # apply before the NEXT cycle's round, which then reschedules
+        # the displaced jobs off the cordoned executor.
+        sequences += self.drains.step(now)
 
         # Scheduling through the runner seam: sync solves inline; async
         # applies the previous solve's result first and only starts the next
@@ -940,16 +971,26 @@ class SchedulerService:
         borrower_pools = {
             p.name for p in self.config.pools if pool in p.away_pools
         }
+        import dataclasses as _dc_nodes
+
         nodes: list[NodeSpec] = []
         node_executor: dict[str, str] = {}
         for hb in executors.values():
-            if hb.name in skipped:
-                continue
             for node in hb.nodes:
                 # Per-node pools (node_group.go GetPool): an executor's
                 # nodes may span pools; match each node, not the cluster.
                 if (node.pool or hb.pool) not in allowed_pools:
                     continue
+                if hb.name in skipped and not node.unschedulable:
+                    # Skipped (cordoned / lagging) executors take no NEW
+                    # placements but their nodes stay IN the round as
+                    # unschedulable, keeping running jobs bound — a
+                    # cordon must not read as "nodes vanished", which
+                    # would dangle running jobs at NO_NODE and let the
+                    # solver gang-preempt their mates the next cycle
+                    # (the drain orchestrator relies on this: cordon
+                    # first, preempt only at ITS deadline).
+                    node = _dc_nodes.replace(node, unschedulable=True)
                 nodes.append(node)
                 node_executor[node.id] = hb.name
 
@@ -1142,6 +1183,36 @@ class SchedulerService:
             )
         solve_started = _time.time()
         result = self._solve(snap, inc=inc)
+        if self.fork_capture is not None and inc is None:
+            # What-if fork seam (armada_tpu/whatif/fork.py): references
+            # to the round's already-built inputs + decision arrays —
+            # every referenced object is frozen or freshly built this
+            # round, so this costs a few small copies, never an array
+            # build. Incremental rounds share mutable snapshot state
+            # across cycles and are skipped (the planner falls back to
+            # a jobdb fork off the round thread). Advisory: a capture
+            # failure must never fail the round.
+            try:
+                self.fork_capture.capture(
+                    pool=pool,
+                    cycle=self.cycle_count,
+                    now=now,
+                    config=self.config,
+                    snap=snap,
+                    result=result,
+                    inputs=(nodes, queues, running, queued, excluded_nodes),
+                    node_executor=dict(node_executor),
+                    cordoned_queues=set(
+                        cordoned if cordoned is not None
+                        else self.cordoned_queues
+                    ),
+                    cordoned_executors=set(self.cordoned_executors),
+                    backend=self.backend,
+                )
+            except Exception as e:  # noqa: BLE001 - advisory path
+                self.log_.with_fields(pool=pool).error(
+                    "what-if fork capture failed: %r", e
+                )
         # Round-deadline guardrail: a truncated round still commits the
         # partial placement below (queued placements are a prefix of the
         # full round's decisions; evicted running jobs got their pinned
@@ -1540,14 +1611,21 @@ class SchedulerService:
         executors = executors if executors is not None else dict(self.executors)
         if skipped is None:
             skipped = self._skipped_executors(executors)
+        import dataclasses as _dc_nodes
+
         nodes = []
         node_executor: dict[str, str] = {}
         for hb in executors.values():
-            if hb.name in skipped:
-                continue
             for node in hb.nodes:
                 if (node.pool or hb.pool) != pool:
                     continue
+                if hb.name in skipped and not node.unschedulable:
+                    # Mirror the rebuild path: skipped executors' nodes
+                    # stay in the round as unschedulable (running jobs
+                    # keep their binding; no new placements). The fresh
+                    # NodeSpec changes the node signature, so a cordon
+                    # flip forces the rebuild the new state needs.
+                    node = _dc_nodes.replace(node, unschedulable=True)
                 nodes.append(node)
                 node_executor[node.id] = hb.name
         if not nodes:

@@ -132,6 +132,17 @@ class ControlPlane:
         )
         self.metrics = SchedulerMetrics()
         self.scheduler.attach_metrics(self.metrics)
+        # What-if planner (armada_tpu/whatif): fork capture on the round
+        # seam + bounded shadow-solve worker; the WhatIf/PlanDrain/
+        # ExecuteDrain RPCs and lookout's /api/whatif reach it through
+        # the scheduler.
+        from ..whatif import WhatIfService
+
+        self.whatif = WhatIfService(
+            self.scheduler, metrics=self.metrics,
+            cycle_interval=cycle_period,
+        )
+        self.scheduler.attach_whatif(self.whatif)
         self.submit_checker = (
             SubmitChecker(self.config, self.scheduler) if enable_submit_check else None
         )

@@ -28,6 +28,45 @@ class CheckResult:
     reason: str = ""
 
 
+def feasibility_snapshot(config, pool, nodes, jobs):
+    """Empty-of-queued feasibility snapshot for "could this gang EVER
+    fit" checks: the jobs alone against an executor's empty nodes. The
+    ONE builder both the SubmitChecker and the what-if planner's gang
+    injection use (armada_tpu/whatif/planner._injection_feasibility),
+    so checker and planner semantics cannot drift."""
+    jobs = [j.with_(queue=j.queue or "check") for j in jobs]
+    queues = sorted({j.queue for j in jobs})
+    snap = build_round_snapshot(
+        config, pool, nodes, [QueueSpec(q) for q in queues], [], jobs
+    )
+    return snap
+
+
+def static_check(config, pool, nodes, jobs) -> CheckResult:
+    """Solve the feasibility snapshot with the oracle; all-or-nothing
+    (gang-aware: either every job fits together or the check fails with
+    the per-job reasons)."""
+    snap = feasibility_snapshot(config, pool, nodes, jobs)
+    res = ReferenceSolver(snap).solve()
+    if res.scheduled_mask.all():
+        return CheckResult(True)
+    failed = [
+        snap.job_ids[i]
+        for i in range(snap.num_jobs)
+        if not res.scheduled_mask[i]
+    ]
+    reasons = {
+        res.unschedulable_reason[i]
+        for i in range(snap.num_jobs)
+        if not res.scheduled_mask[i] and res.unschedulable_reason[i]
+    }
+    return CheckResult(
+        False,
+        f"{len(failed)} job(s) unschedulable: "
+        f"{'; '.join(sorted(reasons)) or 'no fit'}",
+    )
+
+
 class SubmitChecker:
     def __init__(
         self,
@@ -48,13 +87,25 @@ class SubmitChecker:
             return {}
         return self.scheduler.executors
 
+    def _cordoned(self) -> frozenset:
+        if self.scheduler is None:
+            return frozenset()
+        return frozenset(getattr(self.scheduler, "cordoned_executors", ()))
+
     def check(self, jobs: list[JobSpec]) -> CheckResult:
         """Gang-aware: all jobs must fit together on some single executor
-        (submitcheck.go:212-289)."""
-        executors = self._executors()
+        (submitcheck.go:212-289). Cordoned executors take no new work
+        and are not feasibility candidates."""
+        cordoned = self._cordoned()
+        executors = {
+            name: hb
+            for name, hb in self._executors().items()
+            if name not in cordoned
+        }
         if not executors:
-            # No clusters known: accept; scheduling will wait (the reference
-            # treats an empty nodeDb set the same way).
+            # No (uncordoned) clusters known: accept; scheduling will wait
+            # (the reference treats an empty nodeDb set the same way, and
+            # a fully-cordoned fleet is transient by construction).
             return CheckResult(True)
         key = tuple(
             (
@@ -66,12 +117,15 @@ class SubmitChecker:
             )
             for j in jobs
         )
-        # Cache validity: entries expire on TTL and whenever the executor
-        # set changes (the reference refreshes its snapshots every cycle,
-        # submitcheck.go:100).
+        # Cache validity: entries expire on TTL and whenever the fleet
+        # epoch changes — the executor set, its node counts, OR the
+        # cordon set (the reference refreshes its snapshots every cycle,
+        # submitcheck.go:100; a cordon that did not invalidate the cache
+        # would keep serving verdicts for capacity that just left the
+        # fleet, tests/test_whatif.py::test_submit_checker_cordon_epoch).
         epoch = frozenset(
             (name, len(hb.nodes)) for name, hb in executors.items()
-        )
+        ) | frozenset(("cordoned", name) for name in sorted(cordoned))
         now = _time.time()
         if epoch != self._cache_epoch:
             self._cache.clear()
@@ -98,30 +152,4 @@ class SubmitChecker:
         return result
 
     def _check_on_executor(self, hb, jobs: list[JobSpec]) -> CheckResult:
-        # Normalize first: jobs may arrive before queue assignment.
-        jobs = [j.with_(queue=j.queue or "check") for j in jobs]
-        queues = sorted({j.queue for j in jobs})
-        snap = build_round_snapshot(
-            self.config,
-            hb.pool,
-            hb.nodes,
-            [QueueSpec(q) for q in queues],
-            [],
-            jobs,
-        )
-        res = ReferenceSolver(snap).solve()
-        if res.scheduled_mask.all():
-            return CheckResult(True)
-        failed = [
-            snap.job_ids[i]
-            for i in range(snap.num_jobs)
-            if not res.scheduled_mask[i]
-        ]
-        reasons = {
-            res.unschedulable_reason[i]
-            for i in range(snap.num_jobs)
-            if not res.scheduled_mask[i] and res.unschedulable_reason[i]
-        }
-        return CheckResult(
-            False, f"{len(failed)} job(s) unschedulable: {'; '.join(sorted(reasons)) or 'no fit'}"
-        )
+        return static_check(self.config, hb.pool, hb.nodes, jobs)

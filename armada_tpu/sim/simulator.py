@@ -135,6 +135,10 @@ class Simulator:
         # for a fresh controller or a prebuilt AutotuneController (e.g.
         # with a pre-seeded tuning store).
         autotune=False,
+        # What-if planner (armada_tpu/whatif): attach a WhatIfService
+        # (fork capture on the round seam + bounded shadow-solve
+        # worker) so sim tests exercise planning against live sim state.
+        whatif=False,
     ):
         self.config = config or SchedulingConfig()
         self.rng = np.random.default_rng(seed)
@@ -208,6 +212,18 @@ class Simulator:
                 meta={"backend": backend, "cycle_interval": cycle_interval},
             )
             self.scheduler.attach_trace_recorder(self.trace_recorder)
+        self.whatif = None
+        if whatif:
+            from ..whatif import WhatIfService
+
+            self.whatif = (
+                whatif
+                if not isinstance(whatif, bool)
+                else WhatIfService(
+                    self.scheduler, cycle_interval=cycle_interval
+                )
+            )
+            self.scheduler.attach_whatif(self.whatif)
         self.autotune = None
         if autotune:
             from ..autotune import AutotuneController

@@ -352,6 +352,52 @@ def test_cordon_executor_over_grpc(client, plane):
     assert "fake-a" not in plane.scheduler.cordoned_executors
 
 
+def test_whatif_rpcs_both_wires(client, plane):
+    """WhatIf/PlanDrain/ExecuteDrain work over the JSON wire AND the
+    binary-protobuf wire, and the planner's backlog cap maps to
+    RESOURCE_EXHAUSTED."""
+    import grpc
+
+    from armada_tpu.services.grpc_api import ProtoApiClient
+
+    client.create_queue("wiq")
+    client.submit_jobs("wiq", "s", [dict(JOB) for _ in range(2)])
+    _wait(lambda: plane.scheduler.jobdb.read_txn().leased_jobs())
+    # JSON wire: inject-gang plan with a structured outcome.
+    out = client.what_if(
+        [{"kind": "inject_gang", "queue": "wiq", "gang_cardinality": 2,
+          "cpu": "1", "memory": "1Gi"}],
+        rounds=3,
+    )
+    assert out["plan"]["injected"][0]["eta_rounds"] == 1
+    assert "injected" in out["rendered"]
+    # Proto wire: same method table, same plan shape.
+    pclient = ProtoApiClient(plane.address)
+    pout = pclient.what_if(
+        [{"kind": "inject_gang", "queue": "wiq", "gang_cardinality": 2,
+          "cpu": "1", "memory": "1Gi"}],
+        rounds=3,
+    )
+    assert pout["plan"]["injected"][0]["eta_rounds"] == 1
+    # Drain dry-run over both wires agrees on the preempted set.
+    dj = client.plan_drain("fake-a", deadline_s=0.0, rounds=6)
+    dp = pclient.plan_drain("fake-a", deadline_s=0.0, rounds=6)
+    assert (
+        dj["plan"]["drain"]["preempted"] == dp["plan"]["drain"]["preempted"]
+    )
+    # Backlog cap: a zero-depth planner rejects with RESOURCE_EXHAUSTED.
+    plane.whatif.queue_depth = 0
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            client.what_if(
+                [{"kind": "inject_gang", "queue": "wiq",
+                  "gang_cardinality": 1, "cpu": "1"}]
+            )
+        assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    finally:
+        plane.whatif.queue_depth = 8
+
+
 def test_follower_proxies_reports_to_leader(tmp_path):
     """File-lease HA: a follower answers report RPCs by proxying to the
     leader's advertised address (the reference proxies reports over the

@@ -170,6 +170,36 @@ def test_trend_handles_every_checked_in_artifact(tmp_path):
         assert "BENCH_r01.json" in proc.stdout
 
 
+def test_trend_tolerates_and_shows_whatif_block(tmp_path):
+    """Artifacts carrying the new extra.whatif block (shadow-solve plan
+    stats from the what-if planner) render a whatif column; artifacts
+    without it print '-' and the gate ignores the block entirely."""
+    with_whatif = json.loads(json.dumps(NEW_SCHEMA))
+    with_whatif["parsed"]["extra"]["whatif"] = {
+        "plans": 3, "plan_s": 0.42,
+    }
+    bare_marker = json.loads(json.dumps(NEW_SCHEMA))
+    bare_marker["parsed"]["extra"]["whatif"] = {"enabled": True}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(OLD_SCHEMA))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(with_whatif))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(bare_marker))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "bench_trend.py"),
+            "--dir", str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "whatif" in proc.stdout
+    lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
+    assert "3@0.42s" in lines["BENCH_r02.json"]
+    assert lines["BENCH_r03.json"].rstrip().endswith("yes")
+    # The gate's metric extraction is unaffected by the extra block.
+    assert extract_metrics(parse_artifact(with_whatif))["warm"] == 3.0
+
+
 def test_trend_shows_effective_params_column(tmp_path):
     """The trend table carries the effective solver-parameter vector
     (window/chunk, starred when tuned) for artifacts that record it and

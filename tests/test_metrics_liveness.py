@@ -178,6 +178,68 @@ def test_every_labeled_family_live_after_short_sim(tmp_path):
         metrics=m,
     )
     assert adopted is not None and adopted["direction"] == "grow"
+    # What-if planner surface (armada_tpu/whatif): one plan against the
+    # sim's scheduler puts samples in whatif_plans_total /
+    # whatif_plan_seconds (and whatif_queue_depth), and a tiny staged
+    # drain on a two-executor harness drives drain_jobs_preempted_total
+    # / drain_jobs_completed_total through the REAL event path.
+    from armada_tpu.core.types import JobSpec, QueueSpec
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+    from armada_tpu.whatif import WhatIfService, mutations_from_dicts
+
+    wi = WhatIfService(sim.scheduler, metrics=m)
+    plan = wi.plan(
+        mutations_from_dicts(
+            [{"kind": "inject_gang", "queue": "qa", "gang_cardinality": 2,
+              "cpu": "2"}]
+        ),
+        rounds=2,
+    )
+    assert plan.injected
+    from armada_tpu.core.config import PriorityClass
+
+    drain_cfg = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    dlog = InMemoryEventLog()
+    dsched = SchedulerService(drain_cfg, dlog)
+    dsubmit = SubmitService(drain_cfg, dlog, scheduler=dsched)
+    dsubmit.create_queue(QueueSpec("q"))
+    # `fast` completes AFTER the drain's first step (t=10) but inside
+    # its deadline (t=25): counted as a voluntary completion.
+    runtimes = {"fast": 12.0}
+    rt = lambda jid: runtimes.get(jid, 1e9)  # noqa: E731
+    dex_a = FakeExecutor("dex-a", dlog, dsched,
+                         nodes=make_nodes("dex-a", count=1, cpu="8"),
+                         runtime_for=rt)
+    dex_b = FakeExecutor("dex-b", dlog, dsched,
+                         nodes=make_nodes("dex-b", count=1, cpu="8"),
+                         runtime_for=rt)
+    dsubmit.submit("q", "s", [
+        JobSpec(id="fast", queue="q", requests={"cpu": "2", "memory": "1Gi"},
+                submitted_ts=0.0),
+        JobSpec(id="slow", queue="q", requests={"cpu": "2", "memory": "1Gi"},
+                submitted_ts=1.0),
+    ], now=0.0)
+
+    def dcycle(t):
+        for ex in (dex_a, dex_b):
+            ex.tick(t)
+        dsched.cycle(now=t)
+        for ex in (dex_a, dex_b):
+            ex.tick(t)
+
+    dcycle(0.0)
+    executor = dsched.jobdb.get("slow").latest_run.executor
+    dsched.drains.start(executor, deadline_s=15.0, metrics=m)
+    for k in range(1, 6):
+        dcycle(10.0 * k)
+    status = dsched.drains.status(executor)
+    assert status["preempted"], status
     counts = _labeled_sample_counts(m)
     dead = sorted(
         name for name, n in counts.items()

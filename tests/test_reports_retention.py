@@ -1,0 +1,86 @@
+"""SchedulingReportsRepository retention: the per-job report map stays
+bounded under a long sim, and queries for evicted ids degrade with a
+clear message instead of a KeyError (ISSUE 10 satellite)."""
+
+from armada_tpu.services.reports import (
+    QueueReport,
+    RoundReport,
+    SchedulingReportsRepository,
+)
+
+
+def _report(i, job_ids):
+    rep = RoundReport(
+        pool="default", started=float(i), finished=float(i) + 0.5,
+        num_jobs=len(job_ids), num_nodes=4,
+    )
+    rep.queues["q"] = QueueReport(queue="q")
+    for jid in job_ids:
+        rep.job_contexts[jid] = f"scheduled: round {i}"
+    return rep
+
+
+def test_retained_jobs_bounds_memory_and_degrades_gracefully():
+    repo = SchedulingReportsRepository(retained_jobs=50)
+    for i in range(40):
+        repo.record(_report(i, [f"job-{i}-{k}" for k in range(5)]))
+    # 200 job entries pushed through a 50-entry budget: the repository
+    # must stay bounded (eviction halves at the cap, so never > cap+batch).
+    assert len(repo._job_reports) <= 55
+    # The newest round's jobs are queryable...
+    assert repo.job_report("job-39-0") == "scheduled: round 39"
+    # ...an evicted early id degrades with the explicit no-report
+    # message, not a KeyError.
+    msg = repo.job_report("job-0-0")
+    assert msg == "no report for job job-0-0"
+    # Unknown ids get the same contract.
+    assert repo.job_report("never-existed").startswith("no report for job")
+
+
+def test_retention_under_long_sim():
+    """End-to-end: a sim whose scheduler carries a tiny retained_jobs
+    budget keeps the map bounded across the whole run, and every query
+    path (hit, evicted, unknown) returns a string."""
+    from armada_tpu.sim.simulator import (
+        ClusterSpec,
+        JobTemplate,
+        NodeTemplate,
+        QueueSpecSim,
+        ShiftedExponential,
+        Simulator,
+        WorkloadSpec,
+    )
+
+    sim = Simulator(
+        [ClusterSpec(name="c", node_templates=(NodeTemplate(count=4, cpu="8"),))],
+        WorkloadSpec(
+            queues=(
+                QueueSpecSim(
+                    name="q",
+                    job_templates=(
+                        JobTemplate(
+                            id="t", number=120, cpu="2",
+                            runtime=ShiftedExponential(minimum=15.0),
+                        ),
+                        # A can-never-fit job keeps job_reasons flowing
+                        # into the repository every single round.
+                        JobTemplate(id="huge", number=1, cpu="999"),
+                    ),
+                ),
+            )
+        ),
+        backend="oracle",
+        cycle_interval=10.0,
+        max_time=1200.0,
+    )
+    sim.scheduler.reports = SchedulingReportsRepository(retained_jobs=30)
+    sim.run()
+    repo = sim.scheduler.reports
+    assert len(repo._job_reports) <= 40, len(repo._job_reports)
+    # The perpetual unschedulable job's verdict survives (recorded every
+    # round, so it is always among the newest entries).
+    assert repo.job_report("q-huge-000000") == "job does not fit on any node"
+    # An early finished job's id eventually evicts; the query is a
+    # clear message either way, never an exception.
+    assert isinstance(repo.job_report("q-t-000000"), str)
+    assert isinstance(repo.scheduling_report(), str)

@@ -105,6 +105,137 @@ def test_e2e_failed_node_retry_avoids_node():
     assert first_node in j.failed_nodes
 
 
+def _wait(predicate, timeout=10.0, interval=0.05):
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_cli_node_cordon_respected_by_next_round():
+    """`armadactl node cordon` round-trips through the control plane
+    (binoculars -> executor -> next heartbeat) and the NEXT round's
+    snapshot refuses the node; uncordon restores it. Only the happy
+    path via binoculars.set_cordon was exercised before."""
+    from armada_tpu.clients.cli import main
+    from armada_tpu.services.server import ControlPlane
+
+    plane = ControlPlane(
+        SchedulingConfig(),
+        cycle_period=0.05,
+        fake_executors=[{"name": "ex", "nodes": 2, "cpu": "8",
+                         "runtime": 1e6}],
+    ).start()
+    try:
+        main(["--server", plane.address, "queue", "create", "team"])
+        # Cordon node 0 through the CLI; the next round must place on
+        # node 1 only.
+        main(["--server", plane.address, "node", "cordon",
+              "ex-node-00000"])
+        assert _wait(
+            lambda: plane.executors[0].nodes[0].unschedulable
+        )
+        from armada_tpu.services.grpc_api import ApiClient
+
+        client = ApiClient(plane.address)
+        client.submit_jobs(
+            "team", "s",
+            [{"requests": {"cpu": "2", "memory": "1Gi"}} for _ in range(2)],
+        )
+
+        def both_leased_off_node0():
+            jobs = [
+                j for j in plane.scheduler.jobdb.read_txn().all_jobs()
+                if j.latest_run is not None
+            ]
+            return len(jobs) == 2 and all(
+                j.latest_run.node_id == "ex-node-00001" for j in jobs
+            )
+
+        assert _wait(both_leased_off_node0)
+        # Uncordon: new work may land on node 0 again.
+        main(["--server", plane.address, "node", "uncordon",
+              "ex-node-00000"])
+        assert _wait(
+            lambda: not plane.executors[0].nodes[0].unschedulable
+        )
+        client.submit_jobs(
+            "team", "s2",
+            [{"requests": {"cpu": "6", "memory": "1Gi"}}],
+        )
+
+        def third_on_node0():
+            jobs = [
+                j for j in plane.scheduler.jobdb.read_txn().all_jobs()
+                if j.latest_run is not None
+                and j.latest_run.node_id == "ex-node-00000"
+            ]
+            return len(jobs) == 1
+
+        assert _wait(third_on_node0)
+    finally:
+        plane.stop()
+
+
+def test_cli_executor_cordon_event_log_round_trip():
+    """`armadactl executor cordon` is event-sourced: the NEXT round's
+    snapshot takes no new placements there (nodes stay, unschedulable),
+    and a fresh scheduler replaying the same log materializes the
+    cordon."""
+    from armada_tpu.clients.cli import main
+    from armada_tpu.services.grpc_api import ApiClient
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.server import ControlPlane
+
+    config = SchedulingConfig()
+    plane = ControlPlane(
+        config,
+        cycle_period=0.05,
+        fake_executors=[
+            {"name": "ex-a", "nodes": 1, "cpu": "8", "runtime": 1e6},
+            {"name": "ex-b", "nodes": 1, "cpu": "8", "runtime": 1e6},
+        ],
+    ).start()
+    try:
+        main(["--server", plane.address, "queue", "create", "team"])
+        main(["--server", plane.address, "executor", "cordon", "ex-a"])
+        assert _wait(
+            lambda: "ex-a" in plane.scheduler.cordoned_executors
+        )
+        client = ApiClient(plane.address)
+        client.submit_jobs(
+            "team", "s",
+            [{"requests": {"cpu": "2", "memory": "1Gi"}} for _ in range(2)],
+        )
+
+        def both_on_ex_b():
+            jobs = [
+                j for j in plane.scheduler.jobdb.read_txn().all_jobs()
+                if j.latest_run is not None
+            ]
+            return len(jobs) == 2 and all(
+                j.latest_run.executor == "ex-b" for j in jobs
+            )
+
+        assert _wait(both_on_ex_b)
+        # Event-log round trip: a brand-new scheduler replaying the same
+        # log (a restarted/standby leader) holds the cordon too.
+        replica = SchedulerService(config, plane.log)
+        assert "ex-a" in replica.cordoned_executors
+        main(["--server", plane.address, "executor", "uncordon", "ex-a"])
+        assert _wait(
+            lambda: "ex-a" not in plane.scheduler.cordoned_executors
+        )
+        replica2 = SchedulerService(config, plane.log)
+        assert "ex-a" not in replica2.cordoned_executors
+    finally:
+        plane.stop()
+
+
 def test_e2e_cordoned_queue():
     from armada_tpu.events import InMemoryEventLog
     from armada_tpu.jobdb import JobState

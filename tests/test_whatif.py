@@ -1,0 +1,484 @@
+"""What-if planner: forked shadow solves, drain plan/apply parity,
+planner isolation, fixture-trace bit-exactness, submit-checker epoch.
+
+The acceptance contracts (ISSUE 10):
+  - plan/apply parity: a drain dry-run's predicted outcome (preempted
+    set, requeue placements, rounds-to-drain) is IDENTICAL to executing
+    the same drain in a deterministic sim, gang-aware, under LOCAL and
+    "2x4" mesh solver specs;
+  - planner isolation: a concurrent what-if burst leaves live round
+    metrics untouched and planner solves are bit-exact with the live
+    kernel on an unmutated fork (replayer-style compare on the
+    committed fixture trace).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import Gang, JobSpec, QueueSpec
+from armada_tpu.events import InMemoryEventLog
+from armada_tpu.jobdb import JobState
+from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+from armada_tpu.services.metrics import HAVE_PROMETHEUS, SchedulerMetrics
+from armada_tpu.services.scheduler import SchedulerService
+from armada_tpu.services.submit import SubmitService
+from armada_tpu.whatif import (
+    WhatIfBusyError,
+    WhatIfService,
+    fork_from_trace,
+    mutation_from_dict,
+    mutations_from_dicts,
+)
+from armada_tpu.whatif.planner import parity_check
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "sim_steady.atrace")
+
+CONFIG = SchedulingConfig(
+    priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+    default_priority_class="d",
+)
+
+
+def _harness(runtimes=None, *, nodes_a=2, nodes_b=2, cpu="8", config=None):
+    """Scheduler + two fake executors + submit service on one log."""
+    config = config or CONFIG
+    log = InMemoryEventLog()
+    sched = SchedulerService(config, log)
+    submit = SubmitService(config, log, scheduler=sched)
+    submit.create_queue(QueueSpec("team"))
+    runtimes = runtimes or {}
+    rt = lambda jid: runtimes.get(jid, 1e9)  # noqa: E731
+    ex_a = FakeExecutor("ex-a", log, sched,
+                        nodes=make_nodes("ex-a", count=nodes_a, cpu=cpu),
+                        runtime_for=rt)
+    ex_b = FakeExecutor("ex-b", log, sched,
+                        nodes=make_nodes("ex-b", count=nodes_b, cpu=cpu),
+                        runtime_for=rt)
+    return log, sched, submit, ex_a, ex_b
+
+
+def _cycle(sched, executors, t):
+    for ex in executors:
+        ex.tick(t)
+    seqs = sched.cycle(now=t)
+    for ex in executors:
+        ex.tick(t)
+    return seqs
+
+
+def _job(i, cpu="4", gang=None, **kw):
+    return JobSpec(
+        id=f"j{i}", queue="team", jobset="s",
+        requests={"cpu": cpu, "memory": "1Gi"},
+        submitted_ts=float(i), gang=gang, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# mutations vocabulary
+# ---------------------------------------------------------------------------
+
+
+def test_mutation_vocabulary_decodes_every_kind():
+    kinds = [
+        {"kind": "cordon_node", "name": "n0"},
+        {"kind": "uncordon_node", "name": "n0"},
+        {"kind": "remove_node", "name": "n0"},
+        {"kind": "add_nodes", "count": 2, "cpu": "8"},
+        {"kind": "cordon_executor", "name": "ex"},
+        {"kind": "drain_executor", "name": "ex", "deadline_s": 5.0},
+        {"kind": "inject_gang", "queue": "q", "gang_cardinality": 4,
+         "cpu": "2"},
+        {"kind": "inject_jobs", "queue": "q", "count": 3},
+        {"kind": "scale_queue", "name": "q", "weight": 2.0},
+    ]
+    for d in kinds:
+        m = mutation_from_dict(d)
+        assert m.to_dict()["kind"] == (
+            "inject_gang" if d["kind"] == "inject_jobs" else d["kind"]
+        )
+    with pytest.raises(ValueError, match="unknown mutation kind"):
+        mutation_from_dict({"kind": "explode"})
+
+
+def test_preempt_requeue_event_semantics():
+    """JobRunPreempted(requeue=True) kills the RUN but returns the job
+    to QUEUED; without the flag the job stays terminally PREEMPTED."""
+    from armada_tpu.events import EventSequence, JobRunPreempted
+
+    log, sched, submit, ex_a, ex_b = _harness()
+    submit.submit("team", "s", [_job(0), _job(1)], now=0.0)
+    _cycle(sched, [ex_a, ex_b], 0.0)
+    for jid in ("j0", "j1"):
+        assert sched.jobdb.get(jid).state in (
+            JobState.LEASED, JobState.PENDING, JobState.RUNNING,
+        )
+    run0 = sched.jobdb.get("j0").latest_run
+    run1 = sched.jobdb.get("j1").latest_run
+    log.publish(EventSequence.of(
+        "team", "s",
+        JobRunPreempted(created=1.0, job_id="j0", run_id=run0.id,
+                        reason="drain test", requeue=True),
+        JobRunPreempted(created=1.0, job_id="j1", run_id=run1.id,
+                        reason="classic"),
+    ))
+    sched.ingester.sync()
+    j0, j1 = sched.jobdb.get("j0"), sched.jobdb.get("j1")
+    assert j0.state == JobState.QUEUED
+    assert j0.latest_run.state.value == "preempted"
+    assert j1.state == JobState.PREEMPTED
+    sched.jobdb.read_txn().assert_valid()
+
+
+# ---------------------------------------------------------------------------
+# planning: gang ETA, headroom, feasibility, live-state isolation
+# ---------------------------------------------------------------------------
+
+
+def test_inject_gang_eta_and_headroom():
+    log, sched, submit, ex_a, ex_b = _harness()
+    submit.submit("team", "s", [_job(i) for i in range(4)], now=0.0)
+    _cycle(sched, [ex_a, ex_b], 0.0)
+    wi = WhatIfService(sched)
+    sched.attach_whatif(wi)
+    _cycle(sched, [ex_a, ex_b], 1.0)  # captured fork with the seam
+
+    plan = wi.plan(
+        mutations_from_dicts(
+            [{"kind": "inject_gang", "queue": "team",
+              "gang_cardinality": 2, "cpu": "4", "memory": "1Gi"}]
+        ),
+        rounds=4,
+    )
+    (gang,) = plan.injected
+    assert gang["feasible"] and gang["eta_rounds"] == 1
+    assert gang["gang_cardinality"] == 2 and len(gang["nodes"]) >= 1
+    free = plan.headroom["pool"]["free"]
+    # 4 nodes x 8 cpu - 4 running x 4 - injected gang 2 x 4 = 8 left.
+    assert free["cpu"] == 8.0
+    assert plan.baseline["running"] == 4 and plan.baseline["queued"] == 0
+
+    # An impossible gang carries the SubmitChecker's reason vocabulary
+    # (same snapshot-build helper: services/submit_check.static_check).
+    plan2 = wi.plan(
+        mutations_from_dicts(
+            [{"kind": "inject_gang", "queue": "team",
+              "gang_cardinality": 2, "cpu": "999"}]
+        ),
+        rounds=2,
+    )
+    (gang2,) = plan2.injected
+    assert not gang2["feasible"]
+    assert gang2["eta_rounds"] is None
+    assert "never schedulable" in gang2["reason"]
+
+
+def test_whatif_leaves_live_state_untouched():
+    """Shadow solves must not publish a single live event or flip any
+    job state — the whole point of forking."""
+    log, sched, submit, ex_a, ex_b = _harness()
+    submit.submit("team", "s", [_job(i) for i in range(4)], now=0.0)
+    _cycle(sched, [ex_a, ex_b], 0.0)
+    wi = WhatIfService(sched)
+    sched.attach_whatif(wi)
+    _cycle(sched, [ex_a, ex_b], 1.0)
+    before_offset = log.end_offset
+    before_states = {
+        j.id: j.state for j in sched.jobdb.read_txn().all_jobs()
+    }
+    wi.plan_drain("ex-a", deadline_s=0.0, rounds=6)
+    wi.plan(
+        mutations_from_dicts(
+            [{"kind": "remove_node", "name": "ex-b-node-00000"}]
+        ),
+        rounds=3,
+    )
+    assert log.end_offset == before_offset
+    assert {
+        j.id: j.state for j in sched.jobdb.read_txn().all_jobs()
+    } == before_states
+    assert not sched.cordoned_executors
+
+
+# ---------------------------------------------------------------------------
+# drain: plan/apply parity (the acceptance contract)
+# ---------------------------------------------------------------------------
+
+
+def _drain_parity_case(solver, backend, mesh):
+    """One deterministic drain scenario, predicted then executed.
+
+    Fleet: ex-a 1x8cpu node, ex-b 2x8cpu nodes. A 2-member gang of
+    8-cpu jobs spans ex-a and ex-b; a short job on ex-a completes
+    voluntarily inside the deadline. Draining ex-a must: let the short
+    job finish, preempt BOTH gang members (gang-aware — the ex-b member
+    goes too, no stranded partial gang), and land the whole gang on
+    ex-b's freed nodes.
+    """
+    runtimes = {"g0": 1e9, "g1": 1e9, "short": 25.0}
+    log = InMemoryEventLog()
+    sched = SchedulerService(CONFIG, log, backend=backend, mesh=mesh)
+    submit = SubmitService(CONFIG, log, scheduler=sched)
+    submit.create_queue(QueueSpec("team"))
+    rt = lambda jid: runtimes.get(jid, 1e9)  # noqa: E731
+    # 9-cpu nodes: an 8-cpu gang member + the 1-cpu short job share
+    # ex-a's node (best-fit ties break toward the lexicographically
+    # first node id, so `short` provably lands next to g0 on ex-a).
+    ex_a = FakeExecutor("ex-a", log, sched,
+                        nodes=make_nodes("ex-a", count=1, cpu="9"),
+                        runtime_for=rt)
+    ex_b = FakeExecutor("ex-b", log, sched,
+                        nodes=make_nodes("ex-b", count=2, cpu="9"),
+                        runtime_for=rt)
+    gang = Gang(id="g", cardinality=2)
+    jobs = [
+        JobSpec(id="g0", queue="team", jobset="s",
+                requests={"cpu": "8", "memory": "1Gi"}, gang=gang,
+                submitted_ts=0.0),
+        JobSpec(id="g1", queue="team", jobset="s",
+                requests={"cpu": "8", "memory": "1Gi"}, gang=gang,
+                submitted_ts=0.0),
+        JobSpec(id="short", queue="team", jobset="s",
+                requests={"cpu": "1", "memory": "1Gi"}, submitted_ts=1.0),
+    ]
+    submit.submit("team", "s", jobs, now=0.0)
+    _cycle(sched, [ex_a, ex_b], 0.0)
+    _cycle(sched, [ex_a, ex_b], 10.0)
+    txn = sched.jobdb.read_txn()
+    placements = {
+        j.id: j.latest_run.node_id for j in txn.all_jobs() if j.latest_run
+    }
+    # The scenario's premise: the gang spans both executors.
+    gang_execs = {placements["g0"][:4], placements["g1"][:4]}
+    assert gang_execs == {"ex-a", "ex-b"}, placements
+
+    wi = WhatIfService(sched)
+    sched.attach_whatif(wi)
+    remaining = {}
+    for ex in (ex_a, ex_b):
+        for run in ex.active.values():
+            remaining[run.job_id] = run.finishes_at - 10.0
+    predicted = wi.plan_drain(
+        "ex-a",
+        deadline_s=40.0,
+        rounds=12,
+        solver=solver,
+        runtime_for=lambda jid: remaining.get(jid, 1e9),
+    )
+    pred = predicted.drain
+    assert pred["done"], pred
+
+    wi.execute_drain("ex-a", deadline_s=40.0)
+    for k in range(1, 12):
+        _cycle(sched, [ex_a, ex_b], 10.0 + 10.0 * k)
+    actual = sched.drains.status("ex-a")
+    assert actual["done"], actual
+    for key in ("completed", "preempted", "blocked", "landings",
+                "rounds_to_drain"):
+        assert pred[key] == actual[key], (key, pred[key], actual[key])
+    # Scenario shape: short completed voluntarily; the WHOLE gang was
+    # preempted (including the ex-b member) and landed on ex-b.
+    assert pred["completed"] == ["short"]
+    assert pred["preempted"] == ["g0", "g1"]
+    assert set(pred["landings"]) == {"g0", "g1"}
+    assert all(n.startswith("ex-b") for n in pred["landings"].values())
+    # No stranded partial gang: both members live again, off ex-a.
+    txn = sched.jobdb.read_txn()
+    for jid in ("g0", "g1"):
+        job = txn.get(jid)
+        assert job.state in (
+            JobState.LEASED, JobState.PENDING, JobState.RUNNING,
+        )
+        assert job.latest_run.executor == "ex-b"
+    return pred
+
+
+def test_drain_plan_apply_parity_local():
+    _drain_parity_case(solver="oracle", backend="oracle", mesh=None)
+
+
+def test_drain_plan_apply_parity_local_kernel():
+    _drain_parity_case(solver="LOCAL", backend="kernel", mesh=None)
+
+
+@pytest.mark.slow
+def test_drain_plan_apply_parity_mesh_2x4():
+    _drain_parity_case(solver="2x4", backend="kernel", mesh="2x4")
+
+
+def test_drain_reason_visible_in_job_trace():
+    """Drain preemptions carry their reason into the job-journey
+    timeline (`armadactl job-trace`)."""
+    log, sched, submit, ex_a, ex_b = _harness()
+    submit.submit("team", "s", [_job(0)], now=0.0)
+    _cycle(sched, [ex_a, ex_b], 0.0)
+    executor = sched.jobdb.get("j0").latest_run.executor
+    sched.drains.start(executor, deadline_s=0.0)
+    for k in range(1, 5):
+        _cycle(sched, [ex_a, ex_b], 10.0 * k)
+    rendered = sched.timeline.render("j0")
+    assert "preempted" in rendered
+    assert f"drain {executor}: deadline reached" in rendered
+    # And the job landed on the other executor.
+    assert sched.jobdb.get("j0").latest_run.executor != executor
+
+
+# ---------------------------------------------------------------------------
+# planner isolation: burst leaves live rounds untouched + backpressure
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not HAVE_PROMETHEUS, reason="prometheus unavailable")
+def test_planner_isolation_burst():
+    log, sched, submit, ex_a, ex_b = _harness()
+    m = SchedulerMetrics()
+    sched.attach_metrics(m)
+    submit.submit("team", "s", [_job(i) for i in range(4)], now=0.0)
+    _cycle(sched, [ex_a, ex_b], 0.0)
+    wi = WhatIfService(sched, metrics=m, workers=1, queue_depth=2)
+    sched.attach_whatif(wi)
+    _cycle(sched, [ex_a, ex_b], 1.0)
+
+    def live_solve_count():
+        total = 0
+        for family in m.solve_time.collect():
+            for sample in family.samples:
+                if sample.name.endswith("_count"):
+                    total += sample.value
+        return total
+
+    solves_before = live_solve_count()
+    results, errors = [], []
+
+    def fire():
+        try:
+            results.append(
+                wi.plan(
+                    mutations_from_dicts(
+                        [{"kind": "inject_gang", "queue": "team",
+                          "gang_cardinality": 2, "cpu": "1"}]
+                    ),
+                    rounds=3,
+                )
+            )
+        except WhatIfBusyError as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=fire) for _ in range(6)]
+    cycle_times = []
+    for th in threads:
+        th.start()
+    # Live rounds keep running mid-burst; their wall clock is recorded
+    # by the live metrics only.
+    for k in range(2, 6):
+        t0 = time.monotonic()
+        _cycle(sched, [ex_a, ex_b], float(k))
+        cycle_times.append(time.monotonic() - t0)
+    for th in threads:
+        th.join()
+
+    # Backpressure: a 6-deep burst on a 1-worker/2-queue planner must
+    # shed some requests instead of queueing unboundedly...
+    assert errors, "expected WhatIfBusyError from the bounded planner"
+    assert results, "and still complete the admitted plans"
+    # ...the queue drains back to idle...
+    assert wi._pending == 0
+    # ...and live round metrics saw ONLY the live cycles: planner
+    # solves never touch scheduler_solve_* (each plan re-solves in its
+    # private rollout scheduler with no metrics attached).
+    assert live_solve_count() == solves_before + 4
+    # The plan histogram recorded the admitted plans.
+    plan_count = 0
+    for family in m.whatif_plan_seconds.collect():
+        for sample in family.samples:
+            if sample.name.endswith("_count"):
+                plan_count += sample.value
+    assert plan_count == len(results)
+
+
+# ---------------------------------------------------------------------------
+# fixture-trace parity: planner solves are bit-exact with the live kernel
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_fork_parity_local():
+    """Tier-1 smoke: fork a recorded round from the committed fixture
+    bundle and re-solve it UNMUTATED under LOCAL — the decision stream
+    must be bit-exact (replayer-style compare)."""
+    fork = fork_from_trace(FIXTURE, round_i=0, allow_foreign=True)
+    report = parity_check(fork, "LOCAL")
+    assert report["ok"], report["divergences"]
+    assert report["num_jobs"] > 0
+
+
+@pytest.mark.slow
+def test_fixture_fork_parity_hotwindow():
+    fork = fork_from_trace(FIXTURE, round_i=1, allow_foreign=True)
+    report = parity_check(fork, "hotwindow:4")
+    assert report["ok"], report["divergences"]
+
+
+@pytest.mark.slow
+def test_fixture_fork_parity_mesh_2x4():
+    fork = fork_from_trace(FIXTURE, round_i=0, allow_foreign=True)
+    report = parity_check(fork, "2x4")
+    assert report["ok"], report["divergences"]
+
+
+def test_trace_fork_device_cordon():
+    """Device-level node cordon on a trace fork flips placements away
+    from the cordoned node (the recorded round placed jobs there)."""
+    import numpy as np
+
+    from armada_tpu.whatif.fork import cordon_node_in_fork
+
+    fork = fork_from_trace(FIXTURE, round_i=0, allow_foreign=True)
+    rec = fork.trace_record
+    ids = (rec.raw.get("ids") or {}).get("nodes")
+    if not ids:
+        pytest.skip("fixture carries no node id vocabulary")
+    decisions = rec.decisions()
+    assigned = np.asarray(decisions["assigned_node"])[: rec.num_jobs]
+    used = [i for i in np.unique(assigned) if i >= 0]
+    if not used:
+        pytest.skip("recorded round placed nothing")
+    victim = ids[int(used[0])]
+    mutated = cordon_node_in_fork(fork, victim)
+    report = parity_check(mutated, "LOCAL")
+    # The mutated fork MUST diverge from the recorded decisions: the
+    # victim node can no longer host its jobs.
+    assert not report["ok"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: SubmitChecker cache invalidation on executor cordon
+# ---------------------------------------------------------------------------
+
+
+def test_submit_checker_cordon_epoch():
+    """Cordoning an executor is a fleet-epoch change: cached verdicts
+    must invalidate, and the cordoned executor stops counting as
+    feasible capacity."""
+    from armada_tpu.services.submit_check import SubmitChecker
+
+    log, sched, submit, ex_a, ex_b = _harness(nodes_a=1, nodes_b=1)
+    # ex-a is the only executor with a big node; ex-b gets tiny nodes.
+    ex_b.nodes = make_nodes("ex-b", count=1, cpu="1")
+    _cycle(sched, [ex_a, ex_b], 0.0)
+    checker = SubmitChecker(CONFIG, sched)
+    big = [JobSpec(id="big", queue="team",
+                   requests={"cpu": "8", "memory": "1Gi"})]
+    assert checker.check(big).schedulable  # fits on ex-a; verdict cached
+    # Cordon the only executor that can host it: the cached verdict must
+    # NOT survive the fleet-epoch change.
+    sched.set_executor_cordon("ex-a", True)
+    result = checker.check(big)
+    assert not result.schedulable
+    assert "unschedulable" in result.reason
+    # Uncordon: schedulable again (epoch flips back, cache rebuilt).
+    sched.set_executor_cordon("ex-a", False)
+    assert checker.check(big).schedulable

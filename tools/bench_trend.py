@@ -33,7 +33,7 @@ def rows(search_dir: str) -> list[dict]:
     ):
         row = {"round": os.path.basename(path), "warm": None,
                "tracking": None, "burst": None, "solve": None,
-               "trace": False, "params": None}
+               "trace": False, "params": None, "whatif": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -53,6 +53,18 @@ def rows(search_dir: str) -> list[dict]:
             # this artifact's workload is replayable by
             # tools/replay_gate.py against any candidate kernel.
             row["trace"] = True
+        whatif = extra.get("whatif") if isinstance(extra, dict) else None
+        if isinstance(whatif, dict):
+            # What-if planner block (armada_tpu/whatif): artifacts from
+            # runs that shadow-solved plans carry plan count + median
+            # plan wall clock; earlier artifacts simply lack the block.
+            plans = whatif.get("plans")
+            plan_s = whatif.get("plan_s")
+            row["whatif"] = (
+                f"{plans}@{plan_s:.2f}s"
+                if isinstance(plans, int) and isinstance(plan_s, (int, float))
+                else "yes"
+            )
         params = extra.get("params") if isinstance(extra, dict) else None
         if isinstance(params, dict):
             # Effective headline solver parameters (window/chunk, "*"
@@ -77,7 +89,7 @@ def main(argv=None) -> int:
         return 1
     header = (
         f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} "
-        f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6}"
+        f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6} {'whatif':>9}"
     )
     print(header)
     print("-" * len(header))
@@ -86,7 +98,8 @@ def main(argv=None) -> int:
             f"{r['round']:<18} {_fmt(r['warm']):>8} {_fmt(r['solve']):>8} "
             f"{_fmt(r['tracking']):>10} {_fmt(r['burst']):>8} "
             f"{r.get('params') or '-':>10} "
-            f"{'yes' if r.get('trace') else '-':>6}"
+            f"{'yes' if r.get('trace') else '-':>6} "
+            f"{r.get('whatif') or '-':>9}"
         )
     return 0
 
