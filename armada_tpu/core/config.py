@@ -250,6 +250,18 @@ class SchedulingConfig:
     store_capacity_bytes: int = 0
     store_fraction_of_capacity_limit: float = 0.8
     max_ingest_lag_events: int = 0
+    # Front door (armada_tpu/frontdoor): jobset-keyed sharded ingest +
+    # per-tenant admission. `frontdoor_shards` > 0 enables the sharded
+    # write path (submissions ack on the shard WAL, per-shard ingesters
+    # deliver exactly-once into the main log); rates are jobs/second
+    # token buckets, `frontdoor_overload_rate` is the quota-weighted
+    # trickle admitted while the backpressure gate is unhealthy.
+    frontdoor_shards: int = 0
+    frontdoor_tenant_rate: float = 1000.0
+    frontdoor_tenant_burst: float = 2000.0
+    frontdoor_global_rate: float = 10_000.0
+    frontdoor_global_burst: float = 20_000.0
+    frontdoor_overload_rate: float = 100.0
     # Short-job penalty (scheduling/short_job_penalty.go): jobs that finish
     # faster than this still count against their queue's cost until the
     # window passes, discouraging churn. 0 disables.
@@ -499,6 +511,12 @@ class SchedulingConfig:
             ("autotuneMaxWindowSlots", "autotune_max_window_slots", int),
             ("enableFastFill", "enable_fast_fill", bool),
             ("fillGroupMax", "fill_group_max", int),
+            ("frontdoorShards", "frontdoor_shards", int),
+            ("frontdoorTenantRate", "frontdoor_tenant_rate", float),
+            ("frontdoorTenantBurst", "frontdoor_tenant_burst", float),
+            ("frontdoorGlobalRate", "frontdoor_global_rate", float),
+            ("frontdoorGlobalBurst", "frontdoor_global_burst", float),
+            ("frontdoorOverloadRate", "frontdoor_overload_rate", float),
         ]:
             if yaml_key in d:
                 kwargs[attr] = conv(d[yaml_key])
@@ -620,6 +638,19 @@ def validate_config(config: SchedulingConfig):
         problems.append("fillGroupMax must be >= 1")
     if config.max_scheduling_duration_s < 0:
         problems.append("maxSchedulingDuration must be >= 0")
+    if config.frontdoor_shards < 0:
+        problems.append("frontdoorShards must be >= 0")
+    if config.frontdoor_shards > 0:
+        for knob in (
+            "frontdoor_tenant_rate",
+            "frontdoor_tenant_burst",
+            "frontdoor_global_rate",
+            "frontdoor_global_burst",
+            "frontdoor_overload_rate",
+        ):
+            if getattr(config, knob) <= 0:
+                problems.append(f"{knob} must be > 0 when the front door "
+                                "is enabled")
     if config.executor_lease_ttl_s < 0:
         problems.append("executorLeaseTTL must be >= 0")
     if config.truncated_rounds_backpressure < 1:

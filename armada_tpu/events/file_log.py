@@ -226,6 +226,7 @@ class FileEventLog(EventLog):
                                 ),
                                 user=payload.get("u", ""),
                                 traceparent=payload.get("tp", ""),
+                                ingest_marker=payload.get("im", ""),
                             )
                     except (json.JSONDecodeError, KeyError, TypeError) as e:
                         bad = f"undecodable record: {e!r}"
@@ -299,6 +300,9 @@ class FileEventLog(EventLog):
                 # Written only when set: untraced publishers keep the
                 # historical record shape (and crc) byte-for-byte.
                 payload["tp"] = sequence.traceparent
+            if getattr(sequence, "ingest_marker", ""):
+                # Front-door delivery marker (same only-when-set rule).
+                payload["im"] = sequence.ingest_marker
             rec = {
                 "o": offset,
                 "c": zlib.crc32(json.dumps(payload).encode()),

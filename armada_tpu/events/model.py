@@ -221,6 +221,12 @@ class EventSequence:
     # executors echo it on run reports — so one trace id follows a job
     # across every process boundary. "" = untraced publisher.
     traceparent: str = ""
+    # Idempotent-producer marker ("fd<shard>:<wal offset>") stamped by a
+    # front-door shard ingester when it delivers a WAL entry into this
+    # log (armada_tpu/frontdoor/partition.py). A restarted ingester scans
+    # the suffix for its own markers to dedup redelivery — exactly-once
+    # across crash/restart. "" for every direct publisher.
+    ingest_marker: str = ""
 
     @staticmethod
     def of(queue: str, jobset: str, *events: Event, user: str = "",

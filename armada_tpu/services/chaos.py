@@ -348,10 +348,13 @@ class CrashRecoveringLog:
     wrapper across "crashes"."""
 
     def __init__(self, directory: str, plan: FaultPlan | None = None,
-                 clock=None, **kwargs):
+                 clock=None, target: str = "log", **kwargs):
         self.directory = directory
         self.plan = plan
         self.clock = clock if clock is not None else _time.time
+        # Fault target this log answers to ("log" historically; front-door
+        # shard WALs use "shard-<i>" so one plan can tear a single shard).
+        self.target = target
         self.crashes = 0
         self._suppress_once = False
         kwargs["sync_every"] = 1
@@ -366,7 +369,7 @@ class CrashRecoveringLog:
             # virtual clock cannot advance inside one publish).
             self._suppress_once = False
             return None
-        spec = self.plan.fire("torn_log_write", "log", self.clock())
+        spec = self.plan.fire("torn_log_write", self.target, self.clock())
         if spec is None:
             return None
         frac = spec.param if 0.0 < spec.param < 1.0 else 0.5

@@ -10,6 +10,7 @@ as an alternate content type behind the same method table.
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import json
 import threading
@@ -17,6 +18,7 @@ import time as _time
 
 import grpc
 
+from ..frontdoor.admission import AdmissionError, DeadlineExpired
 from ..core.types import (
     Affinity,
     Gang,
@@ -57,6 +59,47 @@ def is_fenced_error(exc) -> bool:
         return callable(code) and code() == grpc.StatusCode.FAILED_PRECONDITION
     except Exception:
         return False
+
+
+# Absolute deadline (unix seconds) of the in-flight RPC, set by the unary
+# wrappers from gRPC's propagated client deadline (context.time_remaining)
+# so handlers — the submit path — can drop already-expired work early
+# instead of half-processing it. None = the caller set no deadline.
+_CALL_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
+    "armada_call_deadline", default=None
+)
+
+# Trailing-metadata key carrying the server-computed earliest useful retry
+# instant on RESOURCE_EXHAUSTED shed responses; ApiClient/ProtoApiClient
+# honor it with a bounded jittered backoff.
+RETRY_AFTER_KEY = "retry-after"
+
+
+def _retry_after_of(exc) -> float | None:
+    """Seconds the server asked us to wait, from a RESOURCE_EXHAUSTED
+    RpcError's trailing metadata — None for every other failure (other
+    codes, or exhaustion without a hint, e.g. a full what-if backlog)."""
+    code = getattr(exc, "code", None)
+    try:
+        if not callable(code) or code() != grpc.StatusCode.RESOURCE_EXHAUSTED:
+            return None
+        tm = getattr(exc, "trailing_metadata", None)
+        md = tm() if callable(tm) else None
+        for key, value in md or ():
+            if key.lower() == RETRY_AFTER_KEY:
+                return max(0.0, float(value))
+    except (TypeError, ValueError):
+        return None
+    return None
+
+
+def _call_deadline(context) -> object:
+    """Stamp the RPC's absolute deadline into _CALL_DEADLINE; returns the
+    reset token (None when the caller set no deadline)."""
+    remaining = context.time_remaining()
+    if remaining is None:
+        return None
+    return _CALL_DEADLINE.set(_time.time() + remaining)
 
 
 def _rpc_span(method: str, context):
@@ -351,6 +394,7 @@ class ApiServer:
         authorizer=None,
         event_index=None,
         store_health=None,
+        frontdoor=None,
     ):
         self.submit = submit
         self.scheduler = scheduler
@@ -358,6 +402,11 @@ class ApiServer:
         self.log = log
         self.submit_checker = submit_checker
         self.binoculars = binoculars
+        # Optional front door (armada_tpu/frontdoor): the submit handler
+        # observes its latency histogram and counts deadline drops
+        # against it; admission itself runs inside SubmitService.submit
+        # (one enforcement point for every transport).
+        self.frontdoor = frontdoor
         # Optional backpressure monitor (services/backpressure.py):
         # surfaced to executors in lease replies.
         self.store_health = store_health
@@ -421,16 +470,57 @@ class ApiServer:
     # ---- unary handlers ----
 
     def _submit_jobs(self, req):
-        jobs = [
-            job_spec_from_dict(j).with_(queue=req["queue"], jobset=req["jobset"])
-            for j in req["jobs"]
-        ]
-        if self.submit_checker is not None:
-            check = self.submit_checker.check(jobs)
-            if not check.schedulable:
-                raise ValueError(f"jobs would never schedule: {check.reason}")
-        ids = self.submit.submit(req["queue"], req["jobset"], jobs)
-        return {"job_ids": ids}
+        """Submit with the front door's protections when one is wired:
+        the propagated client deadline gates entry (expired work drops
+        before any processing — stage "gate" — or just before the WAL
+        ack — stage "enqueue"), admission sheds with AdmissionError
+        (RESOURCE_EXHAUSTED + retry-after on the wire), and the handler
+        wall clock lands in frontdoor_submit_seconds by outcome."""
+        fd = self.frontdoor
+        metrics = getattr(fd, "metrics", None) if fd is not None else None
+        started = _time.perf_counter()
+        # "error" covers everything that is neither an ack nor a
+        # deliberate shed/expiry (validation rejections, unknown queue):
+        # those requests were never acked and must not skew the ok-path
+        # ack-latency SLO.
+        outcome = "error"
+        try:
+            deadline_ts = req.get("deadline_ts") or _CALL_DEADLINE.get()
+            deadline_ts = float(deadline_ts) if deadline_ts else None
+            if deadline_ts is not None and _time.time() >= deadline_ts:
+                if fd is not None:
+                    fd.note_deadline_drop("gate")
+                raise DeadlineExpired(
+                    "gate", "client deadline expired before admission"
+                )
+            jobs = [
+                job_spec_from_dict(j).with_(
+                    queue=req["queue"], jobset=req["jobset"]
+                )
+                for j in req["jobs"]
+            ]
+            if self.submit_checker is not None:
+                check = self.submit_checker.check(jobs)
+                if not check.schedulable:
+                    raise ValueError(
+                        f"jobs would never schedule: {check.reason}"
+                    )
+            ids = self.submit.submit(
+                req["queue"], req["jobset"], jobs, deadline_ts=deadline_ts
+            )
+            outcome = "ok"
+            return {"job_ids": ids}
+        except AdmissionError:
+            outcome = "shed"
+            raise
+        except DeadlineExpired:
+            outcome = "expired"
+            raise
+        finally:
+            if metrics is not None and metrics.registry is not None:
+                metrics.frontdoor_submit_time.labels(
+                    outcome=outcome
+                ).observe(_time.perf_counter() - started)
 
     def _cancel_jobs(self, req):
         for job_id in req.get("job_ids", []):
@@ -1227,6 +1317,7 @@ class ApiServer:
             from ..whatif.planner import WhatIfBusyError
             from .chaos import CircuitOpenError
 
+            token = _call_deadline(context)
             with _rpc_span(method, context):
                 try:
                     out = fn(req) or {}
@@ -1236,6 +1327,19 @@ class ApiServer:
                     context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
                 except CircuitOpenError as e:
                     context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                except AdmissionError as e:
+                    # Shed with a machine-readable retry hint: clients
+                    # back off deliberately instead of timing out.
+                    context.set_trailing_metadata(
+                        ((RETRY_AFTER_KEY, f"{e.retry_after_s:.3f}"),)
+                    )
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED, str(e)
+                    )
+                except DeadlineExpired as e:
+                    context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED, str(e)
+                    )
                 except WhatIfBusyError as e:
                     context.abort(
                         grpc.StatusCode.RESOURCE_EXHAUSTED, str(e)
@@ -1244,6 +1348,9 @@ class ApiServer:
                     context.abort(
                         grpc.StatusCode.FAILED_PRECONDITION, str(e)
                     )
+                finally:
+                    if token is not None:
+                        _CALL_DEADLINE.reset(token)
             resp_tf = resp_transforms.get(method)
             if resp_tf is not None:
                 out = resp_tf(out)
@@ -1377,6 +1484,7 @@ class ApiServer:
 
                     req = _decode(request)
                     gate(method, req, context)
+                    token = _call_deadline(context)
                     with _rpc_span(method, context):
                         try:
                             return _encode(fn(req))
@@ -1388,6 +1496,18 @@ class ApiServer:
                             )
                         except CircuitOpenError as e:
                             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                        except AdmissionError as e:
+                            context.set_trailing_metadata(
+                                ((RETRY_AFTER_KEY,
+                                  f"{e.retry_after_s:.3f}"),)
+                            )
+                            context.abort(
+                                grpc.StatusCode.RESOURCE_EXHAUSTED, str(e)
+                            )
+                        except DeadlineExpired as e:
+                            context.abort(
+                                grpc.StatusCode.DEADLINE_EXCEEDED, str(e)
+                            )
                         except WhatIfBusyError as e:
                             context.abort(
                                 grpc.StatusCode.RESOURCE_EXHAUSTED, str(e)
@@ -1396,6 +1516,9 @@ class ApiServer:
                             context.abort(
                                 grpc.StatusCode.FAILED_PRECONDITION, str(e)
                             )
+                        finally:
+                            if token is not None:
+                                _CALL_DEADLINE.reset(token)
 
                 return grpc.unary_unary_rpc_method_handler(
                     unary, request_deserializer=bytes, response_serializer=bytes
@@ -1432,15 +1555,57 @@ CHANNEL_OPTIONS = (
 )
 
 
+def _retrying_call(invoke, retry_budget_s: float, seed: int = 0):
+    """Shared client retry loop: a RESOURCE_EXHAUSTED reply carrying the
+    server's `retry-after` trailing metadata (front-door shedding) is
+    retried after max(server hint, jittered exponential delay), with the
+    CUMULATIVE sleep capped by `retry_budget_s` — the executor-agent
+    lease path's bounded-backoff discipline applied to submit clients.
+    Every other failure (other codes, or exhaustion without a hint, e.g.
+    a full what-if backlog) raises immediately, as before."""
+    from .chaos import ExponentialBackoff
+
+    backoff = None
+    while True:
+        try:
+            return invoke()
+        except grpc.RpcError as e:
+            retry_after = _retry_after_of(e)
+            if retry_after is None or retry_budget_s <= 0:
+                raise
+            if backoff is None:
+                backoff = ExponentialBackoff(
+                    base_s=0.05, cap_s=5.0, seed=seed,
+                    budget_s=retry_budget_s,
+                )
+            if backoff.exhausted:
+                raise
+            jitter = backoff.next_delay()
+            # Clamp the server hint to the REMAINING budget (not the
+            # whole budget) so cumulative sleep stays <= retry_budget_s.
+            remaining = max(0.0, retry_budget_s - backoff.spent_s)
+            delay = max(jitter, min(retry_after, remaining))
+            # The server hint may exceed the jittered delay; charge the
+            # surplus against the budget so the streak stays bounded.
+            backoff.spent_s += max(0.0, delay - jitter)
+            if delay > 0:
+                _time.sleep(delay)
+
+
 class ApiClient:
     """Python client for the gRPC API (pkg/client + client/python analogue).
 
     Credentials: pass `token=` (Bearer JWT) or `basic=(user, password)` —
     the client attaches the authorization metadata the server's auth chain
-    expects (client/rust/src/auth.rs plays the same role)."""
+    expects (client/rust/src/auth.rs plays the same role).
+
+    Shed responses (RESOURCE_EXHAUSTED with the server's `retry-after`
+    hint) are retried with a bounded, jittered backoff; `retry_budget_s`
+    caps the cumulative sleep per call (0 disables retries)."""
 
     def __init__(self, target: str, token: str | None = None, basic=None,
-                 ca_cert: str | None = None):
+                 ca_cert: str | None = None, retry_budget_s: float = 30.0,
+                 retry_seed: int = 0):
         options = list(CHANNEL_OPTIONS)
         if ca_cert:
             with open(ca_cert, "rb") as f:
@@ -1448,6 +1613,8 @@ class ApiClient:
             self.channel = grpc.secure_channel(target, creds, options=options)
         else:
             self.channel = grpc.insecure_channel(target, options=options)
+        self.retry_budget_s = retry_budget_s
+        self._retry_seed = retry_seed
         self._metadata: list = []
         if token:
             self._metadata = [("authorization", f"Bearer {token}")]
@@ -1458,22 +1625,34 @@ class ApiClient:
             cred = base64.b64encode(f"{user}:{password}".encode()).decode()
             self._metadata = [("authorization", f"Basic {cred}")]
 
-    def _call(self, method: str, request: dict):
+    def _call(self, method: str, request: dict, timeout: float | None = None):
         fn = self.channel.unary_unary(
             f"/{SERVICE}/{method}",
             request_serializer=bytes,
             response_deserializer=bytes,
         )
-        return _decode(
-            fn(
-                _encode(request),
-                metadata=_inject_traceparent(self._metadata) or None,
+
+        def invoke():
+            return _decode(
+                fn(
+                    _encode(request),
+                    metadata=_inject_traceparent(self._metadata) or None,
+                    timeout=timeout,
+                )
             )
+
+        return _retrying_call(
+            invoke, self.retry_budget_s, seed=self._retry_seed
         )
 
-    def submit_jobs(self, queue, jobset, jobs: list[dict]):
+    def submit_jobs(self, queue, jobset, jobs: list[dict],
+                    deadline_s: float | None = None):
+        """`deadline_s` sets a gRPC deadline on the call; the server
+        propagates it through the admission gate and the ingest enqueue
+        (expired work is dropped early, never half-applied)."""
         return self._call(
-            "SubmitJobs", {"queue": queue, "jobset": jobset, "jobs": jobs}
+            "SubmitJobs", {"queue": queue, "jobset": jobset, "jobs": jobs},
+            timeout=deadline_s,
         )["job_ids"]
 
     def cancel_jobs(self, queue, jobset, job_ids=(), cancel_jobset=False, reason=""):
@@ -1650,7 +1829,8 @@ class ProtoApiClient:
     same generated armada_pb2 the server uses."""
 
     def __init__(self, target: str, token: str | None = None, basic=None,
-                 ca_cert: str | None = None):
+                 ca_cert: str | None = None, retry_budget_s: float = 30.0,
+                 retry_seed: int = 0):
         options = list(CHANNEL_OPTIONS)
         if ca_cert:
             with open(ca_cert, "rb") as f:
@@ -1658,6 +1838,10 @@ class ProtoApiClient:
             self.channel = grpc.secure_channel(target, creds, options=options)
         else:
             self.channel = grpc.insecure_channel(target, options=options)
+        # Shed responses retry like ApiClient: bounded jittered backoff
+        # honoring the server's retry-after hint.
+        self.retry_budget_s = retry_budget_s
+        self._retry_seed = retry_seed
         # Same credential surface as ApiClient: Bearer or Basic metadata
         # for the server's auth chain.
         self._metadata: list = []
@@ -1670,14 +1854,23 @@ class ProtoApiClient:
             cred = base64.b64encode(f"{user}:{password}".encode()).decode()
             self._metadata = [("authorization", f"Basic {cred}")]
 
-    def _unary(self, method: str, request, resp_type):
+    def _unary(self, method: str, request, resp_type,
+               timeout: float | None = None):
         fn = self.channel.unary_unary(
             f"/{PROTO_SERVICE}/{method}",
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=resp_type.FromString,
         )
-        return fn(
-            request, metadata=_inject_traceparent(self._metadata) or None
+
+        def invoke():
+            return fn(
+                request,
+                metadata=_inject_traceparent(self._metadata) or None,
+                timeout=timeout,
+            )
+
+        return _retrying_call(
+            invoke, self.retry_budget_s, seed=self._retry_seed
         )
 
     def submit_jobs(self, queue: str, jobset: str, items) -> list[str]:

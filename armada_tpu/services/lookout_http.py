@@ -62,13 +62,18 @@ def _parse_filters(params: dict) -> list[JobFilter]:
 class LookoutHttpServer:
     def __init__(self, query, scheduler, submit, port: int = 0,
                  bind: str = "127.0.0.1", tls: tuple | None = None,
-                 auth=None, authorizer=None, binoculars=None):
+                 auth=None, authorizer=None, binoculars=None,
+                 frontdoor=None):
         self.query = query
         self.scheduler = scheduler
         self.submit = submit
         # Optional log access (services/binoculars.py): the reference UI
         # fetches container logs through the binoculars service.
         self.binoculars = binoculars
+        # Optional front door (armada_tpu/frontdoor): /api/frontdoor
+        # serves shard lag + per-tenant admitted/shed — the overload
+        # runbook's "find the hot tenant" view.
+        self.frontdoor = frontdoor
         # Optional auth chain for the mutation endpoints (reads stay
         # open, like the reference's lookout deployment posture).
         self.auth = auth
@@ -366,6 +371,17 @@ class LookoutHttpServer:
                             "drains": svc.drain_status() or {},
                         }
                     )
+                elif parsed.path == "/api/frontdoor":
+                    # Front-door overload view (armada_tpu/frontdoor):
+                    # per-shard ingest lag / delivery counters and the
+                    # per-tenant admitted/shed table sorted hot-first —
+                    # the "Surviving an overload" runbook reads this to
+                    # identify the tenant to re-quota.
+                    if outer.frontdoor is None:
+                        self._json({"error": "front door not enabled"},
+                                   503)
+                        return
+                    self._json(outer.frontdoor.snapshot())
                 elif parsed.path.startswith("/api/jobtrace/"):
                     # Job journey (services/job_timeline.py): transitions
                     # + aggregated unschedulable-round history + trace id.

@@ -401,6 +401,55 @@ class SchedulerMetrics:
             ["resolution"],
             registry=r,
         )
+        # ---- front door (armada_tpu/frontdoor): the sharded-ingest +
+        # admission surface. Shard lag is the acked-but-undelivered
+        # backlog per ingest shard (the soak's SLO input); admitted/shed
+        # attribute intake decisions to TENANTS so an operator can find
+        # the hot queue during an overload (docs/operations.md runbook).
+        self.frontdoor_shard_lag = Gauge(
+            "frontdoor_shard_lag_events",
+            "Acked submissions not yet delivered into the main event "
+            "log, per ingest shard",
+            ["shard"],
+            registry=r,
+        )
+        self.frontdoor_admitted = Counter(
+            "frontdoor_admitted_total",
+            "Jobs admitted through the front door, by tenant (queue)",
+            ["tenant"],
+            registry=r,
+        )
+        self.frontdoor_shed = Counter(
+            "frontdoor_shed_total",
+            "Jobs shed by admission control, by tenant and reason class "
+            "(tenantRate / globalRate / overload)",
+            ["tenant", "reason"],
+            registry=r,
+        )
+        self.frontdoor_submit_time = Histogram(
+            "frontdoor_submit_seconds",
+            "Submit handler wall clock through admission + durable "
+            "shard-WAL ack, by outcome (ok / shed / expired / error)",
+            ["outcome"],
+            buckets=(0.0005, 0.002, 0.01, 0.05, 0.2, 1, 5),
+            registry=r,
+        )
+        self.frontdoor_deadline_drops = Counter(
+            "frontdoor_deadline_drops_total",
+            "Submissions dropped because the propagated client deadline "
+            "expired, by stage (gate = before processing, enqueue = "
+            "before the WAL append; acked work is never dropped)",
+            ["stage"],
+            registry=r,
+        )
+        self.frontdoor_delivered = Counter(
+            "frontdoor_delivered_total",
+            "Shard-ingester deliveries into the main log, by shard and "
+            "outcome (published / duplicate = suppressed redelivery "
+            "after a crash)",
+            ["shard", "outcome"],
+            registry=r,
+        )
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS:

@@ -116,9 +116,47 @@ class ControlPlane:
         self.submit_gate = CompositeGate(
             self.store_health, self.scheduler.round_pressure
         )
+        self.metrics = SchedulerMetrics()
+        self.scheduler.attach_metrics(self.metrics)
+        # Front door (armada_tpu/frontdoor): jobset-keyed sharded ingest
+        # WALs (the ack point; exactly-once delivery into the log) with
+        # per-tenant admission layered in front of the SAME composite
+        # gate — during overload the gate's reason drives quota-weighted
+        # shedding instead of the submit service's all-or-nothing check.
+        # Quota weight is the fair-share weight (1/priorityFactor), read
+        # lazily from the queue registry so `armadactl queue update`
+        # adjusts a tenant's slice live (the overload runbook's lever).
+        self.frontdoor = None
+        if self.config.frontdoor_shards > 0:
+            from ..frontdoor import FrontDoor, TenantAdmission
+
+            def _quota(tenant: str) -> float:
+                q = self.submit.get_queue(tenant)
+                return q.spec.weight if q is not None else 1.0
+
+            admission = TenantAdmission(
+                tenant_rate=self.config.frontdoor_tenant_rate,
+                tenant_burst=self.config.frontdoor_tenant_burst,
+                global_rate=self.config.frontdoor_global_rate,
+                global_burst=self.config.frontdoor_global_burst,
+                overload_rate=self.config.frontdoor_overload_rate,
+                downstream=self.submit_gate,
+                quota_of=_quota,
+                metrics=self.metrics,
+            )
+            self.frontdoor = FrontDoor(
+                self.log,
+                num_shards=self.config.frontdoor_shards,
+                directory=(
+                    os.path.join(data_dir, "frontdoor") if data_dir else None
+                ),
+                admission=admission,
+                metrics=self.metrics,
+            )
         self.submit = SubmitService(
             self.config, self.log, scheduler=self.scheduler,
             checkpoint=_ckpt("submit"), store_health=self.submit_gate,
+            frontdoor=self.frontdoor,
         )
         if self.store_health is not None:
             self.store_health.add_lag_source(
@@ -127,11 +165,15 @@ class ControlPlane:
                     0, self.log.end_offset - self.scheduler.ingester.cursor
                 ),
             )
+            if self.frontdoor is not None:
+                # Shard lag is ingest lag too: acked-but-undelivered work
+                # backs the store up just like an unsynced view.
+                self.store_health.add_lag_source(
+                    "frontdoor", self.frontdoor.max_lag
+                )
         self.query = QueryApi(
             self.scheduler.jobdb, timeline=self.scheduler.timeline
         )
-        self.metrics = SchedulerMetrics()
-        self.scheduler.attach_metrics(self.metrics)
         # What-if planner (armada_tpu/whatif): fork capture on the round
         # seam + bounded shadow-solve worker; the WhatIf/PlanDrain/
         # ExecuteDrain RPCs and lookout's /api/whatif reach it through
@@ -189,6 +231,7 @@ class ControlPlane:
             binoculars=self.binoculars,
             event_index=self.event_index,
             store_health=self.store_health,
+            frontdoor=self.frontdoor,
         )
         self.grpc_server, self.grpc_port = self.api.serve(grpc_port, tls=tls)
         self.metrics_server, self.metrics_port = (
@@ -212,6 +255,14 @@ class ControlPlane:
             self.checkpoints.register("submit", self.submit)
             self.checkpoints.register("event_index", self.event_index)
             self.checkpoints.register("lookout", self.lookout_store)
+            if self.frontdoor is not None:
+                # The shard ingesters' recovery scan starts at their
+                # durably saved main-log offsets (drain.json, not the
+                # checkpoint store) — register the front door so
+                # compaction never deletes the dedup window out from
+                # under a restarting shard (idle shards report the log
+                # end, not 0, so they cannot stall compaction).
+                self.checkpoints.register("frontdoor", self.frontdoor)
         self.lookout = None
         if lookout_port is not None:
             from .lookout_http import LookoutHttpServer
@@ -225,6 +276,7 @@ class ControlPlane:
                 self.submit,
                 lookout_port,
                 binoculars=self.binoculars,
+                frontdoor=self.frontdoor,
             )
         # Health surface (common/health; schedulerapp.go:71-75).
         from .health import (
@@ -290,6 +342,11 @@ class ControlPlane:
         while not self._stop.is_set():
             started = _time.time()
             now = _time.time()
+            if self.frontdoor is not None:
+                # Drain the shard WALs into the log BEFORE the cycle so
+                # this round sees everything acked up to now; injected
+                # shard crashes restart in place inside pump().
+                self.frontdoor.pump(now=now)
             for ex in self.executors:
                 ex.tick(now)
             try:
@@ -381,6 +438,8 @@ class ControlPlane:
             self.lookout.stop()
         if self.health_server:
             self.health_server.shutdown()
+        if self.frontdoor is not None:
+            self.frontdoor.close()
         if hasattr(self.log, "close"):
             self.log.close()
 

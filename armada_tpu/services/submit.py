@@ -50,7 +50,7 @@ class Queue:
 
 class SubmitService:
     def __init__(self, config: SchedulingConfig, log, scheduler=None,
-                 checkpoint=None, store_health=None):
+                 checkpoint=None, store_health=None, frontdoor=None):
         self.config = config
         self.log = log
         self.scheduler = scheduler  # optional: queue updates pushed through
@@ -58,6 +58,15 @@ class SubmitService:
         # -> (healthy, reason); submissions are shed while the store is
         # backed up (the reference rejects work on etcd capacity).
         self.store_health = store_health
+        # Optional front door (armada_tpu/frontdoor): job submissions
+        # route through per-tenant admission and a jobset-keyed shard WAL
+        # (the ack point) instead of publishing straight to the log; the
+        # shard ingesters deliver into the log exactly-once. Queue CRUD
+        # and cancel/reprioritise stay on the direct path (control-plane
+        # volume, not flood surface). When set, the front door's
+        # admission owns backpressure shedding (it wraps the same gate),
+        # so the raw store_health check above is skipped.
+        self.frontdoor = frontdoor
         self.queues: dict[str, Queue] = {}
         self._dedup: dict[tuple, str] = {}  # (queue, dedup_id) -> job_id
         self._cursor = 0  # log offset the view reflects
@@ -201,19 +210,29 @@ class SubmitService:
     # ---- submission (internal/server/submit/submit.go) ----
 
     def submit(
-        self, queue: str, jobset: str, jobs: list[JobSpec], now: float | None = None
+        self, queue: str, jobset: str, jobs: list[JobSpec],
+        now: float | None = None, deadline_ts: float | None = None,
     ) -> list[str]:
-        """Validate + publish; returns job ids (existing ids for dedup hits)."""
-        if self.store_health is not None:
+        """Validate + publish; returns job ids (existing ids for dedup
+        hits). `deadline_ts` is the caller's propagated deadline (same
+        clock as `now`): expired work is dropped before the durable
+        enqueue — acked work always applies, never half."""
+        if self.store_health is not None and self.frontdoor is None:
             healthy, reason = self.store_health.check()
             if not healthy:
                 raise SubmissionError(f"store backpressure: {reason}")
         if queue not in self.queues:
             raise SubmissionError(f"queue {queue!r} does not exist")
         now = _time.time() if now is None else now
+        if self.frontdoor is not None:
+            # Per-tenant admission (token buckets + quota-weighted
+            # overload shedding) counts JOBS, not RPCs — raises
+            # AdmissionError with a retry-after the transport forwards.
+            self.frontdoor.admit(queue, len(jobs), now=now)
         self._validate_gangs(jobs)
         events = []
         job_ids = []
+        added_dedup = []
         for job in jobs:
             job = self._validate_and_default(queue, jobset, job, now)
             dedup_key = None
@@ -225,6 +244,7 @@ class SubmitService:
                     continue
             if dedup_key:
                 self._dedup[dedup_key] = job.id
+                added_dedup.append(dedup_key)
             job_ids.append(job.id)
             events.append(SubmitJob(created=now, job=job, deduplication_id=dedup_id))
         if events:
@@ -235,12 +255,26 @@ class SubmitService:
             # from submit RPC through lease.
             from ..utils.tracing import TRACER
 
-            self.log.publish(
-                EventSequence.of(
-                    queue, jobset, *events,
-                    traceparent=TRACER.current_traceparent(),
-                )
+            seq = EventSequence.of(
+                queue, jobset, *events,
+                traceparent=TRACER.current_traceparent(),
             )
+            if self.frontdoor is not None:
+                # Durable shard-WAL append IS the acknowledgement; the
+                # deadline is checked one last time immediately before it
+                # (drop early, whole — never a half-applied batch). A
+                # dropped batch must not leave phantom dedup entries: a
+                # later retry with the same dedup ids has to re-publish.
+                try:
+                    self.frontdoor.append(
+                        seq, deadline_ts=deadline_ts, now=now
+                    )
+                except Exception:
+                    for key in added_dedup:
+                        self._dedup.pop(key, None)
+                    raise
+            else:
+                self.log.publish(seq)
         return job_ids
 
     def _validate_and_default(

@@ -139,6 +139,11 @@ class Simulator:
         # (fork capture on the round seam + bounded shadow-solve
         # worker) so sim tests exercise planning against live sim state.
         whatif=False,
+        # Front door (armada_tpu/frontdoor): an int routes submissions
+        # through that many jobset-keyed ingest shards (pumped before
+        # every cycle on the virtual clock, chaos plan included); a
+        # prebuilt FrontDoor attaches as-is. 0/None = direct publish.
+        frontdoor=None,
     ):
         self.config = config or SchedulingConfig()
         self.rng = np.random.default_rng(seed)
@@ -186,7 +191,27 @@ class Simulator:
             self.config, self.log, backend=backend, mesh=mesh,
             snapshot_mode=snapshot_mode, is_leader=is_leader,
         )
-        self.submit = SubmitService(self.config, self.log, scheduler=self.scheduler)
+        self.frontdoor = None
+        if frontdoor:
+            from ..frontdoor import FrontDoor
+            from ..services.chaos import VirtualClock
+
+            if self.chaos_clock is None:
+                self.chaos_clock = VirtualClock()
+            self.frontdoor = (
+                frontdoor
+                if not isinstance(frontdoor, (int, bool))
+                else FrontDoor(
+                    self.log,
+                    num_shards=int(frontdoor) if frontdoor is not True else 2,
+                    fault_plan=fault_plan,
+                    clock=self.chaos_clock,
+                )
+            )
+        self.submit = SubmitService(
+            self.config, self.log, scheduler=self.scheduler,
+            frontdoor=self.frontdoor,
+        )
         self.span_tracer = None
         if span_path is not None:
             from ..utils.tracing import OtlpJsonFileExporter, Tracer
@@ -333,6 +358,11 @@ class Simulator:
                 self.submit.submit(queue, jobset, jobs, now=t)
                 sub_idx += 1
 
+            if self.frontdoor is not None:
+                # Drain shard WALs before the round on the same virtual
+                # instant: acked work is visible to the cycle unless a
+                # shard is partitioned/crash-looping in this window.
+                self.frontdoor.pump(now=t)
             for ex in self.executors:
                 ex.tick(t)
             seqs = self.scheduler.cycle(now=t)
@@ -348,7 +378,15 @@ class Simulator:
             states = [j.state for j in txn.all_jobs()]
             finished = sum(1 for s in states if s.terminal)
             all_submitted = sub_idx >= len(self._pending_submissions)
-            if all_submitted and states and finished == len(states):
+            if (
+                all_submitted
+                and states
+                and finished == len(states)
+                and (self.frontdoor is None or self.frontdoor.max_lag() == 0)
+            ):
+                # With a front door, acked-but-undelivered work is still
+                # in a shard WAL (e.g. behind a partition window): the
+                # sim keeps stepping until every ack lands and finishes.
                 break
 
             # Advance virtual time: next interesting instant. Only FUTURE

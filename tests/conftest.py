@@ -49,6 +49,34 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 import pytest  # noqa: E402
 
 
+def pytest_sessionstart(session):
+    """Fail fast on orphaned bytecode: a `__pycache__/mod.*.pyc` whose
+    `mod.py` source is gone (a deleted or renamed module, e.g. the
+    remnants of a discarded front-door attempt) still satisfies imports
+    on this interpreter and can silently shadow the real tree. Delete
+    the stale .pyc instead of exempting it here."""
+    root = os.environ["REPO_ROOT"]
+    orphans = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if not d.startswith(".")]
+        if os.path.basename(dirpath) != "__pycache__":
+            continue
+        src_dir = os.path.dirname(dirpath)
+        for name in filenames:
+            if not name.endswith(".pyc"):
+                continue
+            module = name.split(".", 1)[0]
+            if not os.path.exists(os.path.join(src_dir, module + ".py")):
+                orphans.append(os.path.relpath(
+                    os.path.join(dirpath, name), root
+                ))
+    if orphans:
+        raise pytest.UsageError(
+            "orphaned __pycache__ bytecode without a matching .py source "
+            "(can shadow imports; delete them): " + ", ".join(sorted(orphans))
+        )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_jit_cache():
     yield

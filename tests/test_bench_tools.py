@@ -195,9 +195,46 @@ def test_trend_tolerates_and_shows_whatif_block(tmp_path):
     assert "whatif" in proc.stdout
     lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
     assert "3@0.42s" in lines["BENCH_r02.json"]
-    assert lines["BENCH_r03.json"].rstrip().endswith("yes")
+    assert lines["BENCH_r03.json"].split()[-2] == "yes"  # whatif column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_whatif))["warm"] == 3.0
+
+
+def test_trend_tolerates_and_shows_frontdoor_block(tmp_path):
+    """Artifacts carrying the extra.frontdoor SLO block
+    (tools/frontdoor_soak.py --out) render a frontdoor column —
+    p99/max-lag, '!' on a breached gate; old artifacts print '-'."""
+    with_fd = json.loads(json.dumps(NEW_SCHEMA))
+    with_fd["parsed"]["extra"]["frontdoor"] = {
+        "p99_ms": 17.0, "max_lag": 13, "ok": True,
+    }
+    breached = json.loads(json.dumps(NEW_SCHEMA))
+    breached["parsed"]["extra"]["frontdoor"] = {
+        "p99_ms": 300.0, "max_lag": 5000, "ok": False,
+    }
+    bare = json.loads(json.dumps(NEW_SCHEMA))
+    bare["parsed"]["extra"]["frontdoor"] = {"enabled": True}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(OLD_SCHEMA))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(with_fd))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(breached))
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(bare))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "bench_trend.py"),
+            "--dir", str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "frontdoor" in proc.stdout
+    lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
+    assert lines["BENCH_r01.json"].rstrip().endswith("-")
+    assert "17ms/13" in lines["BENCH_r02.json"]
+    assert "300ms/5000!" in lines["BENCH_r03.json"]
+    assert lines["BENCH_r04.json"].rstrip().endswith("yes")
+    # The gate's metric extraction is unaffected by the extra block.
+    assert extract_metrics(parse_artifact(with_fd))["warm"] == 3.0
 
 
 def test_trend_shows_effective_params_column(tmp_path):

@@ -73,6 +73,12 @@ EXEMPT_LABELED = {
     "scheduler_jobs_preempted_by_type",
 }
 
+# Front-door families are exempt from the sim sweep BY PREFIX (the sim
+# publishes directly; the front door is off) — every one of them is
+# liveness-asserted instead by test_frontdoor_families_live_after_short_soak
+# below, which auto-covers families added later.
+FRONTDOOR_PREFIX = "frontdoor_"
+
 
 def _labeled_sample_counts(m: SchedulerMetrics) -> dict:
     """family name -> sample count, for every LABELED metric attribute
@@ -244,6 +250,7 @@ def test_every_labeled_family_live_after_short_sim(tmp_path):
     dead = sorted(
         name for name, n in counts.items()
         if n == 0 and name not in EXEMPT_LABELED
+        and not name.startswith(FRONTDOOR_PREFIX)
     )
     assert not dead, f"labeled metric families never set by the sim: {dead}"
     live_exempt = sorted(
@@ -259,3 +266,61 @@ def test_every_labeled_family_live_after_short_sim(tmp_path):
     for attr, metric in vars(m).items():
         for family in getattr(metric, "collect", lambda: [])():
             assert family.name in rendered, family.name
+
+
+def test_frontdoor_families_live_after_short_soak():
+    """Every labeled frontdoor_* family must carry samples after a short
+    front-door soak: admitted + shed (tenant flood), a deadline drop at
+    the gate, and a pump that delivers and observes shard lag. New
+    frontdoor_* families are auto-covered — register one and leave it
+    unwired and this test fails."""
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.core.types import QueueSpec
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.frontdoor import (
+        AdmissionError,
+        DeadlineExpired,
+        FrontDoor,
+        TenantAdmission,
+    )
+    from armada_tpu.services.grpc_api import ApiServer
+    from armada_tpu.services.submit import SubmitService
+
+    m = SchedulerMetrics()
+    log = InMemoryEventLog()
+    admission = TenantAdmission(
+        tenant_rate=5.0, tenant_burst=5.0, metrics=m
+    )
+    fd = FrontDoor(log, num_shards=2, admission=admission, metrics=m)
+    submit = SubmitService(SchedulingConfig(), log, frontdoor=fd)
+    submit.create_queue(QueueSpec("hot"))
+    api = ApiServer(submit, None, None, log, frontdoor=fd)
+    job = {"requests": {"cpu": "1", "memory": "1Gi"}}
+    shed = 0
+    for k in range(12):  # burst 5: the flood sheds the tail
+        try:
+            api._submit_jobs(
+                {"queue": "hot", "jobset": f"js{k % 3}", "jobs": [job]}
+            )
+        except AdmissionError:
+            shed += 1
+    assert shed > 0
+    import time as _t
+
+    with pytest.raises(DeadlineExpired):
+        api._submit_jobs(
+            {"queue": "hot", "jobset": "js0", "jobs": [job],
+             "deadline_ts": _t.time() - 1.0}
+        )
+    fd.pump()
+    counts = _labeled_sample_counts(m)
+    frontdoor_families = {
+        name for name in counts if name.startswith(FRONTDOOR_PREFIX)
+    }
+    assert frontdoor_families, "no frontdoor_* families registered"
+    dead = sorted(
+        name for name in frontdoor_families if counts[name] == 0
+    )
+    assert not dead, (
+        f"frontdoor_* families never set by the soak: {dead}"
+    )

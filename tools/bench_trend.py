@@ -33,7 +33,8 @@ def rows(search_dir: str) -> list[dict]:
     ):
         row = {"round": os.path.basename(path), "warm": None,
                "tracking": None, "burst": None, "solve": None,
-               "trace": False, "params": None, "whatif": None}
+               "trace": False, "params": None, "whatif": None,
+               "frontdoor": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -65,6 +66,22 @@ def rows(search_dir: str) -> list[dict]:
                 if isinstance(plans, int) and isinstance(plan_s, (int, float))
                 else "yes"
             )
+        frontdoor = extra.get("frontdoor") if isinstance(extra, dict) else None
+        if isinstance(frontdoor, dict):
+            # Front-door SLO block (tools/frontdoor_soak.py --out):
+            # worst-seed submit p99 + max shard ingest lag; a "!" marks
+            # a run whose soak breached its gate. Old artifacts simply
+            # lack the block.
+            p99 = frontdoor.get("p99_ms")
+            lag = frontdoor.get("max_lag")
+            row["frontdoor"] = (
+                (
+                    f"{p99:.0f}ms/{lag}"
+                    if isinstance(p99, (int, float)) and isinstance(lag, int)
+                    else "yes"
+                )
+                + ("" if frontdoor.get("ok", True) else "!")
+            )
         params = extra.get("params") if isinstance(extra, dict) else None
         if isinstance(params, dict):
             # Effective headline solver parameters (window/chunk, "*"
@@ -89,7 +106,8 @@ def main(argv=None) -> int:
         return 1
     header = (
         f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} "
-        f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6} {'whatif':>9}"
+        f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6} {'whatif':>9} "
+        f"{'frontdoor':>10}"
     )
     print(header)
     print("-" * len(header))
@@ -99,7 +117,8 @@ def main(argv=None) -> int:
             f"{_fmt(r['tracking']):>10} {_fmt(r['burst']):>8} "
             f"{r.get('params') or '-':>10} "
             f"{'yes' if r.get('trace') else '-':>6} "
-            f"{r.get('whatif') or '-':>9}"
+            f"{r.get('whatif') or '-':>9} "
+            f"{r.get('frontdoor') or '-':>10}"
         )
     return 0
 
