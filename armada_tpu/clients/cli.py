@@ -173,6 +173,43 @@ def cmd_job_trace(args):
         print(trace["rendered"])
 
 
+def cmd_slo(args):
+    """Print the declared SLOs with compliance and multi-window burn
+    rates (services/slo.py; GET /api/slo serves the same document)."""
+    client = connect(args.server, ca_cert=args.ca_cert or None)
+    status = client.slo_status()
+    if args.json:
+        _print(status)
+        return
+    for s in status.get("slos", []):
+        compliance = s.get("compliance")
+        fast, slow = s["burn"]["fast"], s["burn"]["slow"]
+        # Live state comes from the CURRENT burn windows; a historical
+        # multiwindow alert renders as a suffix, not a latched state —
+        # a long-lived control plane recovers in this view (the gate's
+        # breach memory lives in evaluate(), where it belongs).
+        state = "ALERTING" if s.get("alerting") else "ok"
+        history = (
+            f"  (burn alert fired at t={s['breached_at']:.1f})"
+            if s.get("breached_at") is not None and not s.get("alerting")
+            else ""
+        )
+        print(
+            f"{s['name']}: {state}  "
+            f"objective {s['objective']:.3f} on {s['signal']} <= "
+            f"{s['threshold_s']}s  "
+            + (
+                f"compliance {compliance:.4f} "
+                if compliance is not None
+                else "compliance - "
+            )
+            + f"({s['good']}/{s['observed']} good)  burn "
+            f"fast {fast['rate']:.2f}x/{fast['threshold']:.0f}x "
+            f"slow {slow['rate']:.2f}x/{slow['threshold']:.0f}x"
+            + history
+        )
+
+
 def _whatif_mutations(args) -> list[dict]:
     """Mutation dicts from the repeatable whatif flags (the same
     vocabulary every surface speaks, whatif/mutations.py)."""
@@ -435,6 +472,13 @@ def build_parser():
     jt.add_argument("--json", action="store_true",
                     help="raw journey record instead of the rendered text")
     jt.set_defaults(fn=cmd_job_trace)
+
+    slo = sub.add_parser(
+        "slo",
+        help="show declared SLOs with compliance and burn rates",
+    )
+    slo.add_argument("--json", action="store_true")
+    slo.set_defaults(fn=cmd_slo)
 
     wi = sub.add_parser(
         "whatif",

@@ -76,6 +76,33 @@ class OptimiserConfig:
 
 
 @dataclass(frozen=True)
+class SLOSpec:
+    """A declared service-level objective over one latency signal
+    (services/slo.py tracks it; tools/slo_gate.py gates runs on it).
+
+    An observation of `signal` counts GOOD iff value <= threshold_s;
+    the objective is the required good fraction. Burn rate is the
+    error rate divided by the error budget (1 - objective): 1.0 means
+    spending the budget exactly; the multiwindow alert fires when the
+    fast AND slow windows both exceed their thresholds (the SRE
+    -workbook multiwindow multi-burn-rate shape, defaults 14x/6x)."""
+
+    name: str
+    # round_seconds (scheduler cycle wall clock), queue_wait_seconds
+    # (submit→first-lease per job), frontdoor_submit_seconds (submit
+    # handler through admission + durable ack). Open vocabulary: soaks
+    # may declare extra signals (e.g. shard lag).
+    signal: str
+    threshold_s: float
+    objective: float = 0.99
+    fast_burn_window_s: float = 300.0
+    slow_burn_window_s: float = 3600.0
+    fast_burn_threshold: float = 14.0
+    slow_burn_threshold: float = 6.0
+    description: str = ""
+
+
+@dataclass(frozen=True)
 class GangDefinition:
     """A gang shape the indicative pricer quotes every round
     (configuration.GangDefinition, configuration.go:449-456)."""
@@ -269,6 +296,13 @@ class SchedulingConfig:
     # Terminal jobs older than this are pruned from the in-memory store
     # (the reference's lookout/scheduler DB pruners).
     terminal_job_retention_s: float = 24 * 3600.0
+    # Declared SLOs (services/slo.py): round-latency / queue-wait /
+    # front-door objectives tracked with multi-window burn rates and
+    # surfaced via `GET /api/slo`, `armadactl slo` and the
+    # scheduler_slo_* metric families; tools/slo_gate.py gates runs on
+    # them. Empty = services/slo.DEFAULT_SLOS when a tracker is built
+    # from config.
+    slos: tuple = ()
     # Market-driven scheduling (experimental in the reference,
     # scheduling_algo.go:795-813): candidates ordered by bid price instead
     # of fair share; every bound job is evictable each round; a spot price
@@ -422,6 +456,31 @@ class SchedulingConfig:
             }
         if "defaultPriorityClassName" in d:
             kwargs["default_priority_class"] = d["defaultPriorityClassName"]
+        if "slos" in d:
+            kwargs["slos"] = tuple(
+                SLOSpec(
+                    name=s["name"],
+                    signal=s["signal"],
+                    threshold_s=float(
+                        s.get("thresholdSeconds", s.get("threshold_s", 0))
+                    ),
+                    objective=float(s.get("objective", 0.99)),
+                    fast_burn_window_s=float(
+                        s.get("fastBurnWindowSeconds", 300.0)
+                    ),
+                    slow_burn_window_s=float(
+                        s.get("slowBurnWindowSeconds", 3600.0)
+                    ),
+                    fast_burn_threshold=float(
+                        s.get("fastBurnThreshold", 14.0)
+                    ),
+                    slow_burn_threshold=float(
+                        s.get("slowBurnThreshold", 6.0)
+                    ),
+                    description=s.get("description", ""),
+                )
+                for s in d["slos"]
+            )
         if "dominantResourceFairnessResourcesToConsider" in d:
             kwargs["dominant_resource_fairness_resources"] = {
                 name: 1.0 for name in d["dominantResourceFairnessResourcesToConsider"]
@@ -653,6 +712,25 @@ def validate_config(config: SchedulingConfig):
                                 "is enabled")
     if config.executor_lease_ttl_s < 0:
         problems.append("executorLeaseTTL must be >= 0")
+    seen_slos = set()
+    for slo in config.slos:
+        if not slo.name or slo.name in seen_slos:
+            problems.append(f"slos: missing or duplicate name {slo.name!r}")
+        seen_slos.add(slo.name)
+        if slo.threshold_s <= 0:
+            problems.append(f"slo {slo.name!r}: thresholdSeconds must be > 0")
+        if not (0.0 < slo.objective < 1.0):
+            problems.append(
+                f"slo {slo.name!r}: objective must be in (0, 1) — an "
+                "objective of 1.0 leaves no error budget to burn"
+            )
+        if slo.fast_burn_window_s <= 0 or slo.slow_burn_window_s <= 0:
+            problems.append(f"slo {slo.name!r}: burn windows must be > 0")
+        if slo.fast_burn_window_s > slo.slow_burn_window_s:
+            problems.append(
+                f"slo {slo.name!r}: fast burn window must not exceed the "
+                "slow one"
+            )
     if config.truncated_rounds_backpressure < 1:
         problems.append("truncatedRoundsBackpressure must be >= 1")
     for name, frac in config.maximum_resource_fraction_to_schedule.items():

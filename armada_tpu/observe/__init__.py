@@ -1,0 +1,32 @@
+"""Round observatory: host↔device transfer ledger + compile telemetry.
+
+ROADMAP item 1 (device-resident round state) is a host↔device-churn
+refactor, and nothing measured the churn: `jax.device_put` sites moved
+unquantified bytes, retraces/compiles were invisible outside XLA log
+spam, and "the round is snapshot-bound" was an inference from wall
+clocks, not an accounting. This package is the measurement substrate
+that makes that refactor executable and provable:
+
+- `ledger`   — a per-round transfer ledger booking bytes-up/bytes-down,
+  array counts and donated-vs-copied buffers at every instrumented
+  host↔device seam (solver/kernel.solve_round, parallel/mesh
+  place_round, bench's _put), surfaced through `out["profile"]`,
+  `scheduler_round_transfer_*` metrics, round-span attributes and the
+  flight-recorder round records;
+- `xla`      — compile/retrace telemetry off `jax.monitoring`: tracing
+  events, backend compile wall clock and compile-cache hits/misses,
+  surfaced as `scheduler_xla_compiles_total` /
+  `scheduler_xla_compile_seconds` and as a `retrace` divergence class
+  in trace replay (a warm shape that recompiles is a bug signal).
+"""
+
+from .ledger import (  # noqa: F401
+    TransferLedger,
+    active_ledger,
+    note_donated,
+    note_down,
+    note_up,
+    round_ledger,
+    tree_transfer_size,
+)
+from .xla import TELEMETRY, CompileTelemetry, install_compile_telemetry  # noqa: F401

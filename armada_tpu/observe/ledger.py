@@ -1,0 +1,163 @@
+"""Host↔device transfer ledger.
+
+Books the bytes a scheduling round moves between host and device — the
+cost ROADMAP item 1 (device-resident round state) exists to kill, and
+the number nothing in the repo measured before this module. The design
+constraint is that accounting must be free at round scale: every note_*
+call is a host-side pytree walk summing `.nbytes` of array leaves — no
+device sync, no data copy, microseconds against a multi-second solve.
+
+Usage: a scope that wants a ledger activates one,
+
+    with round_ledger() as led:
+        out = solve_round(dev)
+    led.as_dict()  # bytes_up / bytes_down / donated / array counts
+
+and the instrumented seams (solver/kernel.solve_round's device_put and
+chunk donations, parallel/mesh.place_round, bench's _put) call the
+module-level `note_up` / `note_down` / `note_donated`, which book into
+EVERY ledger on the current thread's stack — so a scheduler-round
+ledger and solve_round's own per-solve ledger each see a complete
+picture without threading a handle through the call graph. With no
+active ledger the notes are near-free no-ops.
+
+Vocabulary (one row per direction in `scheduler_round_transfer_*`):
+
+- up      — host arrays uploaded to device (fresh copies: the cost a
+            resident round would not pay);
+- down    — device results materialized back on host (np.asarray of
+            solver outputs);
+- donated — device buffers the solve updated IN PLACE via buffer
+            donation (the chunked pass-1 carries, hot-window
+            scatter-back): traffic the donation machinery already
+            avoided, booked so the copied-vs-donated split is visible.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TransferLedger:
+    bytes_up: int = 0
+    arrays_up: int = 0
+    bytes_down: int = 0
+    arrays_down: int = 0
+    donated_bytes: int = 0
+    donated_buffers: int = 0
+    # Free-form site counters ({"h2d": n, ...}) for debugging which seam
+    # booked what; not part of the metric surface.
+    sites: dict = field(default_factory=dict)
+
+    def note(self, direction: str, nbytes: int, arrays: int, site: str = ""):
+        if direction == "up":
+            self.bytes_up += nbytes
+            self.arrays_up += arrays
+        elif direction == "down":
+            self.bytes_down += nbytes
+            self.arrays_down += arrays
+        elif direction == "donated":
+            self.donated_bytes += nbytes
+            self.donated_buffers += arrays
+        else:  # pragma: no cover - caller bug
+            raise ValueError(f"unknown transfer direction {direction!r}")
+        if site:
+            self.sites[site] = self.sites.get(site, 0) + 1
+
+    def as_dict(self) -> dict:
+        """The round-record / bench / metrics payload (ints only — this
+        travels through JSON in .atrace rounds and bench artifacts)."""
+        return {
+            "bytes_up": int(self.bytes_up),
+            "arrays_up": int(self.arrays_up),
+            "bytes_down": int(self.bytes_down),
+            "arrays_down": int(self.arrays_down),
+            "donated_bytes": int(self.donated_bytes),
+            "donated_buffers": int(self.donated_buffers),
+        }
+
+
+_LOCAL = threading.local()
+
+
+def _stack() -> list:
+    stack = getattr(_LOCAL, "stack", None)
+    if stack is None:
+        stack = _LOCAL.stack = []
+    return stack
+
+
+def active_ledger() -> TransferLedger | None:
+    """The innermost active ledger on this thread, or None."""
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def round_ledger(ledger: TransferLedger | None = None):
+    """Activate a ledger for the dynamic extent of the block. Nests:
+    notes inside book into every ledger on the stack, so an outer
+    (scheduler-round) ledger still sees transfers that an inner
+    (per-solve) ledger also claims."""
+    led = ledger if ledger is not None else TransferLedger()
+    stack = _stack()
+    stack.append(led)
+    try:
+        yield led
+    finally:
+        stack.pop()
+
+
+def tree_transfer_size(tree, host_only: bool = False) -> tuple[int, int]:
+    """(bytes, arrays) across a pytree's array leaves. Host-side only:
+    reads shapes/dtypes, never device data. `host_only=True` counts
+    np.ndarray leaves exclusively — leaves already living on device
+    (jax.Array) cost nothing to "upload" again and must not inflate the
+    up column when an already-placed round is re-solved."""
+    import jax
+    import numpy as np
+
+    nbytes = 0
+    arrays = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if host_only and not isinstance(leaf, np.ndarray):
+            continue
+        n = getattr(leaf, "nbytes", None)
+        if n is None:
+            size = getattr(leaf, "size", None)
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+            if size is None or itemsize is None:
+                continue
+            n = int(size) * int(itemsize)
+        nbytes += int(n)
+        arrays += 1
+    return nbytes, arrays
+
+
+def _note(direction: str, tree, site: str, host_only: bool = False):
+    stack = _stack()
+    if not stack:
+        return
+    nbytes, arrays = tree_transfer_size(tree, host_only=host_only)
+    for led in stack:
+        led.note(direction, nbytes, arrays, site=site)
+
+
+def note_up(tree, site: str = "h2d"):
+    """Book a host→device upload: only np.ndarray (host) leaves count —
+    leaves already on device are not a transfer."""
+    _note("up", tree, site, host_only=True)
+
+
+def note_down(tree, site: str = "d2h"):
+    """Book a device→host materialization of every array leaf."""
+    _note("down", tree, site)
+
+
+def note_donated(tree, site: str = "donate"):
+    """Book buffers updated in place through donation (no copy moved,
+    which is exactly why the split is worth recording)."""
+    _note("donated", tree, site)

@@ -1,0 +1,155 @@
+"""Compile/retrace telemetry off `jax.monitoring`.
+
+Retraces and XLA compiles were invisible outside TF_CPP log spam: a
+warm scheduling cycle that quietly re-traced a jitted entrypoint (a
+drifted static arg, a new padded shape bucket) paid seconds of compile
+inside what the profile called "solve". jax.monitoring publishes
+exactly the events needed:
+
+    /jax/core/compile/jaxpr_trace_duration      — one per (re)trace
+    /jax/core/compile/backend_compile_duration  — one per XLA compile
+    /jax/compilation_cache/cache_hits|misses    — persistent-cache use
+
+`CompileTelemetry` accumulates them process-wide (the listeners are
+registered once, from `utils/platform.enable_persistent_compile_cache`
+— the same place that configures the cache these counters describe);
+callers snapshot before a region and diff after:
+
+    snap = TELEMETRY.snapshot()
+    out = solve_round(dev)
+    delta = TELEMETry.delta_since(snap)   # {"traces": 0, ...} when warm
+
+The scheduler folds the per-round delta into `out["profile"]`, bench
+into `extra.transfer`, and trace replay flags any compile on an
+already-seen round shape as a `retrace` divergence.
+"""
+
+from __future__ import annotations
+
+import threading
+
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+# The keys of a telemetry snapshot/delta, in reporting order.
+FIELDS = ("traces", "compiles", "compile_seconds", "cache_hits", "cache_misses")
+
+
+class CompileTelemetry:
+    """Monotonic counters, process-wide AND per-thread; thread-safe
+    (XLA may compile on any thread — and jax traces/compiles run
+    synchronously on the DISPATCHING thread, which is what makes the
+    per-thread view sound). Bracketing callers that can run
+    concurrently with other solves (the scheduler's live round vs a
+    what-if rollout on the planner's worker pool) must use
+    thread_snapshot(), or a neighbour thread's compile lands in their
+    delta as a phantom warm recompile. All reads go through
+    snapshot()/thread_snapshot()/delta_since() so callers never see a
+    torn multi-field update."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._installed = False
+        self._local = threading.local()
+        self.traces = 0
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    def _thread_counts(self) -> dict:
+        counts = getattr(self._local, "counts", None)
+        if counts is None:
+            counts = self._local.counts = {
+                "traces": 0, "compiles": 0, "compile_seconds": 0.0,
+                "cache_hits": 0, "cache_misses": 0,
+            }
+        return counts
+
+    # -- listener plumbing --------------------------------------------
+
+    def install(self) -> bool:
+        """Register the jax.monitoring listeners (idempotent). Returns
+        whether telemetry is live — False when jax.monitoring is
+        unavailable, in which case every delta reads as zeros rather
+        than crashing the caller."""
+        with self._lock:
+            if self._installed:
+                return True
+            try:
+                from jax import monitoring
+            except Exception:  # pragma: no cover - jax is a hard dep here
+                return False
+            monitoring.register_event_listener(self._on_event)
+            monitoring.register_event_duration_secs_listener(self._on_duration)
+            self._installed = True
+            return True
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
+    def _on_event(self, event: str, **kwargs):
+        if event == CACHE_HIT_EVENT:
+            self._thread_counts()["cache_hits"] += 1
+            with self._lock:
+                self.cache_hits += 1
+        elif event == CACHE_MISS_EVENT:
+            self._thread_counts()["cache_misses"] += 1
+            with self._lock:
+                self.cache_misses += 1
+
+    def _on_duration(self, event: str, duration: float, **kwargs):
+        if event == TRACE_EVENT:
+            self._thread_counts()["traces"] += 1
+            with self._lock:
+                self.traces += 1
+        elif event == COMPILE_EVENT:
+            counts = self._thread_counts()
+            counts["compiles"] += 1
+            counts["compile_seconds"] += float(duration)
+            with self._lock:
+                self.compiles += 1
+                self.compile_seconds += float(duration)
+
+    # -- reading -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Process-wide totals — for single-threaded brackets (bench,
+        the replay gate) and absolute reporting."""
+        with self._lock:
+            return {
+                "traces": self.traces,
+                "compiles": self.compiles,
+                "compile_seconds": self.compile_seconds,
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+            }
+
+    def thread_snapshot(self) -> dict:
+        """THIS thread's totals — the bracket for callers sharing the
+        process with concurrent solves (the scheduler round vs what-if
+        rollouts): only compiles dispatched by this thread count."""
+        return dict(self._thread_counts())
+
+    def delta_since(self, snapshot: dict, *, thread: bool = False) -> dict:
+        """Counter movement since `snapshot`, with compile_seconds
+        rounded for JSON surfaces. `thread=True` diffs against this
+        thread's counters — REQUIRED when the baseline came from
+        thread_snapshot(), or the delta mixes scopes and counts other
+        threads' compiles."""
+        now = self.thread_snapshot() if thread else self.snapshot()
+        out = {k: now[k] - snapshot.get(k, 0) for k in FIELDS}
+        out["compile_seconds"] = round(out["compile_seconds"], 4)
+        return out
+
+
+# Process-wide singleton, installed by utils/platform's cache setup so
+# every entrypoint that prepares a JAX backend gets telemetry for free.
+TELEMETRY = CompileTelemetry()
+
+
+def install_compile_telemetry() -> bool:
+    return TELEMETRY.install()

@@ -146,12 +146,18 @@ def place_round(dev: DeviceRound, mesh: Mesh, specs: dict) -> DeviceRound:
     global array from per-device slices of the host copy, which also
     works when the mesh spans multiple processes (each process holds the
     full host copy and contributes its addressable shards)."""
+    from ..observe import ledger as _tledger
+
     placed = {}
     multiproc = jax.process_count() > 1
     for f in dataclasses.fields(DeviceRound):
         v = getattr(dev, f.name)
         if isinstance(v, (np.ndarray, jax.Array)):
             sharding = NamedSharding(mesh, specs.get(f.name, P()))
+            # Transfer ledger (observe/ledger.py): every host array
+            # placed onto the mesh is an upload the device-resident
+            # round refactor would amortize away.
+            _tledger.note_up(v, site="mesh.place")
             if multiproc:
                 arr = np.asarray(v)
                 placed[f.name] = jax.make_array_from_callback(

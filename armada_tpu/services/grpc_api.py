@@ -668,6 +668,18 @@ class ApiServer:
             "rendered": timeline.render(req["job_id"], doc=doc),
         }
 
+    def _slo_status(self, req):
+        """Declared SLOs with compliance + multi-window burn rates
+        (services/slo.py). Leader-proxied like the reports — burn rates
+        describe the leader's rounds, a follower's tracker is idle."""
+        proxied = self._proxy_to_leader("SLOStatus", req)
+        if proxied is not None:
+            return proxied
+        tracker = getattr(self.scheduler, "slo", None)
+        if tracker is None:
+            raise KeyError("SLO tracking not enabled on this server")
+        return tracker.snapshot()
+
     # ---- what-if planner (armada_tpu/whatif) ----
 
     def _whatif_service(self):
@@ -1391,6 +1403,7 @@ class ApiServer:
             "QueueReport": self._queue_report,
             "JobReport": self._job_report,
             "JobTrace": self._job_trace,
+            "SLOStatus": self._slo_status,
             "GetJobLogs": self._get_logs,
             "CordonNode": self._cordon_node,
             "SetPriorityOverride": self._set_priority_override,
@@ -1727,6 +1740,10 @@ class ApiClient:
 
     def job_report(self, job_id):
         return self._call("JobReport", {"job_id": job_id})["report"]
+
+    def slo_status(self):
+        """Declared SLOs + compliance + burn rates (services/slo.py)."""
+        return self._call("SLOStatus", {})
 
     def job_trace(self, job_id):
         """The job's end-to-end journey: {"journey": <dict>, "rendered":

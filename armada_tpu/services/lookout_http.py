@@ -18,6 +18,7 @@ view, scheduling report.
   GET /api/errors
   GET /api/logs/<job_id>?tail=N   (binoculars log fetch, when wired)
   GET /api/runs/<run_id>/error|debug|termination
+  GET /api/slo                   (SLO compliance + burn rates)
   GET /api/jobtrace/<job_id>     (job journey: transitions + reasons)
   GET /api/details/<job_id>      (row + runs incl. debug)
   GET /api/job/<id>              (spec + runs)
@@ -371,6 +372,18 @@ class LookoutHttpServer:
                             "drains": svc.drain_status() or {},
                         }
                     )
+                elif parsed.path == "/api/slo":
+                    # SLO status (services/slo.py): declared objectives,
+                    # compliance and multi-window burn rates — the view
+                    # the "Reading the round cost ledger" runbook pairs
+                    # with /metrics to decide whether churn is hurting
+                    # users yet.
+                    tracker = getattr(outer.scheduler, "slo", None)
+                    if tracker is None:
+                        self._json({"error": "SLO tracking not enabled"},
+                                   503)
+                        return
+                    self._json(tracker.snapshot())
                 elif parsed.path == "/api/frontdoor":
                     # Front-door overload view (armada_tpu/frontdoor):
                     # per-shard ingest lag / delivery counters and the

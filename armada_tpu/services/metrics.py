@@ -450,6 +450,86 @@ class SchedulerMetrics:
             ["shard", "outcome"],
             registry=r,
         )
+        # ---- round observatory (armada_tpu/observe): the host↔device
+        # transfer ledger and compile telemetry. These are the numbers
+        # the ROADMAP-1 device-resident-round refactor must move: bytes
+        # uploaded per round (what residency would amortize away),
+        # donated-buffer traffic (what the donation machinery already
+        # avoids), and warm-cycle XLA compiles (which must be zero).
+        self.round_transfer_bytes = Gauge(
+            "scheduler_round_transfer_bytes",
+            "Bytes the last solved round moved across the host↔device "
+            "boundary, by direction (up = host→device uploads, down = "
+            "result materialization, donated = device buffers updated "
+            "in place via donation — avoided traffic)",
+            ["pool", "direction"],
+            registry=r,
+        )
+        self.round_transfer_arrays = Gauge(
+            "scheduler_round_transfer_arrays",
+            "Array/buffer count behind scheduler_round_transfer_bytes "
+            "for the last solved round",
+            ["pool", "direction"],
+            registry=r,
+        )
+        self.transfer_bytes_total = Counter(
+            "scheduler_transfer_bytes_total",
+            "Cumulative host↔device bytes booked by the round transfer "
+            "ledger, by direction",
+            ["direction"],
+            registry=r,
+        )
+        self.xla_compiles = Counter(
+            "scheduler_xla_compiles_total",
+            "XLA backend compiles observed during scheduling rounds "
+            "(jax.monitoring; a warm steady state compiles nothing)",
+            registry=r,
+        )
+        self.xla_retraces = Counter(
+            "scheduler_xla_retraces_total",
+            "Jitted-entrypoint tracing events observed during "
+            "scheduling rounds (every retrace risks a compile)",
+            registry=r,
+        )
+        self.xla_compile_seconds = Counter(
+            "scheduler_xla_compile_seconds",
+            "Cumulative XLA backend-compile wall clock spent inside "
+            "scheduling rounds",
+            registry=r,
+        )
+        self.xla_cache_events = Counter(
+            "scheduler_xla_cache_events_total",
+            "Persistent compile-cache lookups during scheduling rounds, "
+            "by outcome (hit / miss)",
+            ["outcome"],
+            registry=r,
+        )
+        # ---- SLO layer (services/slo.py): declared objectives over
+        # round latency / queue wait / front-door submit latency, with
+        # multi-window burn rates — what the soaks and tools/slo_gate.py
+        # gate on, and what an operator pages on.
+        self.slo_events = Counter(
+            "scheduler_slo_events_total",
+            "SLO-signal observations, by SLO name and verdict (good = "
+            "within threshold, bad = breached it)",
+            ["slo", "verdict"],
+            registry=r,
+        )
+        self.slo_burn_rate = Gauge(
+            "scheduler_slo_burn_rate",
+            "Error-budget burn rate per SLO and window (1.0 = burning "
+            "exactly the budget; the multiwindow alert fires when fast "
+            "AND slow windows both exceed their thresholds)",
+            ["slo", "window"],
+            registry=r,
+        )
+        self.slo_compliance = Gauge(
+            "scheduler_slo_compliance",
+            "Good-event fraction per SLO over the tracker's full "
+            "retention window (compare against the objective)",
+            ["slo"],
+            registry=r,
+        )
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS:

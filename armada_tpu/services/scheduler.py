@@ -160,6 +160,11 @@ class SchedulerService:
         # the RPC surfaces reach through the scheduler.
         self.fork_capture = None
         self.whatif = None
+        # SLO tracker (services/slo.py): when attached, every cycle's
+        # wall clock feeds the round-latency SLO and every first lease
+        # the queue-wait SLO, with burn-rate gauges refreshed per cycle
+        # (attach_slo; surfaced via GET /api/slo and `armadactl slo`).
+        self.slo = None
         # Staged executor drains (whatif/drain.py): cordon -> voluntary
         # completion -> deadline preempt-requeue, stepped once per cycle
         # through the same event path as every other transition.
@@ -241,6 +246,12 @@ class SchedulerService:
         ever move — placements are bit-exact regardless."""
         self.autotune = controller
 
+    def attach_slo(self, tracker):
+        """Attach an SLO tracker (services/slo.py): cycle latency and
+        per-job queue wait observations flow in, burn-rate gauges
+        refresh per cycle, and the RPC/lookout surfaces read it."""
+        self.slo = tracker
+
     def attach_fork_capture(self, capture):
         """Start handing every rebuild-path round's inputs + decisions
         to the what-if fork capture (references only; see
@@ -314,6 +325,17 @@ class SchedulerService:
             event.job_id
         )
         self.timeline.observe_event(event, sequence)
+        if self.slo is not None and first_lease:
+            # Queue-wait SLO sample at the first lease, on the event
+            # clock (virtual in sims) — independent of whether a
+            # metrics registry is attached.
+            job_ = txn.get(event.job_id)
+            if job_ is not None and event.created >= job_.submitted:
+                self.slo.observe(
+                    "queue_wait_seconds",
+                    event.created - job_.submitted,
+                    now=event.created,
+                )
         m = self.metrics
         if m is None or m.registry is None:
             return
@@ -529,8 +551,23 @@ class SchedulerService:
             self._last_token_id = token_id
             self.started_at = now
             self._orphan_sweep_done = False
-        with self._span("scheduler.cycle", cycle=self.cycle_count):
-            return self._cycle_body(now, token)
+        t_cycle = _time.monotonic()
+        try:
+            with self._span("scheduler.cycle", cycle=self.cycle_count):
+                return self._cycle_body(now, token)
+        finally:
+            # The cycle observes its own wall clock: the metric lives
+            # where the work happens, so simulator-driven cycles tick
+            # scheduler_cycle_seconds too (it was observed only by the
+            # ControlPlane loop before — registered-but-dead in sims),
+            # and the round-latency SLO gets the same sample on the
+            # caller's clock (virtual in sims).
+            cycle_s = _time.monotonic() - t_cycle
+            if self.metrics is not None and self.metrics.registry is not None:
+                self.metrics.cycle_time.observe(cycle_s)
+            if self.slo is not None:
+                self.slo.observe("round_seconds", cycle_s, now=now)
+                self.slo.update_metrics(now=now)
 
     def _span(self, name: str, **attrs):
         """A tracer span, or a no-op when tracing is detached."""
@@ -1455,13 +1492,53 @@ class SchedulerService:
             )
         self.metrics.shard_solve_time.labels(pool=pool).observe(solve_s)
 
+    def _note_transfer(self, pool: str, transfer: dict | None,
+                       compiles: dict | None = None):
+        """Round observatory metrics (armada_tpu/observe): the last
+        round's host↔device transfer ledger as per-pool gauges plus
+        cumulative byte counters, and the round's compile-telemetry
+        delta — zero compiles/retraces is the warm steady state, so any
+        movement here during warm cycles is the regression signal."""
+        m = self.metrics
+        if not transfer or m is None or m.registry is None:
+            return
+        for direction, bytes_key, arrays_key in (
+            ("up", "bytes_up", "arrays_up"),
+            ("down", "bytes_down", "arrays_down"),
+            ("donated", "donated_bytes", "donated_buffers"),
+        ):
+            nbytes = int(transfer.get(bytes_key, 0))
+            m.round_transfer_bytes.labels(
+                pool=pool, direction=direction
+            ).set(nbytes)
+            m.round_transfer_arrays.labels(
+                pool=pool, direction=direction
+            ).set(int(transfer.get(arrays_key, 0)))
+            if nbytes:
+                m.transfer_bytes_total.labels(direction=direction).inc(nbytes)
+        if compiles:
+            if compiles.get("compiles"):
+                m.xla_compiles.inc(int(compiles["compiles"]))
+            if compiles.get("traces"):
+                m.xla_retraces.inc(int(compiles["traces"]))
+            if compiles.get("compile_seconds"):
+                m.xla_compile_seconds.inc(float(compiles["compile_seconds"]))
+            for outcome, key in (("hit", "cache_hits"), ("miss", "cache_misses")):
+                if compiles.get(key):
+                    m.xla_cache_events.labels(outcome=outcome).inc(
+                        int(compiles[key])
+                    )
+
     def _emit_solve_spans(self, pool: str, profile: dict | None,
-                          solve_s: float):
+                          solve_s: float, transfer: dict | None = None,
+                          compiles: dict | None = None):
         """Child spans of the open round span for the hot-window solve
         profile: setup/pass1/gather/finish laid out sequentially over
         the measured solve window, plus the loop mix and rewindow count
         as attrs on the round span itself — so a Perfetto view of the
-        exported spans shows WHERE a round spent its time."""
+        exported spans shows WHERE a round spent its time. The transfer
+        ledger and compile delta ride as round-span attrs: the Perfetto
+        view answers "is it churn or solve" without leaving the trace."""
         tracer = self.tracer
         if tracer is None:
             return
@@ -1471,6 +1548,23 @@ class SchedulerService:
                 solve_s=round(solve_s, 4),
                 backend=self.backend,
             )
+            if transfer:
+                parent.attrs.update(
+                    transfer_bytes_up=int(transfer.get("bytes_up", 0)),
+                    transfer_bytes_down=int(transfer.get("bytes_down", 0)),
+                    transfer_donated_bytes=int(
+                        transfer.get("donated_bytes", 0)
+                    ),
+                    transfer_donated_buffers=int(
+                        transfer.get("donated_buffers", 0)
+                    ),
+                )
+            if compiles:
+                parent.attrs.update(
+                    xla_compiles=int(compiles.get("compiles", 0)),
+                    xla_retraces=int(compiles.get("traces", 0)),
+                    xla_compile_s=float(compiles.get("compile_seconds", 0.0)),
+                )
         if not profile:
             return
         if parent is not None:
@@ -1796,56 +1890,90 @@ class SchedulerService:
                 dev = pad_device_round(prep_device_round(snap))
             import time as _t
 
+            from ..observe import ledger as _tledger
+            from ..observe.xla import TELEMETRY as _xla
+
             t_solve = _t.monotonic()
-            if self.mesh is not None:
-                # The sharded solve is one fused program; the budget is
-                # enforced between pools only (chunked pass 1 is
-                # single-device for now).
-                from ..parallel.mesh import pad_nodes
+            # Round observatory (armada_tpu/observe): one ledger spans
+            # the whole solve — device placement (mesh or LOCAL
+            # device_put), donated chunk carries, result readback —
+            # and a compile-telemetry delta brackets it, so every
+            # round reports its host<->device cost end to end.
+            # install() is idempotent; entrypoints that skip
+            # utils/platform's cache setup (bare sims) still count.
+            # THREAD-scoped bracket: a what-if rollout compiling a
+            # mutated shape on the planner's worker pool must not land
+            # in the live round's delta as a phantom warm recompile.
+            _xla.install()
+            _comp0 = _xla.thread_snapshot()
+            with _tledger.round_ledger() as _led:
+                if self.mesh is not None:
+                    # The sharded solve is one fused program; the budget is
+                    # enforced between pools only (chunked pass 1 is
+                    # single-device for now).
+                    from ..parallel.mesh import pad_nodes
 
-                run = self._resolve_sharded_run()
-                t0 = _t.monotonic()
-                out = run(pad_nodes(dev, self._mesh_size))
-                # jit dispatch is asynchronous: force execution so the
-                # histogram records solve wall clock, not dispatch time.
-                import jax as _jax
+                    run = self._resolve_sharded_run()
+                    t0 = _t.monotonic()
+                    out = run(pad_nodes(dev, self._mesh_size))
+                    # jit dispatch is asynchronous: force execution so the
+                    # histogram records solve wall clock, not dispatch time.
+                    import jax as _jax
 
-                _jax.block_until_ready(out)
-                out = dict(out)
-                out["truncated"] = False
-                self._note_mesh_metrics(snap.pool, _t.monotonic() - t0)
-                shape = run.mesh_shape
-                hosts, chips = shape if len(shape) == 2 else (1, shape[0])
-                solver_info = {"backend": "kernel", "mesh": f"{hosts}x{chips}"}
-            else:
-                tuned = (
-                    self.autotune.params_for(snap.pool)
-                    if self.autotune is not None
-                    else None
-                )
-                if tuned is not None:
-                    window = tuned.hot_window_slots or None
-                    window_min_slots = tuned.hot_window_min_slots
-                    chunk_loops = tuned.chunk_loops
+                    _jax.block_until_ready(out)
+                    # Materialize on host (downstream slicing does this
+                    # implicitly anyway) so the ledger books the result
+                    # readback alongside place_round's uploads.
+                    out = {k: np.asarray(v) for k, v in out.items()}
+                    _tledger.note_down(out, site="mesh.d2h")
+                    out["truncated"] = False
+                    self._note_mesh_metrics(snap.pool, _t.monotonic() - t0)
+                    shape = run.mesh_shape
+                    hosts, chips = shape if len(shape) == 2 else (1, shape[0])
+                    solver_info = {"backend": "kernel", "mesh": f"{hosts}x{chips}"}
                 else:
-                    window = snap.config.hot_window_slots or None
-                    window_min_slots = snap.config.hot_window_min_slots
-                    chunk_loops = 1
-                out = solve_round(
-                    dev,
-                    budget_s=budget_s,
-                    chunk_loops=chunk_loops,
-                    window=window,
-                    window_min_slots=window_min_slots,
-                )
-                solver_info = {
-                    "backend": "kernel",
-                    "mesh": None,
-                    "window": int(window or 0),
-                    "budget": bool(budget_s),
-                    "autotuned": tuned is not None,
-                }
+                    tuned = (
+                        self.autotune.params_for(snap.pool)
+                        if self.autotune is not None
+                        else None
+                    )
+                    if tuned is not None:
+                        window = tuned.hot_window_slots or None
+                        window_min_slots = tuned.hot_window_min_slots
+                        chunk_loops = tuned.chunk_loops
+                    else:
+                        window = snap.config.hot_window_slots or None
+                        window_min_slots = snap.config.hot_window_min_slots
+                        chunk_loops = 1
+                    out = solve_round(
+                        dev,
+                        budget_s=budget_s,
+                        chunk_loops=chunk_loops,
+                        window=window,
+                        window_min_slots=window_min_slots,
+                    )
+                    solver_info = {
+                        "backend": "kernel",
+                        "mesh": None,
+                        "window": int(window or 0),
+                        "budget": bool(budget_s),
+                        "autotuned": tuned is not None,
+                    }
             truncated = bool(out.get("truncated", False))
+            # Fold the round's cost accounting into one profile view:
+            # the scheduler-round ledger (covers mesh placement AND the
+            # solve's own books) plus the compile delta. The same
+            # numbers land in metrics (_note_transfer), the round span
+            # (_emit_solve_spans) and the flight-recorder record — so
+            # replay can diff cost, not just decisions.
+            transfer = _led.as_dict()
+            compiles = _xla.delta_since(_comp0, thread=True)
+            cost_profile = dict(out.get("profile") or {})
+            cost_profile["transfer"] = transfer
+            cost_profile["compiles"] = compiles
+            if "profile" in out:
+                out["profile"] = cost_profile
+            self._note_transfer(snap.pool, transfer, compiles)
             if self.trace_recorder is not None:
                 self._trace_round(
                     snap,
@@ -1854,7 +1982,7 @@ class SchedulerService:
                     solver=solver_info,
                     truncated=truncated,
                     solve_s=round(_t.monotonic() - t_solve, 4),
-                    profile=out.get("profile"),
+                    profile=cost_profile,
                 )
             self._note_solve_profile(snap.pool, out.get("profile"))
             if self.autotune is not None and self.mesh is None:
@@ -1871,7 +1999,8 @@ class SchedulerService:
                     log=self.log_,
                 )
             self._emit_solve_spans(
-                snap.pool, out.get("profile"), _t.monotonic() - t_solve
+                snap.pool, out.get("profile"), _t.monotonic() - t_solve,
+                transfer=transfer, compiles=compiles,
             )
             J, Q = snap.num_jobs, snap.num_queues
             return {

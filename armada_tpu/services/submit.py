@@ -50,10 +50,17 @@ class Queue:
 
 class SubmitService:
     def __init__(self, config: SchedulingConfig, log, scheduler=None,
-                 checkpoint=None, store_health=None, frontdoor=None):
+                 checkpoint=None, store_health=None, frontdoor=None,
+                 slo=None):
         self.config = config
         self.log = log
         self.scheduler = scheduler  # optional: queue updates pushed through
+        # Optional SLO tracker (services/slo.py): submit() feeds the
+        # frontdoor_submit_seconds signal — wall clock through admission
+        # + the durable ack — at the ONE enforcement point every
+        # transport funnels through, so gRPC and in-process submits
+        # measure identically.
+        self.slo = slo
         # Optional backpressure gate (services/backpressure.py): callable
         # -> (healthy, reason); submissions are shed while the store is
         # backed up (the reference rejects work on etcd capacity).
@@ -217,6 +224,25 @@ class SubmitService:
         hits). `deadline_ts` is the caller's propagated deadline (same
         clock as `now`): expired work is dropped before the durable
         enqueue — acked work always applies, never half."""
+        slo = self.slo
+        measure = slo is not None and slo.observes("frontdoor_submit_seconds")
+        started = _time.perf_counter() if measure else 0.0
+        try:
+            return self._submit(queue, jobset, jobs, now, deadline_ts)
+        finally:
+            if measure:
+                # Shed/expired/errored submits count too: a front door
+                # that fails fast still spent the user's latency budget.
+                slo.observe(
+                    "frontdoor_submit_seconds",
+                    _time.perf_counter() - started,
+                    now=now,
+                )
+
+    def _submit(
+        self, queue: str, jobset: str, jobs: list[JobSpec],
+        now: float | None = None, deadline_ts: float | None = None,
+    ) -> list[str]:
         if self.store_health is not None and self.frontdoor is None:
             healthy, reason = self.store_health.check()
             if not healthy:

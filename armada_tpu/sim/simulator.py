@@ -144,6 +144,12 @@ class Simulator:
         # every cycle on the virtual clock, chaos plan included); a
         # prebuilt FrontDoor attaches as-is. 0/None = direct publish.
         frontdoor=None,
+        # SLO tracking (services/slo.py): True attaches a tracker built
+        # from the config's declared SLOs (defaults when none), a
+        # prebuilt SLOTracker attaches as-is. Observations ride the
+        # sim's VIRTUAL clock, so burn windows mean virtual seconds —
+        # tools/chaos_soak.py --slo and tools/slo_gate.py gate on it.
+        slo=None,
     ):
         self.config = config or SchedulingConfig()
         self.rng = np.random.default_rng(seed)
@@ -208,9 +214,19 @@ class Simulator:
                     clock=self.chaos_clock,
                 )
             )
+        self.slo = None
+        if slo:
+            from ..services.slo import SLOTracker
+
+            self.slo = (
+                slo
+                if not isinstance(slo, bool)
+                else SLOTracker.from_config(self.config)
+            )
+            self.scheduler.attach_slo(self.slo)
         self.submit = SubmitService(
             self.config, self.log, scheduler=self.scheduler,
-            frontdoor=self.frontdoor,
+            frontdoor=self.frontdoor, slo=self.slo,
         )
         self.span_tracer = None
         if span_path is not None:

@@ -2216,140 +2216,168 @@ def solve_round(
     segment (setup / pass-1 / gather+scatter / finish) and pass-1 loop
     counts by kind (gang / fill / merged-fill), plus rewindow counts.
     """
+    from ..observe import ledger as _tledger
+
     use_budget = bool(budget_s) and budget_s > 0
     pre = _window_precheck(dev, window, window_min_slots)
     if not use_budget and pre is None and not profile:
         # Fused single-program path (small rounds land here even with a
         # window configured), and no `truncated` key — existing
-        # consumers iterate the result's array-valued keys.
+        # consumers iterate the result's array-valued keys. The transfer
+        # ledger (observe/ledger.py) books the implicit dispatch upload
+        # of the host arrays and the numpy materialization of the
+        # outputs into whatever round ledger the caller activated.
+        _tledger.note_up(dev, site="solve.dispatch")
         out = _solve(dev)
-        return {k: np.asarray(v) for k, v in out.items()}
+        out = {k: np.asarray(v) for k, v in out.items()}
+        _tledger.note_down(out, site="solve.d2h")
+        return out
 
     import time as _time
 
-    deadline = _time.monotonic() + float(budget_s) if use_budget else None
-    # One upload: every chunk reuses the resident round tensors instead of
-    # re-transferring the host arrays per segment.
-    dev = jax.device_put(dev)
-    t0 = _time.monotonic()
-    carry, ptr, budgets, fair_share, demand_capped, uncapped = _pass1_begin(dev)
-    jax.block_until_ready(carry.loops)
-    setup_s = _time.monotonic() - t0
-    fs = jnp.zeros((), bool)
-    segc = jnp.zeros(3, jnp.int32)
-    S = int(dev.slot_members.shape[0])
-    hard_cap = 2 * S + 4
-    chunk = max(1, int(chunk_loops))
-    truncated = False
-    plan = _window_plan(dev, carry, pre)
-    rewindows = 0
-    gather_s = 0.0
-    t_pass = _time.monotonic()
+    # Per-solve transfer ledger: the host-driven driver attaches its own
+    # complete up/down/donated accounting to out["profile"]["transfer"]
+    # (notes also book into any outer, e.g. scheduler-round, ledger).
+    with _tledger.round_ledger() as _led:
+        deadline = _time.monotonic() + float(budget_s) if use_budget else None
+        # One upload: every chunk reuses the resident round tensors
+        # instead of re-transferring the host arrays per segment.
+        _tledger.note_up(dev, site="solve.h2d")
+        dev = jax.device_put(dev)
+        t0 = _time.monotonic()
+        carry, ptr, budgets, fair_share, demand_capped, uncapped = _pass1_begin(dev)
+        jax.block_until_ready(carry.loops)
+        setup_s = _time.monotonic() - t0
+        fs = jnp.zeros((), bool)
+        segc = jnp.zeros(3, jnp.int32)
+        S = int(dev.slot_members.shape[0])
+        hard_cap = 2 * S + 4
+        chunk = max(1, int(chunk_loops))
+        truncated = False
+        plan = _window_plan(dev, carry, pre)
+        rewindows = 0
+        gather_s = 0.0
+        t_pass = _time.monotonic()
 
-    def _adapt_chunk(t0, executed):
-        # Re-check the clock roughly every budget/8 while never batching
-        # more than one loop when a single loop exceeds that interval
-        # (the burst regime), keeping overshoot to one fill loop.
-        target = max(float(budget_s) / 8.0, 0.02)
-        per_loop = (_time.monotonic() - t0) / executed
-        return max(1, min(int(target / max(per_loop, 1e-7)), 4096))
+        def _adapt_chunk(t0, executed):
+            # Re-check the clock roughly every budget/8 while never batching
+            # more than one loop when a single loop exceeds that interval
+            # (the burst regime), keeping overshoot to one fill loop.
+            target = max(float(budget_s) / 8.0, 0.02)
+            per_loop = (_time.monotonic() - t0) / executed
+            return max(1, min(int(target / max(per_loop, 1e-7)), 4096))
 
-    if plan is None:
-        while True:
-            jax.block_until_ready(carry.loops)
-            loops = int(np.asarray(carry.loops))
-            if bool(np.asarray(carry.stop)) or loops >= hard_cap:
-                break
-            # Forward-progress floor: even a budget spent before the first
-            # loop (snapshot build ate it) runs ONE loop, so a persistently
-            # tiny budget drains the backlog instead of starving it.
-            if deadline is not None and loops > 0 and _time.monotonic() >= deadline:
-                truncated = True
-                break
-            cap = hard_cap if deadline is None else min(loops + chunk, hard_cap)
-            t0 = _time.monotonic()
-            carry, ptr, fs, segc = _pass1_chunk(
-                dev, carry, ptr, fs, segc, budgets, jnp.int32(cap)
-            )
-            jax.block_until_ready(carry.loops)
-            executed = max(1, int(np.asarray(carry.loops)) - loops)
-            if deadline is not None:
-                chunk = _adapt_chunk(t0, executed)
-    else:
-        from .hotwindow import gather_window, scatter_back
-
-        Ws, Ep, lookahead = plan
-        Q = int(dev.queue_weight.shape[0])
-        done = False
-        while not done:
-            t0 = _time.monotonic()
-            ptr = _pass1_norm(dev, carry, ptr)
-            win_base = ptr
-            dev_w, carry_w, ptr_w, trunc, win_len, sidx, jidx = gather_window(
-                dev, carry, ptr, Ws, Ep
-            )
-            trunc_np = np.asarray(trunc)
-            end_np = np.arange(Q) * Ws + np.asarray(win_len)
-            gather_s += _time.monotonic() - t0
+        if plan is None:
             while True:
-                jax.block_until_ready(carry_w.loops)
-                loops = int(np.asarray(carry_w.loops))
-                stop = bool(np.asarray(carry_w.stop))
-                short = (end_np - np.asarray(ptr_w)) < lookahead
-                rewind = (not stop) and bool(np.any(trunc_np & short))
-                if stop or loops >= hard_cap:
-                    done = True
+                jax.block_until_ready(carry.loops)
+                loops = int(np.asarray(carry.loops))
+                if bool(np.asarray(carry.stop)) or loops >= hard_cap:
                     break
-                if rewind:
-                    break
-                if (
-                    deadline is not None
-                    and loops > 0
-                    and _time.monotonic() >= deadline
-                ):
+                # Forward-progress floor: even a budget spent before the first
+                # loop (snapshot build ate it) runs ONE loop, so a persistently
+                # tiny budget drains the backlog instead of starving it.
+                if deadline is not None and loops > 0 and _time.monotonic() >= deadline:
                     truncated = True
-                    done = True
                     break
                 cap = hard_cap if deadline is None else min(loops + chunk, hard_cap)
                 t0 = _time.monotonic()
-                carry_w, ptr_w, fs, segc = _pass1_window_chunk(
-                    dev_w, carry_w, ptr_w, fs, segc, budgets,
-                    jnp.int32(cap), trunc,
+                # The chunk donates its carries: device buffers updated
+                # in place, not re-uploaded — booked on the donated side
+                # of the ledger so the copied-vs-donated split is real.
+                _tledger.note_donated((carry, ptr, fs, segc), site="pass1.chunk")
+                carry, ptr, fs, segc = _pass1_chunk(
+                    dev, carry, ptr, fs, segc, budgets, jnp.int32(cap)
                 )
-                jax.block_until_ready(carry_w.loops)
-                executed = max(1, int(np.asarray(carry_w.loops)) - loops)
+                jax.block_until_ready(carry.loops)
+                executed = max(1, int(np.asarray(carry.loops)) - loops)
                 if deadline is not None:
                     chunk = _adapt_chunk(t0, executed)
-            t0 = _time.monotonic()
-            carry, ptr = scatter_back(
-                carry, carry_w, ptr_w, sidx, jidx, win_base, Ws
-            )
-            gather_s += _time.monotonic() - t0
-            if not done:
-                rewindows += 1
+        else:
+            from .hotwindow import gather_window, scatter_back
 
-    jax.block_until_ready(carry.loops)
-    pass1_s = _time.monotonic() - t_pass - gather_s
-    t0 = _time.monotonic()
-    out = _round_finish_jit(
-        dev, carry, budgets, fair_share, demand_capped, uncapped, truncated
-    )
-    jax.block_until_ready(out["num_loops"])
-    finish_s = _time.monotonic() - t0
-    seg_np = np.asarray(segc)
-    out = {k: np.asarray(v) for k, v in out.items()}
-    if use_budget:
-        out["truncated"] = truncated
-    out["profile"] = {
-        "setup_s": round(setup_s, 4),
-        "pass1_s": round(pass1_s, 4),
-        "gather_s": round(gather_s, 4),
-        "finish_s": round(finish_s, 4),
-        "gang_loops": int(seg_np[SEG_GANG]),
-        "fill_loops": int(seg_np[SEG_FILL]),
-        "merged_fill_loops": int(seg_np[SEG_MERGED]),
-        "compacted": plan is not None,
-        "window_slots": int(plan[0]) if plan else 0,
-        "rewindows": rewindows,
-    }
-    return out
+            Ws, Ep, lookahead = plan
+            Q = int(dev.queue_weight.shape[0])
+            done = False
+            while not done:
+                t0 = _time.monotonic()
+                ptr = _pass1_norm(dev, carry, ptr)
+                win_base = ptr
+                dev_w, carry_w, ptr_w, trunc, win_len, sidx, jidx = gather_window(
+                    dev, carry, ptr, Ws, Ep
+                )
+                trunc_np = np.asarray(trunc)
+                end_np = np.arange(Q) * Ws + np.asarray(win_len)
+                gather_s += _time.monotonic() - t0
+                while True:
+                    jax.block_until_ready(carry_w.loops)
+                    loops = int(np.asarray(carry_w.loops))
+                    stop = bool(np.asarray(carry_w.stop))
+                    short = (end_np - np.asarray(ptr_w)) < lookahead
+                    rewind = (not stop) and bool(np.any(trunc_np & short))
+                    if stop or loops >= hard_cap:
+                        done = True
+                        break
+                    if rewind:
+                        break
+                    if (
+                        deadline is not None
+                        and loops > 0
+                        and _time.monotonic() >= deadline
+                    ):
+                        truncated = True
+                        done = True
+                        break
+                    cap = hard_cap if deadline is None else min(loops + chunk, hard_cap)
+                    t0 = _time.monotonic()
+                    _tledger.note_donated(
+                        (carry_w, ptr_w, fs, segc), site="pass1.window_chunk"
+                    )
+                    carry_w, ptr_w, fs, segc = _pass1_window_chunk(
+                        dev_w, carry_w, ptr_w, fs, segc, budgets,
+                        jnp.int32(cap), trunc,
+                    )
+                    jax.block_until_ready(carry_w.loops)
+                    executed = max(1, int(np.asarray(carry_w.loops)) - loops)
+                    if deadline is not None:
+                        chunk = _adapt_chunk(t0, executed)
+                t0 = _time.monotonic()
+                # scatter_back donates the full carry (in-place window
+                # row writes — hot-window's whole point).
+                _tledger.note_donated(carry, site="scatter_back")
+                carry, ptr = scatter_back(
+                    carry, carry_w, ptr_w, sidx, jidx, win_base, Ws
+                )
+                gather_s += _time.monotonic() - t0
+                if not done:
+                    rewindows += 1
+
+        jax.block_until_ready(carry.loops)
+        pass1_s = _time.monotonic() - t_pass - gather_s
+        t0 = _time.monotonic()
+        out = _round_finish_jit(
+            dev, carry, budgets, fair_share, demand_capped, uncapped, truncated
+        )
+        jax.block_until_ready(out["num_loops"])
+        finish_s = _time.monotonic() - t0
+        seg_np = np.asarray(segc)
+        out = {k: np.asarray(v) for k, v in out.items()}
+        _tledger.note_down(out, site="solve.d2h")
+        if use_budget:
+            out["truncated"] = truncated
+        out["profile"] = {
+            "setup_s": round(setup_s, 4),
+            "pass1_s": round(pass1_s, 4),
+            "gather_s": round(gather_s, 4),
+            "finish_s": round(finish_s, 4),
+            "gang_loops": int(seg_np[SEG_GANG]),
+            "fill_loops": int(seg_np[SEG_FILL]),
+            "merged_fill_loops": int(seg_np[SEG_MERGED]),
+            "compacted": plan is not None,
+            "window_slots": int(plan[0]) if plan else 0,
+            "rewindows": rewindows,
+            # The solve's own complete transfer accounting
+            # (observe/ledger.py): bytes/arrays up and down plus the
+            # donated-buffer traffic the chunked drivers avoided.
+            "transfer": _led.as_dict(),
+        }
+        return out

@@ -156,6 +156,14 @@ class TraceRecorder:
                 "config_summary": summary,
                 "seeds": self.seeds,
                 "meta": self.meta,
+                # Round observatory (armada_tpu/observe): rounds in this
+                # bundle carry cost accounting in their profile blocks —
+                # `transfer` (bytes up/down, donated buffers) and
+                # `compiles` (trace/compile deltas) — so replay can diff
+                # COST against the recording, not just decisions. Older
+                # bundles simply lack the key (readers default absent).
+                "observatory": {"transfer_ledger": True,
+                                "compile_telemetry": True},
             },
             metrics=metrics,
         )

@@ -20,6 +20,11 @@ classify as:
   profile_regression — replayed solve wall clock beyond
                        `profile_threshold` x the recorded solve time
                        (opt-in: wall clocks only compare on one host)
+  retrace            — XLA traced/compiled during a round whose shape
+                       signature was already replayed under the same
+                       solver (observe/xla.py telemetry): a warm cycle
+                       must dispatch cached executables, so any compile
+                       here is the silent-warm-recompile failure mode
 
 Replay REFUSES a bundle whose target signature (host CPU features,
 effective XLA target, x64 mode) differs from this process unless
@@ -211,6 +216,26 @@ def _first_diffs(a, b, limit=4):
     return [int(i) for i in idx]
 
 
+def _shape_signature(dev) -> tuple:
+    """The (treedef, shapes, dtypes) signature that determines which
+    compiled programs a DeviceRound dispatches to. Two rounds with the
+    same signature must replay WITHOUT tracing or compiling anything:
+    the first replay of each signature warms the jit caches, and any
+    XLA activity on a later same-signature round is an unexpected warm
+    retrace — the production failure mode where a drifted static arg
+    quietly pays seconds of compile inside every 'warm' cycle."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(dev)
+    return (
+        str(treedef),
+        tuple(
+            (getattr(v, "shape", ()), str(getattr(v, "dtype", type(v).__name__)))
+            for v in leaves
+        ),
+    )
+
+
 def compare_round(rec: RoundRecord, out: dict, *, compare_loops: bool | None = None):
     """Divergences between a recorded round's decisions and a replayed
     output dict. Arrays compare on the UNPADDED prefix (the recorded
@@ -274,6 +299,7 @@ def replay_trace(
     profile_threshold: float | None = None,
     perturb: str | None = None,
     allow_foreign: bool = False,
+    flag_retraces: bool = True,
     metrics=None,
     log=None,
 ) -> dict:
@@ -287,6 +313,16 @@ def replay_trace(
     (services.metrics.SchedulerMetrics) gets the replay-divergence
     counter bumped per divergence kind."""
     check_target(trace.header, allow_foreign=allow_foreign)
+    from ..observe.xla import TELEMETRY
+
+    # Warm-retrace audit (flag_retraces): the first replay of each
+    # round-shape signature per solver warms the jit caches; any
+    # trace/compile activity on a LATER round with an already-seen
+    # signature is classified `retrace` — the silent warm-cycle compile
+    # the observatory exists to catch. Telemetry installs lazily and is
+    # a no-op counter source when jax.monitoring is unavailable.
+    telemetry_live = TELEMETRY.install() if flag_retraces else False
+    seen_shapes: dict[str, set] = {}
     resolved = [replay_solver(s, trace.header) for s in solvers]
     results = []
     by_kind: dict[str, int] = {}
@@ -304,10 +340,32 @@ def replay_trace(
             dev = perturb_device_round(dev, perturb)
         replayed += 1
         for label, solve in resolved:
+            warm = False
+            if telemetry_live:
+                sig = _shape_signature(dev)
+                warm = sig in seen_shapes.setdefault(label, set())
+                # Thread-scoped: a concurrent solve elsewhere in the
+                # process must not read as this round's retrace.
+                comp0 = TELEMETRY.thread_snapshot()
             t0 = time.monotonic()
             out = solve(dev)
             replay_s = time.monotonic() - t0
             divergences = compare_round(rec, out)
+            if telemetry_live:
+                delta = TELEMETRY.delta_since(comp0, thread=True)
+                seen_shapes[label].add(sig)
+                if warm and (delta["compiles"] or delta["traces"]):
+                    divergences.append(
+                        {
+                            "kind": "retrace",
+                            "key": "xla",
+                            "detail": "warm shape retraced: "
+                            f"{delta['traces']} trace(s), "
+                            f"{delta['compiles']} compile(s) "
+                            f"({delta['compile_seconds']}s) on an "
+                            "already-replayed round signature",
+                        }
+                    )
             if profile_threshold and rec.raw.get("solve_s") is not None:
                 # The first solve of a (solver, shape) pays JIT compile;
                 # the recorded solve_s is a warm steady-state number. Time

@@ -146,7 +146,12 @@ def enable_persistent_compile_cache(path: str | None = None):
     in minutes per padded shape on TPU, and every fresh process (bench,
     services, driver runs) would otherwise pay it again. Safe to call
     before or after backend selection; idempotent. The directory is keyed
-    by the host's CPU-feature hash (see host_cpu_signature)."""
+    by the host's CPU-feature hash (see host_cpu_signature).
+
+    Also installs the compile/retrace telemetry listeners
+    (observe/xla.py): every process that sets up the cache gets
+    trace/compile/cache-hit counters describing it, so a warm cycle
+    that silently recompiles is measurable instead of log spam."""
     import jax
 
     if path is None:
@@ -156,6 +161,12 @@ def enable_persistent_compile_cache(path: str | None = None):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as e:  # never let cache config break the solve
         print(f"[platform] compile cache disabled: {e!r}")
+    try:
+        from ..observe.xla import install_compile_telemetry
+
+        install_compile_telemetry()
+    except Exception as e:  # pragma: no cover - observability must not kill
+        print(f"[platform] compile telemetry disabled: {e!r}", file=sys.stderr)
 
 
 def enable_exact_costs():

@@ -55,6 +55,35 @@ def parse_traceparent(value: str | None) -> tuple[str, str] | None:
     return trace_id, span_id
 
 
+# Per-thread registry of OPEN spans across every Tracer instance: the
+# logging layer (utils/logging.py) stamps the current trace id on every
+# record, and a process may run several tracers at once (the process
+# default plus an exporter-attached one in the scheduler) — log
+# correlation must not care which instance opened the active span.
+_ACTIVE_SPANS = threading.local()
+
+
+def _active_stack() -> list:
+    stack = getattr(_ACTIVE_SPANS, "stack", None)
+    if stack is None:
+        stack = _ACTIVE_SPANS.stack = []
+    return stack
+
+
+def current_trace_id() -> str:
+    """Trace id of this thread's innermost open span, whichever Tracer
+    opened it ("" outside any span) — what the JSON log formatter
+    stamps on every record so log lines join the job-journey trace."""
+    stack = _active_stack()
+    return stack[-1].trace_id if stack else ""
+
+
+def current_span_id() -> str:
+    """Span id of this thread's innermost open span ("" outside)."""
+    stack = _active_stack()
+    return stack[-1].span_id if stack else ""
+
+
 @dataclass
 class Span:
     name: str
@@ -222,11 +251,13 @@ class Tracer:
             trace_id=trace_id or secrets.token_hex(16),
         )
         stack.append(s)
+        _active_stack().append(s)
         try:
             yield s
         finally:
             s.end = time.monotonic()
             stack.pop()
+            _active_stack().pop()
             self._finish(s)
             if self.logger is not None:
                 self.logger.with_fields(

@@ -182,6 +182,11 @@ def _put(dev):
     import jax
     import numpy as np
 
+    from armada_tpu.observe import note_up
+
+    # Transfer ledger: the explicit warm-cycle upload — what a
+    # device-resident round (ROADMAP 1) would mostly eliminate.
+    note_up(dev, site="bench.put")
     out = jax.tree_util.tree_map(
         lambda x: jax.device_put(x) if isinstance(x, np.ndarray) else x, dev
     )
@@ -202,6 +207,8 @@ def _emit_cycle_spans(tracer, config_name, timings, profile):
     end_ns = time.time_ns()
     cycle_s = timings["cycle_s"]
     start_ns = end_ns - int(cycle_s * 1e9)
+    transfer = timings.get("transfer") or {}
+    compiles = transfer.get("compiles") or {}
     parent = tracer.add_span(
         "bench.warm_cycle",
         start_unix_ns=start_ns,
@@ -209,6 +216,12 @@ def _emit_cycle_spans(tracer, config_name, timings, profile):
         config=config_name,
         scheduled_jobs=timings["scheduled_jobs"],
         loops=timings["loops"],
+        # The cost ledger on the cycle span: the Perfetto view answers
+        # "churn or solve" without leaving the timeline.
+        transfer_bytes_up=int(transfer.get("bytes_up", 0)),
+        transfer_bytes_down=int(transfer.get("bytes_down", 0)),
+        transfer_donated_buffers=int(transfer.get("donated_buffers", 0)),
+        xla_compiles=int(compiles.get("compiles", 0)),
     )
     from armada_tpu.utils.tracing import add_segment_spans
 
@@ -334,19 +347,28 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
             for i in range(len(leases))
         ]
         next_id += len(leases)
-        t0 = time.time()
-        inc.bind(leases)
-        inc.add_jobs(new_jobs)
-        delta_s = time.time() - t0
-        t0 = time.time()
-        dev = inc.device_round()
-        prep_s = time.time() - t0
-        t0 = time.time()
-        dev = _put(pad_device_round(dev))
-        h2d_s = time.time() - t0
-        t0 = time.time()
-        out = solve_round(dev)
-        solve_s = time.time() - t0
+        # Round observatory (armada_tpu/observe): one transfer ledger +
+        # compile-telemetry delta per warm cycle, so every artifact
+        # carries extra.transfer — bytes up/down, donated buffers, and
+        # the warm-cycle compile count (which must be ZERO: a compile
+        # here is the silent-warm-recompile failure mode).
+        from armada_tpu.observe import TELEMETRY, round_ledger
+
+        comp0 = TELEMETRY.snapshot()
+        with round_ledger() as led:
+            t0 = time.time()
+            inc.bind(leases)
+            inc.add_jobs(new_jobs)
+            delta_s = time.time() - t0
+            t0 = time.time()
+            dev = inc.device_round()
+            prep_s = time.time() - t0
+            t0 = time.time()
+            dev = _put(pad_device_round(dev))
+            h2d_s = time.time() - t0
+            t0 = time.time()
+            out = solve_round(dev)
+            solve_s = time.time() - t0
         timings = {
             "delta_s": round(delta_s, 3),
             "prep_s": round(prep_s, 3),
@@ -355,6 +377,10 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
             "cycle_s": round(delta_s + prep_s + h2d_s + solve_s, 4),
             "scheduled_jobs": int(np.asarray(out["scheduled_mask"]).sum()),
             "loops": int(out["num_loops"]),
+            "transfer": {
+                **led.as_dict(),
+                "compiles": TELEMETRY.delta_since(comp0),
+            },
         }
         if "truncated" in out:
             timings["round_truncated"] = bool(out["truncated"])

@@ -31,6 +31,14 @@ NEW_SCHEMA = {
             # Effective solver parameters (autotune round on).
             "params": {"hot_window_slots": 4096, "chunk_loops": 1,
                        "fill_window": 2048, "tuned": False},
+            # Round-observatory cost ledger (observatory round on):
+            # bytes up/down + warm-cycle compile delta gate alongside
+            # the cycle times.
+            "transfer": {"bytes_up": 2048, "arrays_up": 61,
+                         "bytes_down": 512, "arrays_down": 9,
+                         "donated_bytes": 0, "donated_buffers": 0,
+                         "compiles": {"traces": 0, "compiles": 0,
+                                      "compile_seconds": 0.0}},
             "tracking_100k": {"cycle_s": 0.27},
             "burst_50k": {"cycle_s": 18.7},
         },
@@ -48,12 +56,15 @@ FAILED_RUN = {"rc": 1, "parsed": {"ok": False, "error": "boom"}}
 def test_parse_both_schemas():
     new = extract_metrics(parse_artifact(NEW_SCHEMA))
     assert new == {"warm": 3.0, "tracking": 0.27, "burst": 18.7,
-                   "pass1": 2.0, "gather": 0.2}
-    # Old artifacts predate extra.segments: the segment metrics are
-    # None, never a crash or a phantom gate.
+                   "pass1": 2.0, "gather": 0.2,
+                   "bytes_up": 2048.0, "bytes_down": 512.0,
+                   "compiles": 0.0}
+    # Old artifacts predate extra.segments / extra.transfer: those
+    # metrics are None, never a crash or a phantom gate.
     old = extract_metrics(parse_artifact(OLD_SCHEMA))
     assert old == {"warm": 1.2, "tracking": None, "burst": None,
-                   "pass1": None, "gather": None}
+                   "pass1": None, "gather": None,
+                   "bytes_up": None, "bytes_down": None, "compiles": None}
     assert all(v is None for v in extract_metrics(parse_artifact(BROKEN)).values())
     # ok=false parsed blocks are failures, not baselines.
     assert parse_artifact(FAILED_RUN) is None
@@ -76,7 +87,7 @@ def test_gate_skips_incomparable_metrics():
         {"warm": 1.0, "tracking": 0.3, "burst": 50.0, "pass1": 9.0}, base, 1.15
     )
     assert not regressions
-    assert sum("not comparable" in n for n in notes) == 4
+    assert sum("not comparable" in n for n in notes) == 7
 
 
 def test_gate_per_segment_medians():
@@ -195,7 +206,7 @@ def test_trend_tolerates_and_shows_whatif_block(tmp_path):
     assert "whatif" in proc.stdout
     lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
     assert "3@0.42s" in lines["BENCH_r02.json"]
-    assert lines["BENCH_r03.json"].split()[-2] == "yes"  # whatif column
+    assert lines["BENCH_r03.json"].split()[-3] == "yes"  # whatif column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_whatif))["warm"] == 3.0
 
@@ -232,9 +243,61 @@ def test_trend_tolerates_and_shows_frontdoor_block(tmp_path):
     assert lines["BENCH_r01.json"].rstrip().endswith("-")
     assert "17ms/13" in lines["BENCH_r02.json"]
     assert "300ms/5000!" in lines["BENCH_r03.json"]
-    assert lines["BENCH_r04.json"].rstrip().endswith("yes")
+    assert lines["BENCH_r04.json"].split()[-2] == "yes"  # frontdoor column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_fd))["warm"] == 3.0
+
+
+def test_gate_transfer_ledger_and_compiles(tmp_path):
+    """extra.transfer gates: bytes up/down regress past the threshold
+    factor, the warm-cycle compile count regresses on ANY increase
+    (zero compiles is the warm steady state), and artifacts without
+    the block (pre-observatory) report incomparable, never gate."""
+    base = extract_metrics(parse_artifact(NEW_SCHEMA))
+    ok = dict(base, bytes_up=2100.0, bytes_down=520.0, compiles=0.0)
+    regressions, _ = gate(ok, base, threshold=1.15)
+    assert not regressions
+    # Byte blowup inside the threshold-passing cycle gates on its own.
+    churny = dict(base, bytes_up=base["bytes_up"] * 3)
+    regressions, _ = gate(churny, base, threshold=1.15)
+    assert len(regressions) == 1 and regressions[0].startswith("bytes_up")
+    # One compile in a warm cycle gates regardless of how fast it was.
+    recompiled = dict(base, compiles=1.0)
+    regressions, _ = gate(recompiled, base, threshold=1.15)
+    assert len(regressions) == 1 and regressions[0].startswith("compiles")
+    # Pre-observatory baseline: transfer metrics incomparable, no gate.
+    old = extract_metrics(parse_artifact(OLD_SCHEMA))
+    regressions, notes = gate(dict(base, warm=old["warm"]), old, 1.15)
+    assert not regressions
+    assert sum("not comparable" in n for n in notes) >= 3
+
+
+def test_trend_shows_transfer_column(tmp_path):
+    """The trend table renders the cost ledger (bytes up/down + compile
+    count) for artifacts that record extra.transfer; older artifacts
+    print '-'."""
+    churn = json.loads(json.dumps(NEW_SCHEMA))
+    churn["parsed"]["extra"]["transfer"] = {
+        "bytes_up": 3 * 1024 ** 3, "bytes_down": 5 * 1024 ** 2,
+        "compiles": {"compiles": 2},
+    }
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(OLD_SCHEMA))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(NEW_SCHEMA))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(churn))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "bench_trend.py"),
+            "--dir", str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "transfer" in proc.stdout
+    lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
+    assert lines["BENCH_r01.json"].rstrip().endswith("-")
+    assert "2.0K/512B,c0" in lines["BENCH_r02.json"]
+    assert "3.0G/5.0M,c2" in lines["BENCH_r03.json"]
 
 
 def test_trend_shows_effective_params_column(tmp_path):

@@ -208,3 +208,22 @@ def test_gap_markers_match_prose():
         if "<!-- gap:" not in b and "<!-- closed-gap:" not in b
     ]
     assert not unmarked, f"gap bullets without markers: {unmarked}"
+
+
+def test_metrics_inventory_matches_registry():
+    """docs/metrics.md is GENERATED from SchedulerMetrics
+    (tools/gen_metrics_doc.py): every registered family must appear in
+    the doc with its registered type/labels/help, and no documented
+    family may outlive its registration — same anti-rot contract as the
+    known-gaps section."""
+    from gen_metrics_doc import DOC_PATH, render
+
+    assert os.path.exists(DOC_PATH), (
+        "docs/metrics.md missing; run tools/gen_metrics_doc.py --write"
+    )
+    with open(DOC_PATH) as f:
+        current = f.read()
+    assert current == render(), (
+        "docs/metrics.md is stale vs services/metrics.SchedulerMetrics; "
+        "run tools/gen_metrics_doc.py --write"
+    )

@@ -79,6 +79,47 @@ EXEMPT_LABELED = {
 # below, which auto-covers families added later.
 FRONTDOOR_PREFIX = "frontdoor_"
 
+# UNLABELED families legitimately untouched by this test's sims — each
+# needs a mode the short oracle run does not exercise. Same anti-rot
+# contract as EXEMPT_LABELED: the test asserts these stay UNTOUCHED
+# here, so an entry whose feature lands in the sim path must be removed.
+EXEMPT_UNLABELED = {
+    # partition/heal chaos only (tests/test_netchaos.py covers)
+    "scheduler_executor_reconnect_seconds",
+    # sharded-solve (mesh) only
+    "scheduler_solve_dcn_scalars_per_select",
+}
+
+
+def _instrument_unlabeled(m: SchedulerMetrics) -> dict:
+    """Wrap every UNLABELED metric's mutators (inc/dec/set/observe) with
+    counting shims, returning {family: call_count}. Unlabeled metrics
+    always render a zero-valued sample, so rendered output cannot
+    distinguish 'set to 0 every cycle' from 'registered and never
+    wired' (exactly how scheduler_cycle_seconds sat dead in sims for
+    four PRs while the ControlPlane loop observed it) — counting the
+    mutator CALLS can."""
+    touched: dict = {}
+    for attr, metric in vars(m).items():
+        if getattr(metric, "_labelnames", None):
+            continue
+        collect = getattr(metric, "collect", None)
+        if collect is None:
+            continue
+        family = next(iter(collect())).name
+        touched.setdefault(family, 0)
+        for method_name in ("inc", "dec", "set", "observe"):
+            orig = getattr(metric, method_name, None)
+            if orig is None:
+                continue
+
+            def shim(*a, _orig=orig, _f=family, _t=touched, **k):
+                _t[_f] += 1
+                return _orig(*a, **k)
+
+            setattr(metric, method_name, shim)
+    return touched
+
 
 def _labeled_sample_counts(m: SchedulerMetrics) -> dict:
     """family name -> sample count, for every LABELED metric attribute
@@ -145,8 +186,27 @@ def test_every_labeled_family_live_after_short_sim(tmp_path):
         trace_path=str(tmp_path / "liveness.atrace"),
     )
     m = SchedulerMetrics()
+    touched = _instrument_unlabeled(m)
     sim.scheduler.attach_metrics(m)
+    # SLO layer (services/slo.py): cycle latency + first-lease queue
+    # wait feed the tracker on the virtual clock; burn/compliance
+    # gauges refresh per cycle.
+    from armada_tpu.services.slo import SLOTracker
+
+    sim.scheduler.attach_slo(SLOTracker(metrics=m))
     sim.run()
+    # Round-observatory wiring (scheduler._note_transfer): the oracle
+    # sim never runs the kernel's device solve, so drive the wiring
+    # itself with ledger/compile payloads of the shape _solve emits —
+    # the transfer gauges/counters and xla counters prove they are
+    # connected (the _note_solve_profile pattern below).
+    sim.scheduler._note_transfer(
+        "default",
+        {"bytes_up": 4096, "arrays_up": 61, "bytes_down": 512,
+         "arrays_down": 9, "donated_bytes": 2048, "donated_buffers": 12},
+        {"traces": 3, "compiles": 1, "compile_seconds": 0.5,
+         "cache_hits": 1, "cache_misses": 1},
+    )
     # The solve-profile wiring (scheduler._note_solve_profile) is fed by
     # the kernel's host-driven driver; exercise the wiring itself with a
     # profile dict of the shape solver/kernel.solve_round emits so the
@@ -260,6 +320,28 @@ def test_every_labeled_family_live_after_short_sim(tmp_path):
     assert not live_exempt, (
         "exempted families now get samples in the sim — remove them from "
         f"EXEMPT_LABELED so they stay guarded: {live_exempt}"
+    )
+    # Unlabeled audit: every unlabeled family's mutators must have been
+    # CALLED during the sweep (rendered zero-samples can't distinguish
+    # dead wiring from a genuine zero — scheduler_cycle_seconds sat
+    # registered-but-dead in sims exactly that way), except the
+    # explicitly mode-gated exemptions, which must stay untouched so
+    # the list cannot rot.
+    dead_unlabeled = sorted(
+        family for family, calls in touched.items()
+        if calls == 0 and family not in EXEMPT_UNLABELED
+    )
+    assert not dead_unlabeled, (
+        f"unlabeled metric families never mutated by the sim sweep: "
+        f"{dead_unlabeled}"
+    )
+    touched_exempt = sorted(
+        family for family, calls in touched.items()
+        if calls > 0 and family in EXEMPT_UNLABELED
+    )
+    assert not touched_exempt, (
+        "exempted unlabeled families are now mutated in the sim — "
+        f"remove them from EXEMPT_UNLABELED: {touched_exempt}"
     )
     # Every family (labeled or not) appears in the rendered exposition.
     rendered = m.render().decode()

@@ -1,0 +1,314 @@
+"""SLO layer (services/slo.py, tools/slo_gate.py, soak --slo flags).
+
+Burn-rate math and the multiwindow alert are unit-tested on a virtual
+clock; the gate CLI must pass on the committed sim fixture and exit
+non-zero when an override tightens an SLO under the fixture's recorded
+latencies (the acceptance pair); the surfaces (`GET /api/slo`, the
+SLOStatus RPC behind `armadactl slo`) serve the tracker's snapshot; and
+a deliberately-breached SLO fails the front-door soak's gate.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig, SLOSpec
+from armada_tpu.services.slo import DEFAULT_SLOS, SLOTracker
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "sim_steady.atrace")
+
+FAST_SLO = SLOSpec(
+    name="lat", signal="latency_seconds", threshold_s=1.0, objective=0.9,
+    fast_burn_window_s=10.0, slow_burn_window_s=100.0,
+    fast_burn_threshold=2.0, slow_burn_threshold=1.5,
+)
+
+
+def test_tracker_burn_rates_on_virtual_clock():
+    """burn = error_rate / error_budget per window: 10 observations with
+    2 bad in the fast window → error rate 0.2 against a 0.1 budget →
+    burn 2.0; the slow window sees all 20 with 2 bad → burn 1.0."""
+    t = SLOTracker((FAST_SLO,))
+    for i in range(10):  # old good events, outside the fast window
+        t.observe("latency_seconds", 0.1, now=float(i))
+    for i in range(10):  # recent: 8 good + 2 bad
+        value = 5.0 if i >= 8 else 0.1
+        t.observe("latency_seconds", value, now=90.0 + i)
+    burns = t.burn_rates(now=99.0)["lat"]
+    assert burns["fast"] == pytest.approx(2.0)
+    assert burns["slow"] == pytest.approx(1.0)
+    snap = t.snapshot(now=99.0)["slos"][0]
+    assert snap["observed"] == 20 and snap["bad"] == 2
+    assert snap["compliance"] == pytest.approx(0.9)
+    # fast >= 2.0 AND slow >= 1.5 is the alert; slow sits at 1.0 → no.
+    assert not snap["alerting"]
+
+
+def test_tracker_multiwindow_alert_memory_and_evaluate():
+    """The gate remembers a mid-run multiwindow burn even when lifetime
+    compliance recovers — and reports it as a breach."""
+    t = SLOTracker((FAST_SLO,))
+    # A dense burst of bad events: both windows burn past threshold.
+    for i in range(10):
+        t.observe("latency_seconds", 9.0, now=float(i))
+    assert t.snapshot(now=9.0)["slos"][0]["breached_at"] is not None
+    # A long good tail recovers lifetime compliance above the objective.
+    for i in range(200):
+        t.observe("latency_seconds", 0.1, now=20.0 + i)
+    verdict = t.evaluate(now=220.0)
+    snap = verdict["slos"][0]
+    assert snap["compliance"] > FAST_SLO.objective
+    assert not verdict["ok"]
+    assert "multiwindow burn alert fired" in verdict["breaches"][0]
+
+
+def test_tracker_unobserved_slo_never_breaches():
+    t = SLOTracker(DEFAULT_SLOS)
+    t.observe("round_seconds", 0.1, now=0.0)
+    verdict = t.evaluate(now=1.0)
+    assert verdict["ok"]
+    observed = {s["name"]: s["observed"] for s in verdict["slos"]}
+    assert observed["round-latency"] == 1
+    assert observed["queue-wait"] == 0  # reported, never a breach
+
+
+def test_config_declares_and_validates_slos():
+    cfg = SchedulingConfig.from_dict({
+        "slos": [
+            {"name": "round-latency", "signal": "round_seconds",
+             "thresholdSeconds": 2.0, "objective": 0.999,
+             "fastBurnWindowSeconds": 60.0},
+        ]
+    })
+    assert cfg.slos[0].threshold_s == 2.0
+    assert cfg.slos[0].objective == 0.999
+    assert cfg.slos[0].fast_burn_window_s == 60.0
+    tracker = SLOTracker.from_config(cfg)
+    assert tracker.slos == cfg.slos
+    # Empty config → tracked defaults.
+    assert SLOTracker.from_config(SchedulingConfig()).slos == DEFAULT_SLOS
+    from armada_tpu.core.config import validate_config
+
+    with pytest.raises(ValueError, match="error budget"):
+        validate_config(SchedulingConfig(slos=(
+            SLOSpec(name="x", signal="s", threshold_s=1.0, objective=1.0),
+        )))
+    with pytest.raises(ValueError, match="thresholdSeconds"):
+        validate_config(SchedulingConfig(slos=(
+            SLOSpec(name="x", signal="s", threshold_s=0.0),
+        )))
+
+
+# ---------------------------------------------------------------------------
+# The gate CLI (acceptance pair: fixture passes, tightened SLO trips)
+
+
+def test_slo_gate_passes_on_committed_fixture(capsys):
+    from slo_gate import main as slo_gate_main
+
+    assert slo_gate_main([FIXTURE]) == 0
+    out = capsys.readouterr().out
+    assert "round-latency" in out and "OK" in out
+
+
+def test_slo_gate_trips_on_tightened_slo(capsys):
+    from slo_gate import main as slo_gate_main
+
+    assert slo_gate_main([FIXTURE, "--override", "round-latency=1e-6"]) == 1
+    assert "BREACH" in capsys.readouterr().out
+    # Typo'd override names must not silently gate nothing.
+    assert slo_gate_main([FIXTURE, "--override", "nosuch=1"]) == 2
+    # Objective override too: keep the threshold, demand perfection the
+    # fixture cannot deliver against a sub-ms threshold.
+    assert (
+        slo_gate_main([FIXTURE, "--override", "round-latency=0.001:0.5"]) == 1
+    )
+
+
+def test_slo_gate_reads_observation_documents(tmp_path, capsys):
+    from slo_gate import main as slo_gate_main
+
+    doc = {
+        "observations": [
+            {"signal": "frontdoor_submit_seconds", "value": 0.01, "now": i}
+            for i in range(20)
+        ]
+    }
+    path = tmp_path / "obs.json"
+    path.write_text(json.dumps(doc))
+    assert slo_gate_main([str(path)]) == 0
+    bad = {
+        "observations": [
+            {"signal": "frontdoor_submit_seconds", "value": 9.0, "now": i}
+            for i in range(20)
+        ]
+    }
+    path.write_text(json.dumps(bad))
+    assert slo_gate_main([str(path)]) == 1
+    # No decodable observations is unusable, not a green gate.
+    path.write_text(json.dumps({"observations": []}))
+    assert slo_gate_main([str(path)]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: lookout, RPC, armadactl
+
+
+def _scheduler_with_tracker():
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.scheduler import SchedulerService
+
+    log = InMemoryEventLog()
+    sched = SchedulerService(SchedulingConfig(), log)
+    tracker = SLOTracker(DEFAULT_SLOS)
+    tracker.observe("round_seconds", 0.2, now=1.0)
+    tracker.observe("round_seconds", 9.0, now=2.0)
+    sched.attach_slo(tracker)
+    return sched, log
+
+
+def test_lookout_api_slo_endpoint():
+    from armada_tpu.services.lookout_http import LookoutHttpServer
+
+    sched, _ = _scheduler_with_tracker()
+    server = LookoutHttpServer(None, sched, None, port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/api/slo"
+        ) as resp:
+            doc = json.loads(resp.read())
+        by_name = {s["name"]: s for s in doc["slos"]}
+        assert by_name["round-latency"]["observed"] == 2
+        assert by_name["round-latency"]["bad"] == 1
+        assert by_name["round-latency"]["compliance"] == 0.5
+    finally:
+        server.stop()
+
+
+def test_lookout_api_slo_503_when_detached():
+    from armada_tpu.services.lookout_http import LookoutHttpServer
+
+    sched, _ = _scheduler_with_tracker()
+    sched.slo = None
+    server = LookoutHttpServer(None, sched, None, port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"http://127.0.0.1:{server.port}/api/slo")
+        assert err.value.code == 503
+    finally:
+        server.stop()
+
+
+def test_slo_status_rpc_and_armadactl(capsys):
+    """SLOStatus over a real gRPC socket, raw client and `armadactl
+    slo` rendering."""
+    from armada_tpu.services.grpc_api import ApiClient, ApiServer
+
+    sched, log = _scheduler_with_tracker()
+    api = ApiServer(None, sched, None, log)
+    server, port = api.serve(0)
+    try:
+        client = ApiClient(f"127.0.0.1:{port}")
+        status = client.slo_status()
+        by_name = {s["name"]: s for s in status["slos"]}
+        assert by_name["round-latency"]["observed"] == 2
+        from armada_tpu.clients.cli import main as cli_main
+
+        cli_main(["--server", f"127.0.0.1:{port}", "slo"])
+        out = capsys.readouterr().out
+        assert "round-latency" in out and "1/2 good" in out
+        cli_main(["--server", f"127.0.0.1:{port}", "slo", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert {s["name"] for s in doc["slos"]} == {
+            "round-latency", "queue-wait", "frontdoor-p99"
+        }
+    finally:
+        server.stop(None)
+
+
+# ---------------------------------------------------------------------------
+# Sim + soak integration (the CI wiring satellite)
+
+
+def test_sim_attaches_tracker_and_observes_on_virtual_clock():
+    from armada_tpu.sim.simulator import (
+        ClusterSpec,
+        JobTemplate,
+        NodeTemplate,
+        QueueSpecSim,
+        Simulator,
+        WorkloadSpec,
+    )
+
+    sim = Simulator(
+        [ClusterSpec(name="c", node_templates=(NodeTemplate(count=2, cpu="8"),))],
+        WorkloadSpec(queues=(
+            QueueSpecSim(name="q", job_templates=(
+                JobTemplate(id="t", number=4, cpu="2"),
+            )),
+        )),
+        backend="oracle",
+        cycle_interval=10.0,
+        max_time=300.0,
+        slo=True,
+    )
+    sim.run()
+    verdict = sim.slo.evaluate()
+    by_name = {s["name"]: s for s in verdict["slos"]}
+    assert by_name["round-latency"]["observed"] > 0
+    assert by_name["queue-wait"]["observed"] == 4
+    # Oracle cycles are milliseconds and first leases land within a
+    # couple of virtual cycles: the default objectives hold.
+    assert verdict["ok"], verdict
+
+
+def test_frontdoor_soak_slo_gate_trips_on_deliberate_breach():
+    """The soak's --slo wiring: an impossibly tight submit-latency SLO
+    must breach the gate (exit non-zero through main), while the same
+    run under the committed SLO passes — and the seed doc exports the
+    observation stream tools/slo_gate.py re-evaluates to the same
+    verdict."""
+    from frontdoor_soak import DEFAULTS, run_soak
+    from slo_gate import main as slo_gate_main
+
+    cfg = dict(DEFAULTS, jobs=200, tenants=8, shards=2)
+    tight = (
+        SLOSpec(name="frontdoor-p99", signal="frontdoor_submit_seconds",
+                threshold_s=1e-9, objective=0.99),
+    )
+    doc = run_soak(0, cfg, slos=tight)
+    assert any(b.startswith("slo:") for b in doc["breaches"]), doc["breaches"]
+    assert doc["slo"]["ok"] is False
+    ok_doc = run_soak(0, cfg, slos=True)
+    assert not any(b.startswith("slo:") for b in ok_doc["breaches"])
+    # Offline re-evaluation of the exported stream agrees.
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+        json.dump({"observations": doc["slo"]["observations"]}, f)
+    try:
+        assert slo_gate_main(
+            [f.name, "--override", "frontdoor-p99=1e-9"]
+        ) == 1
+        assert slo_gate_main([f.name]) == 0
+    finally:
+        os.unlink(f.name)
+
+
+@pytest.mark.slow
+def test_chaos_soak_slo_gate_trips_on_deliberate_breach():
+    from chaos_soak import run_plan, soak_slos
+
+    with pytest.raises(AssertionError, match="SLO breach"):
+        run_plan(0, "oracle", 12, use_file_log=False,
+                 slos=soak_slos(queue_wait_s=0.001))
+    doc = run_plan(0, "oracle", 12, use_file_log=False, slos=soak_slos())
+    assert doc["slo"]["ok"] is True

@@ -59,14 +59,21 @@ def parse_artifact(doc: dict) -> dict | None:
 
 
 GATED = ("warm", "tracking", "burst", "pass1", "gather")
+# Round-observatory metrics (extra.transfer, absent before the
+# observatory round): bytes are deterministic counts gated by the same
+# threshold factor; the warm-cycle compile count is gated on ANY
+# increase — zero compiles IS the warm steady state, so one compile
+# sneaking into a warm cycle is a regression however fast it was.
+GATED_TRANSFER = ("bytes_up", "bytes_down", "compiles")
 
 
 def extract_metrics(result: dict | None) -> dict:
-    """{name: seconds|None} for every GATED metric from a bench result
+    """{name: value|None} for every gated metric from a bench result
     dict; tolerant of every historical shape (pass1/gather come from
     the headline config's extra.segments solve profile, absent before
-    the hot-window round)."""
-    out = {name: None for name in GATED}
+    the hot-window round; bytes_up/bytes_down/compiles from
+    extra.transfer, absent before the observatory round)."""
+    out = {name: None for name in GATED + GATED_TRANSFER}
     if not isinstance(result, dict):
         return out
     if isinstance(result.get("value"), (int, float)):
@@ -84,12 +91,23 @@ def extract_metrics(result: dict | None) -> dict:
             for seg, name in (("pass1_s", "pass1"), ("gather_s", "gather")):
                 if isinstance(segments.get(seg), (int, float)):
                     out[name] = float(segments[seg])
+        transfer = extra.get("transfer")
+        if isinstance(transfer, dict):
+            for key in ("bytes_up", "bytes_down"):
+                if isinstance(transfer.get(key), (int, float)):
+                    out[key] = float(transfer[key])
+            compiles = transfer.get("compiles")
+            if isinstance(compiles, dict) and isinstance(
+                compiles.get("compiles"), (int, float)
+            ):
+                out["compiles"] = float(compiles["compiles"])
     return out
 
 
 def gate(current: dict, baseline: dict, threshold: float) -> tuple[list, list]:
     """(regressions, notes) comparing extract_metrics dicts. A metric
-    regresses when current > baseline * threshold."""
+    regresses when current > baseline * threshold; the warm compile
+    count regresses on any increase over the baseline."""
     regressions, notes = [], []
     for name in GATED:
         cur, base = current.get(name), baseline.get(name)
@@ -100,6 +118,24 @@ def gate(current: dict, baseline: dict, threshold: float) -> tuple[list, list]:
         # 0.4ms gather doubling to 0.9ms must not fail the gate.
         limit = max(base, 0.01) * threshold
         line = f"{name}: current {cur:.4f}s vs baseline {base:.4f}s (limit {limit:.4f}s)"
+        if cur > limit:
+            regressions.append(line)
+        else:
+            notes.append("OK " + line)
+    for name in GATED_TRANSFER:
+        cur, base = current.get(name), baseline.get(name)
+        if cur is None or base is None:
+            notes.append(f"{name}: not comparable (current={cur} baseline={base})")
+            continue
+        if name == "compiles":
+            line = f"compiles: current {cur:.0f} vs baseline {base:.0f} (any increase gates)"
+            if cur > base:
+                regressions.append(line)
+            else:
+                notes.append("OK " + line)
+            continue
+        limit = max(base, 1.0) * threshold
+        line = f"{name}: current {cur:.0f}B vs baseline {base:.0f}B (limit {limit:.0f}B)"
         if cur > limit:
             regressions.append(line)
         else:

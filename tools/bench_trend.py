@@ -26,6 +26,15 @@ def _fmt(v) -> str:
     return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
 
 
+def _human_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "K", "M", "G", "T"):
+        if abs(n) < 1024 or unit == "T":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}T"  # pragma: no cover - loop always returns
+
+
 def rows(search_dir: str) -> list[dict]:
     out = []
     for path in sorted(
@@ -34,7 +43,7 @@ def rows(search_dir: str) -> list[dict]:
         row = {"round": os.path.basename(path), "warm": None,
                "tracking": None, "burst": None, "solve": None,
                "trace": False, "params": None, "whatif": None,
-               "frontdoor": None}
+               "frontdoor": None, "transfer": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -82,6 +91,28 @@ def rows(search_dir: str) -> list[dict]:
                 )
                 + ("" if frontdoor.get("ok", True) else "!")
             )
+        transfer = extra.get("transfer") if isinstance(extra, dict) else None
+        if isinstance(transfer, dict):
+            # Round-observatory cost ledger (armada_tpu/observe): the
+            # headline warm cycle's bytes up/down plus its compile
+            # count ("c0" is the healthy warm state). Older artifacts
+            # simply lack the block.
+            up = transfer.get("bytes_up")
+            down = transfer.get("bytes_down")
+            compiles = (transfer.get("compiles") or {}).get("compiles")
+            if isinstance(up, (int, float)) and isinstance(down, (int, float)):
+                # One whitespace-free token so column positions stay
+                # parseable: up/down,cN (c = warm-cycle compile count).
+                row["transfer"] = (
+                    f"{_human_bytes(up)}/{_human_bytes(down)}"
+                    + (
+                        f",c{compiles:.0f}"
+                        if isinstance(compiles, (int, float))
+                        else ""
+                    )
+                )
+            else:
+                row["transfer"] = "yes"
         params = extra.get("params") if isinstance(extra, dict) else None
         if isinstance(params, dict):
             # Effective headline solver parameters (window/chunk, "*"
@@ -107,7 +138,7 @@ def main(argv=None) -> int:
     header = (
         f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} "
         f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6} {'whatif':>9} "
-        f"{'frontdoor':>10}"
+        f"{'frontdoor':>10} {'transfer':>16}"
     )
     print(header)
     print("-" * len(header))
@@ -118,7 +149,8 @@ def main(argv=None) -> int:
             f"{r.get('params') or '-':>10} "
             f"{'yes' if r.get('trace') else '-':>6} "
             f"{r.get('whatif') or '-':>9} "
-            f"{r.get('frontdoor') or '-':>10}"
+            f"{r.get('frontdoor') or '-':>10} "
+            f"{r.get('transfer') or '-':>16}"
         )
     return 0
 

@@ -39,7 +39,24 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_sim(seed: int, backend: str, n_jobs: int, data_dir: str | None):
+# The soak's declared SLOs (--slo): round latency is REAL wall clock
+# per cycle (oracle cycles are milliseconds; a 5s cycle under this tiny
+# fleet is a pathology), queue wait is VIRTUAL seconds — generous
+# headroom over the worst legitimate partition-window requeue delay so
+# chaos-delayed-but-recovered work does not false-positive the gate.
+def soak_slos(queue_wait_s: float = 3600.0, round_s: float = 5.0):
+    from armada_tpu.core.config import SLOSpec
+
+    return (
+        SLOSpec(name="round-latency", signal="round_seconds",
+                threshold_s=round_s, objective=0.95),
+        SLOSpec(name="queue-wait", signal="queue_wait_seconds",
+                threshold_s=queue_wait_s, objective=0.95),
+    )
+
+
+def build_sim(seed: int, backend: str, n_jobs: int, data_dir: str | None,
+              slos=None):
     from armada_tpu.core.config import SchedulingConfig
     from armada_tpu.services.chaos import FaultPlan, FaultSpec
     from armada_tpu.sim.simulator import (
@@ -154,6 +171,11 @@ def build_sim(seed: int, backend: str, n_jobs: int, data_dir: str | None):
             for i in range(2)
         )
     )
+    slo_tracker = None
+    if slos:
+        from armada_tpu.services.slo import SLOTracker
+
+        slo_tracker = SLOTracker(slos)
     return Simulator(
         clusters,
         workload,
@@ -164,6 +186,7 @@ def build_sim(seed: int, backend: str, n_jobs: int, data_dir: str | None):
         max_time=6 * 3600.0,
         fault_plan=plan,
         data_dir=data_dir,
+        slo=slo_tracker,
     ), plan
 
 
@@ -186,15 +209,17 @@ def jobdb_digest(sim) -> str:
 
 
 def run_plan(seed: int, backend: str = "oracle", n_jobs: int = 40,
-             use_file_log: bool = True) -> dict:
-    """One soak iteration; raises on any invariant violation."""
+             use_file_log: bool = True, slos=None) -> dict:
+    """One soak iteration; raises on any invariant violation (with
+    `slos`, a declared-SLO breach — services/slo.py burn-rate verdict
+    over the run — is an invariant violation too)."""
     tmp = None
     data_dir = None
     if use_file_log:
         tmp = tempfile.TemporaryDirectory(prefix=f"chaos-soak-{seed}-")
         data_dir = tmp.name
     try:
-        sim, plan = build_sim(seed, backend, n_jobs, data_dir)
+        sim, plan = build_sim(seed, backend, n_jobs, data_dir, slos=slos)
         result = sim.run()
         # Final invariant sweep on top of the per-cycle assertions
         # (assert_valid includes the split-brain invariant: at most one
@@ -221,6 +246,14 @@ def run_plan(seed: int, backend: str = "oracle", n_jobs: int = 40,
                 f"seed {seed}: {unfinished}/{result.total_jobs} jobs never "
                 "reached a terminal state under chaos"
             )
+        slo_verdict = None
+        if sim.slo is not None:
+            slo_verdict = sim.slo.evaluate(now=result.makespan)
+            if not slo_verdict["ok"]:
+                raise AssertionError(
+                    f"seed {seed}: SLO breach: "
+                    + "; ".join(slo_verdict["breaches"])
+                )
         crashes = getattr(sim.log, "crashes", 0)
         anti_entropy: dict = {}
         for ex in sim.executors:
@@ -238,6 +271,20 @@ def run_plan(seed: int, backend: str = "oracle", n_jobs: int = 40,
             "log_crashes": crashes,
             "anti_entropy": anti_entropy,
             "fences": dict(sim.scheduler.executor_fences),
+            **(
+                {
+                    "slo": {
+                        "ok": slo_verdict["ok"],
+                        "slos": [
+                            {k: s[k] for k in ("name", "observed", "good",
+                                               "bad", "compliance")}
+                            for s in slo_verdict["slos"]
+                        ],
+                    }
+                }
+                if slo_verdict is not None
+                else {}
+            ),
         }
     finally:
         if tmp is not None:
@@ -251,14 +298,25 @@ def main(argv=None) -> int:
                     choices=["oracle", "kernel"])
     ap.add_argument("--jobs", type=int, default=40)
     ap.add_argument("--no-determinism-check", action="store_true")
+    ap.add_argument("--slo", action="store_true",
+                    help="gate each plan on the soak's declared SLOs "
+                    "(services/slo.py): real-wall round latency and "
+                    "virtual-clock queue wait")
+    ap.add_argument("--slo-queue-wait", type=float, default=3600.0,
+                    help="queue-wait SLO threshold in VIRTUAL seconds "
+                    "(with --slo; a deliberately tiny value proves the "
+                    "gate trips)")
     args = ap.parse_args(argv)
 
+    slos = (
+        soak_slos(queue_wait_s=args.slo_queue_wait) if args.slo else None
+    )
     failures = 0
     for seed in range(args.plans):
         try:
-            first = run_plan(seed, args.backend, args.jobs)
+            first = run_plan(seed, args.backend, args.jobs, slos=slos)
             if not args.no_determinism_check:
-                second = run_plan(seed, args.backend, args.jobs)
+                second = run_plan(seed, args.backend, args.jobs, slos=slos)
                 if first["digest"] != second["digest"]:
                     raise AssertionError(
                         f"seed {seed}: nondeterministic final jobdb "

@@ -120,9 +120,16 @@ def build_fault_plan(seed: int, duration: float, shards: int):
 
 
 def run_soak(seed: int, cfg: dict, inject_loss: bool = False,
-             verbose: bool = False) -> dict:
+             verbose: bool = False, slos=None) -> dict:
     """One seeded soak; returns the gate document (breaches list
-    included). Raises nothing for SLO breaches — the caller gates."""
+    included). Raises nothing for SLO breaches — the caller gates.
+
+    `slos`: an iterable of core.config.SLOSpec (or True for the soak's
+    defaults) attaches a services/slo.SLOTracker to the submit path —
+    every submit (admitted, shed or expired) feeds the
+    frontdoor_submit_seconds signal, the tracker's evaluate() verdict
+    joins the breach list, and the raw observation stream lands in the
+    doc under "slo" for offline re-evaluation by tools/slo_gate.py."""
     import numpy as np
 
     from armada_tpu.core.config import SchedulingConfig
@@ -185,7 +192,33 @@ def run_soak(seed: int, cfg: dict, inject_loss: bool = False,
     store_gate.add_lag_source("scheduler-ingester",
                               lambda: max(0, log.end_offset - sched.ingester.cursor))
     store_gate.add_lag_source("frontdoor", fd.max_lag)
-    submit = SubmitService(config, log, scheduler=sched, frontdoor=fd)
+    tracker = None
+    if slos:
+        from armada_tpu.core.config import SLOSpec
+        from armada_tpu.services.slo import SLOTracker
+
+        specs = (
+            (
+                # The soak's default: the committed submit-p99 SLO as a
+                # declared objective (the hand-rolled p99 check below
+                # stays — the tracker adds burn-rate semantics and the
+                # offline-reevaluable observation stream).
+                SLOSpec(
+                    name="frontdoor-p99",
+                    signal="frontdoor_submit_seconds",
+                    threshold_s=float(cfg["slo"]["submit_p99_s"]),
+                    objective=0.99,
+                ),
+            )
+            if slos is True
+            else tuple(slos)
+        )
+        # The retained raw stream is bounded (oldest dropped): seed docs
+        # stay printable at full-scale soaks while committed-config runs
+        # export every observation for tools/slo_gate.py.
+        tracker = SLOTracker(specs, keep_observations=50_000)
+    submit = SubmitService(config, log, scheduler=sched, frontdoor=fd,
+                           slo=tracker)
     for tenant in weights:
         submit.create_queue(QueueSpec(tenant))
     executors = [
@@ -350,6 +383,20 @@ def run_soak(seed: int, cfg: dict, inject_loss: bool = False,
             f"max shard lag {max_lag_seen} over SLO "
             f"{slo['max_shard_lag_events']}"
         )
+    slo_block = None
+    if tracker is not None:
+        verdict = tracker.evaluate(now=t)
+        breaches += [f"slo: {b}" for b in verdict["breaches"]]
+        slo_block = {
+            "ok": verdict["ok"],
+            "breaches": verdict["breaches"],
+            "slos": [
+                {k: s[k] for k in ("name", "observed", "good", "bad",
+                                   "compliance")}
+                for s in verdict["slos"]
+            ],
+            "observations": tracker.observations(),
+        }
     doc = {
         "seed": seed,
         "acked": len(acked),
@@ -370,6 +417,8 @@ def run_soak(seed: int, cfg: dict, inject_loss: bool = False,
         "makespan": round(t, 1),
         "breaches": breaches,
     }
+    if slo_block is not None:
+        doc["slo"] = slo_block
     fd.close()
     tmp.cleanup()
     return doc
@@ -384,6 +433,15 @@ def main(argv=None) -> int:
     ap.add_argument("--shards", type=int, default=None)
     ap.add_argument("--inject-loss", action="store_true",
                     help="drop one acked WAL entry (the gate MUST trip)")
+    ap.add_argument("--slo", action="store_true",
+                    help="attach a services/slo.SLOTracker to the submit "
+                    "path: declared-SLO breaches (burn-rate semantics) "
+                    "join the gate, and each seed doc carries the raw "
+                    "observation stream for tools/slo_gate.py")
+    ap.add_argument("--slo-threshold", type=float, default=None,
+                    help="override the tracked submit-latency SLO "
+                    "threshold in seconds (with --slo; a deliberately "
+                    "tiny value proves the gate trips)")
     ap.add_argument("--out", default=None,
                     help="write a bench-style artifact with the "
                          "extra.frontdoor block (tools/bench_trend.py)")
@@ -394,10 +452,25 @@ def main(argv=None) -> int:
         if value is not None:
             cfg[key] = value
 
+    slos = None
+    if args.slo:
+        if args.slo_threshold is not None:
+            from armada_tpu.core.config import SLOSpec
+
+            slos = (
+                SLOSpec(
+                    name="frontdoor-p99",
+                    signal="frontdoor_submit_seconds",
+                    threshold_s=args.slo_threshold,
+                    objective=0.99,
+                ),
+            )
+        else:
+            slos = True
     failures = 0
     docs = []
     for seed in range(args.seeds):
-        doc = run_soak(seed, cfg, inject_loss=args.inject_loss)
+        doc = run_soak(seed, cfg, inject_loss=args.inject_loss, slos=slos)
         docs.append(doc)
         if doc["breaches"]:
             failures += 1

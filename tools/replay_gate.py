@@ -12,10 +12,13 @@ stream diverges from the recorded one.
 
 Divergences classify as `placement` (any decision array differs —
 placements, evictions, priorities, fair shares, spot price),
-`loop_stream` (same decisions, different pass-1 loop count), and
+`loop_stream` (same decisions, different pass-1 loop count),
 `profile_regression` (replay wall clock beyond --profile-threshold x
 the recorded solve time; off by default — wall clocks only compare on
-one host). `--perturb tiebreak` injects a deliberately-buggy candidate
+one host), and `retrace` (XLA traced/compiled during a round whose
+shape signature was already replayed under that solver — a warm cycle
+must dispatch cached executables; disable with --no-retrace-check).
+`--perturb tiebreak` injects a deliberately-buggy candidate
 (reversed node tie-break ranking) to prove the gate trips.
 
 A bundle recorded on a different target (host CPU features / XLA
@@ -60,6 +63,10 @@ def main(argv=None) -> int:
     ap.add_argument("--allow-foreign", action="store_true",
                     help="replay a bundle recorded on a different host "
                     "(sound only for x64-recorded traces)")
+    ap.add_argument("--no-retrace-check", action="store_true",
+                    help="skip the warm-shape retrace audit (e.g. when "
+                    "deliberately replaying with cold jit caches "
+                    "cleared between rounds)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full report as one JSON line")
     args = ap.parse_args(argv)
@@ -96,6 +103,7 @@ def main(argv=None) -> int:
                 profile_threshold=args.profile_threshold or None,
                 perturb=args.perturb,
                 allow_foreign=args.allow_foreign,
+                flag_retraces=not args.no_retrace_check,
                 log=lambda msg: print(f"{os.path.basename(path)}: {msg}"),
             )
         except TraceTargetMismatch as e:
