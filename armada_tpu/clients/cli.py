@@ -2,8 +2,9 @@
 
 Command surface mirrors /root/reference/internal/armadactl: queue CRUD and
 cordon, submit (YAML job files), cancel, reprioritize, watch, job queries,
-scheduling reports, per-job journey traces (`job-trace`), plus `server`
-to run a local control plane.
+scheduling reports, per-job journey traces (`job-trace`), SLO status
+(`slo`), the fairness scorecard (`fairness`), plus `server` to run a
+local control plane.
 
   python -m armada_tpu.clients.cli --server 127.0.0.1:50051 <command> ...
 """
@@ -207,6 +208,56 @@ def cmd_slo(args):
             f"fast {fast['rate']:.2f}x/{fast['threshold']:.0f}x "
             f"slow {slow['rate']:.2f}x/{slow['threshold']:.0f}x"
             + history
+        )
+
+
+def cmd_fairness(args):
+    """Print the fairness observatory's latest per-pool scorecard:
+    entitlement vs delivered share per queue, regret, Jain index,
+    preemption attribution and active starvation alerts
+    (observe/fairness.py; GET /api/fairness serves the same)."""
+    client = connect(args.server, ca_cert=args.ca_cert or None)
+    doc = client.fairness_report(pool=args.pool or None)
+    if args.json:
+        _print(doc)
+        return
+    pools = doc.get("pools") or {}
+    if not pools:
+        print("no fairness ledger recorded yet (no round has solved)")
+        return
+    for pool in sorted(pools):
+        pdoc = pools[pool] or {}
+        ledger = pdoc.get("ledger") or {}
+        print(
+            f"pool {pool}: jain {ledger.get('jain', 1.0):.4f}  "
+            f"max regret {ledger.get('max_regret', 0.0):.4f}  "
+            f"round {pdoc.get('rounds', 0)}"
+        )
+        for row in ledger.get("queues", []):
+            flags = ""
+            if row.get("alerting"):
+                flags = "  STARVATION ALERT"
+            elif row.get("starved"):
+                flags = "  starved"
+            print(
+                f"  queue {row['queue']}: weight {row.get('weight', 0):g}  "
+                f"share {row.get('fair_share', 0.0):.4f}  "
+                f"entitled {row.get('entitlement', 0.0):.4f} "
+                f"(uncapped {row.get('uncapped', 0.0):.4f})  "
+                f"demand {row.get('demand_share', 0.0):.4f}  "
+                f"delivered {row.get('delivered_share', 0.0):.4f}  "
+                f"regret {row.get('regret', 0.0):.4f}"
+                f"{flags}"
+            )
+        for p in pdoc.get("preemptions", []):
+            print(
+                f"  preempted {p.get('job_id') or p.get('job')}: "
+                f"{p.get('reason') or p.get('mechanism')}"
+            )
+    for a in doc.get("alerts", []):
+        print(
+            f"ALERT pool {a['pool']} queue {a['queue']}: starved "
+            f"{a['starved_rounds']} consecutive rounds"
         )
 
 
@@ -479,6 +530,16 @@ def build_parser():
     )
     slo.add_argument("--json", action="store_true")
     slo.set_defaults(fn=cmd_slo)
+
+    fair = sub.add_parser(
+        "fairness",
+        help="show the per-pool fairness scorecard (entitlement vs "
+        "delivered share, regret, Jain, preemption attribution, "
+        "starvation alerts)",
+    )
+    fair.add_argument("--pool", default="")
+    fair.add_argument("--json", action="store_true")
+    fair.set_defaults(fn=cmd_fairness)
 
     wi = sub.add_parser(
         "whatif",

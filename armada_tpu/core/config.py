@@ -247,6 +247,14 @@ class SchedulingConfig:
     # preempt stragglers once this many seconds have passed
     # (armada_tpu/whatif/drain.py; 0 = preempt immediately).
     drain_deadline_s: float = 600.0
+    # Fairness observatory (armada_tpu/observe/fairness.py): a queue
+    # starved (below its DRF entitlement with unsatisfied demand) for
+    # this many CONSECUTIVE rounds arms the multiwindow starvation
+    # alert (the slow condition — starved in at least half of a 4x
+    # trailing window's full capacity — must hold too before it fires,
+    # so a fresh streak stays silent until starvation sustains to ~2x
+    # this many rounds).
+    fairness_starvation_rounds: int = 3
     executor_timeout_s: float = 600.0
     # Lease TTL advertised to executor agents in every lease reply: an
     # agent that cannot complete a lease exchange for this long must
@@ -547,6 +555,7 @@ class SchedulingConfig:
             ("whatifQueueDepth", "whatif_queue_depth", int),
             ("whatifDefaultRounds", "whatif_default_rounds", int),
             ("drainDeadlineSeconds", "drain_deadline_s", float),
+            ("fairnessStarvationRounds", "fairness_starvation_rounds", int),
             ("executorLeaseTTL", "executor_lease_ttl_s", float),
             ("maxSchedulingDuration", "max_scheduling_duration_s", float),
             (

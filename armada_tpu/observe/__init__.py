@@ -17,9 +17,28 @@ that makes that refactor executable and provable:
   events, backend compile wall clock and compile-cache hits/misses,
   surfaced as `scheduler_xla_compiles_total` /
   `scheduler_xla_compile_seconds` and as a `retrace` divergence class
-  in trace replay (a warm shape that recompiles is a bug signal).
+  in trace replay (a warm shape that recompiles is a bug signal);
+- `fairness` — the round OUTCOME ledger: per-queue entitlement vs
+  delivered dominant share (fair-share triple, demand share, regret,
+  Jain index), a deterministic preemption attribution map (victim →
+  aggressor queue/gang + mechanism), and the starvation detector with
+  its multiwindow alert — surfaced as `scheduler_fairness_*` metrics,
+  `GET /api/fairness`, the `FairnessReport` RPC / `armadactl
+  fairness`, fairness blocks in flight-recorder rounds (a new
+  `fairness_ledger` replay-divergence kind) and
+  `tools/fairness_report.py` offline scorecards.
 """
 
+from .fairness import (  # noqa: F401
+    FairnessTracker,
+    aggregate_scorecard,
+    attribute_preemptions,
+    compute_ledger,
+    jain_index,
+    ledger_from_device_round,
+    ledger_from_snapshot,
+    resolve_names,
+)
 from .ledger import (  # noqa: F401
     TransferLedger,
     active_ledger,

@@ -680,6 +680,30 @@ class ApiServer:
             raise KeyError("SLO tracking not enabled on this server")
         return tracker.snapshot()
 
+    def _fairness_report(self, req):
+        """Fairness observatory (observe/fairness.py): the latest per
+        -pool share ledger, preemption attribution map and starvation
+        alerts. Leader-proxied like the reports — the ledger describes
+        the leader's rounds. Optional req["pool"] narrows to one pool
+        (NOT_FOUND when no round has solved for it)."""
+        proxied = self._proxy_to_leader("FairnessReport", req)
+        if proxied is not None:
+            return proxied
+        tracker = getattr(self.scheduler, "fairness", None)
+        if tracker is None:
+            raise KeyError("fairness observatory not enabled on this server")
+        pool = req.get("pool") or None
+        if pool:
+            doc = tracker.latest(pool)
+            if doc is None:
+                raise KeyError(f"no fairness ledger recorded for pool {pool!r}")
+            snap = tracker.snapshot()
+            return {
+                "pools": {pool: doc},
+                "alerts": [a for a in snap["alerts"] if a["pool"] == pool],
+            }
+        return tracker.snapshot()
+
     # ---- what-if planner (armada_tpu/whatif) ----
 
     def _whatif_service(self):
@@ -1404,6 +1428,7 @@ class ApiServer:
             "JobReport": self._job_report,
             "JobTrace": self._job_trace,
             "SLOStatus": self._slo_status,
+            "FairnessReport": self._fairness_report,
             "GetJobLogs": self._get_logs,
             "CordonNode": self._cordon_node,
             "SetPriorityOverride": self._set_priority_override,
@@ -1744,6 +1769,12 @@ class ApiClient:
     def slo_status(self):
         """Declared SLOs + compliance + burn rates (services/slo.py)."""
         return self._call("SLOStatus", {})
+
+    def fairness_report(self, pool=None):
+        """Fairness observatory document: {"pools": {pool: {ledger,
+        preemptions, alerts...}}, "alerts": [...]}
+        (observe/fairness.py; GET /api/fairness serves the same)."""
+        return self._call("FairnessReport", {"pool": pool or ""})
 
     def job_trace(self, job_id):
         """The job's end-to-end journey: {"journey": <dict>, "rendered":

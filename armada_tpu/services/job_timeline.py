@@ -181,8 +181,13 @@ class JobTimelineStore:
             elif isinstance(event, JobRunRunning):
                 self._append(self._journey(job_id), created, "running")
             elif isinstance(event, JobRunPreempted):
+                # Every preemption must carry its attribution (aggressor
+                # queue/gang + mechanism, or drain/reconciliation): an
+                # empty reason records as "unknown", which the chaos-sim
+                # tier-1 test asserts never happens for any producer.
                 self._append(
-                    self._journey(job_id), created, "preempted", event.reason
+                    self._journey(job_id), created, "preempted",
+                    event.reason or "unknown",
                 )
             elif isinstance(event, JobRunErrors):
                 self._append(

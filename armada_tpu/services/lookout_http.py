@@ -14,6 +14,9 @@ view, scheduling report.
   GET /api/groups?by=F[&byAnnotation=1]&aggregates=<json>&filters=<json>
   GET /api/queues
   GET /api/fairshare             (per-pool queue shares, latest round)
+  GET /api/fairness              (fairness observatory: share ledger,
+                                  preemption attribution, starvation
+                                  alerts — observe/fairness.py)
   GET /api/report
   GET /api/errors
   GET /api/logs/<job_id>?tail=N   (binoculars log fetch, when wired)
@@ -261,6 +264,21 @@ class LookoutHttpServer:
                             for qr in rep.queues.values()
                         ]
                     self._json({"pools": pools})
+                elif parsed.path == "/api/fairness":
+                    # Fairness observatory (observe/fairness.py): the
+                    # latest per-pool share ledger (entitlement vs
+                    # delivered, regret, Jain), the round's preemption
+                    # attribution map and active starvation alerts —
+                    # the "Diagnosing an unfair pool" runbook's first
+                    # stop (docs/operations.md).
+                    tracker = getattr(outer.scheduler, "fairness", None)
+                    if tracker is None:
+                        self._json(
+                            {"error": "fairness observatory not enabled"},
+                            503,
+                        )
+                        return
+                    self._json(tracker.snapshot())
                 elif parsed.path == "/api/report":
                     self._json(
                         {"report": outer.scheduler.reports.scheduling_report()}

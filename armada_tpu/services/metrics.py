@@ -530,6 +530,67 @@ class SchedulerMetrics:
             ["slo"],
             registry=r,
         )
+        # ---- fairness observatory (armada_tpu/observe/fairness.py):
+        # the round OUTCOME surface. The full fair-share triple per
+        # queue — demand-capped (scheduler_queue_fair_share above),
+        # uncapped entitlement, and demand share — lets dashboards
+        # distinguish "capped by demand" from "starved"; regret and the
+        # starved-rounds streak are the starvation-alert inputs; the
+        # attribution counter answers "who is preempting whom".
+        self.fair_share_uncapped = Gauge(
+            "scheduler_queue_fair_share_uncapped",
+            "Uncapped adjusted fair share (the entitlement the queue "
+            "would hold were its demand unbounded; drf.py water-filling "
+            "triple)",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.queue_demand_share = Gauge(
+            "scheduler_queue_demand_share",
+            "Queue demand as DRF dominant-share cost of the round's "
+            "full (running + queued) demand",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.fairness_regret = Gauge(
+            "scheduler_fairness_regret",
+            "Per-queue fairness error: entitlement (demand-capped "
+            "adjusted fair share) minus delivered dominant share, "
+            "floored at zero",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.fairness_jain = Gauge(
+            "scheduler_fairness_jain",
+            "Jain fairness index of the pool's delivered-per-weight "
+            "shares over competing queues (1.0 = perfectly "
+            "proportional)",
+            ["pool"],
+            registry=r,
+        )
+        self.fairness_starved_rounds = Gauge(
+            "scheduler_fairness_starved_rounds",
+            "Consecutive rounds the queue has been starved (below its "
+            "entitlement with unsatisfied demand); 0 = healthy",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.fairness_starvation_alerts = Counter(
+            "scheduler_fairness_starvation_alerts_total",
+            "Multiwindow starvation alerts fired (K consecutive "
+            "starved rounds AND starved in at least half of a 4xK "
+            "trailing window)",
+            ["pool", "queue"],
+            registry=r,
+        )
+        self.preemption_attributed = Counter(
+            "scheduler_preemption_attributed_total",
+            "Round preemptions attributed to an aggressor queue, by "
+            "mechanism (fairness = DRF rebalance, urgency = higher "
+            "scheduled priority)",
+            ["aggressor_queue", "mechanism"],
+            registry=r,
+        )
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS:

@@ -19,6 +19,16 @@ class QueueReport:
     fair_share: float = 0.0
     adjusted_fair_share: float = 0.0
     actual_share: float = 0.0
+    # Fairness observatory (armada_tpu/observe/fairness.py): the full
+    # fair-share triple plus the round's outcome — demand share,
+    # delivered dominant share, regret (entitlement - delivered, >= 0)
+    # and whether the queue is starved (below entitlement with
+    # unsatisfied demand).
+    uncapped_fair_share: float = 0.0
+    demand_share: float = 0.0
+    delivered_share: float = 0.0
+    fairness_regret: float = 0.0
+    starved: bool = False
     scheduled_jobs: int = 0
     preempted_jobs: int = 0
     # Market pools: value placed this round vs the single-mega-node
@@ -83,8 +93,12 @@ class RoundReport:
             lines.append(
                 f"  queue {q}: fairShare={r.fair_share:.4f} "
                 f"adjustedFairShare={r.adjusted_fair_share:.4f} "
+                f"uncappedFairShare={r.uncapped_fair_share:.4f} "
+                f"demandShare={r.demand_share:.4f} "
                 f"actualShare={r.actual_share:.4f} "
-                f"scheduled={r.scheduled_jobs} preempted={r.preempted_jobs}"
+                f"regret={r.fairness_regret:.4f}"
+                + (" STARVED" if r.starved else "")
+                + f" scheduled={r.scheduled_jobs} preempted={r.preempted_jobs}"
                 + value
             )
         return "\n".join(lines)

@@ -165,6 +165,16 @@ class SchedulerService:
         # the queue-wait SLO, with burn-rate gauges refreshed per cycle
         # (attach_slo; surfaced via GET /api/slo and `armadactl slo`).
         self.slo = None
+        # Fairness observatory (armada_tpu/observe/fairness.py): every
+        # round's share ledger + preemption attribution feed this
+        # tracker — per-queue starvation streaks with the multiwindow
+        # alert, the scheduler_fairness_* metric families, and the
+        # document behind GET /api/fairness / the FairnessReport RPC /
+        # `armadactl fairness`. Always on: it is pure host bookkeeping
+        # over arrays the round already computed.
+        from ..observe.fairness import FairnessTracker
+
+        self.fairness = FairnessTracker(config.fairness_starvation_rounds)
         # Staged executor drains (whatif/drain.py): cordon -> voluntary
         # completion -> deadline preempt-requeue, stepped once per cycle
         # through the same event path as every other transition.
@@ -264,7 +274,7 @@ class SchedulerService:
         self.whatif = service
 
     def _trace_round(self, snap, dev, decisions, *, solver, truncated,
-                     solve_s, profile=None):
+                     solve_s, profile=None, fairness=None):
         """Append one solved round to the attached flight recorder.
         Recording must never fail the round: errors log and drop."""
         rec = self.trace_recorder
@@ -289,6 +299,7 @@ class SchedulerService:
                 profile=profile,
                 solve_s=solve_s,
                 ids=ids,
+                fairness=fairness,
                 metrics=self.metrics,
             )
         except Exception as e:  # noqa: BLE001 - advisory path
@@ -1357,7 +1368,10 @@ class SchedulerService:
                 realised = value_by_queue(snap, placed, unit)
                 idealised = calculate_idealised_value(
                     self.config, pool, nodes, queues, running, queued,
-                    self._solve, unit,
+                    # Hypothetical mega-node solves: skip the fairness
+                    # ledger (nothing reads it off this path).
+                    lambda s: self._solve(s, fairness=False),
+                    unit,
                 )
             except Exception as e:
                 self.log_.with_fields(cycle=self.cycle_count, pool=pool).error(
@@ -1409,6 +1423,17 @@ class SchedulerService:
             )
             by_jobset.setdefault((job.queue, job.jobset), []).append(event)
 
+        # Preemption attribution (armada_tpu/observe/fairness.py): every
+        # round preemption's event carries its aggressor queue/gang and
+        # mechanism, so `armadactl job-trace` answers "preempted by
+        # queue B gang g-7 under DRF rebalance" instead of a bare
+        # "preempted by scheduler round".
+        attributed = {
+            int(p["job"]): p.get("reason", "")
+            for p in (result.get("fairness_decorated") or {}).get(
+                "preemptions", ()
+            )
+        }
         for j in np.flatnonzero(result["preempted_mask"]):
             job = txn.get(snap.job_ids[j])
             run = job.latest_run
@@ -1422,7 +1447,8 @@ class SchedulerService:
                 created=now,
                 job_id=job.id,
                 run_id=run_id,
-                reason="preempted by scheduler round",
+                reason=attributed.get(int(j))
+                or "preempted by scheduler round",
             )
             by_jobset.setdefault((job.queue, job.jobset), []).append(event)
 
@@ -1876,7 +1902,10 @@ class SchedulerService:
             return None
         return max(1e-9, self._round_deadline - _time.monotonic())
 
-    def _solve(self, snap, inc=None):
+    def _solve(self, snap, inc=None, fairness=True):
+        """`fairness=False` skips the per-round fairness block: the
+        idealised-value pass re-solves hypothetical mega-node snapshots
+        whose ledger no caller reads."""
         budget_s = self._remaining_budget()
         if self.backend == "kernel":
             from ..solver.kernel import solve_round
@@ -1974,6 +2003,26 @@ class SchedulerService:
             if "profile" in out:
                 out["profile"] = cost_profile
             self._note_transfer(snap.pool, transfer, compiles)
+            # Fairness observatory (armada_tpu/observe/fairness.py): the
+            # canonical per-round share ledger + preemption attribution,
+            # computed host-side from the EXACT padded DeviceRound the
+            # kernel consumed and its decision stream — the same bits
+            # land in the flight-recorder record (replay diffs them as
+            # the fairness_ledger divergence kind), the metrics/report
+            # surfaces, and the starvation detector. Advisory: a ledger
+            # failure must never fail the round.
+            fairness_block = None
+            if fairness:
+                try:
+                    from ..observe.fairness import ledger_from_device_round
+
+                    fairness_block = ledger_from_device_round(
+                        dev, out, snap.num_jobs, snap.num_queues
+                    )
+                except Exception as e:  # noqa: BLE001 - advisory path
+                    self.log_.with_fields(pool=snap.pool).error(
+                        "fairness ledger failed: %r", e
+                    )
             if self.trace_recorder is not None:
                 self._trace_round(
                     snap,
@@ -1983,6 +2032,7 @@ class SchedulerService:
                     truncated=truncated,
                     solve_s=round(_t.monotonic() - t_solve, 4),
                     profile=cost_profile,
+                    fairness=fairness_block,
                 )
             self._note_solve_profile(snap.pool, out.get("profile"))
             if self.autotune is not None and self.mesh is None:
@@ -2010,6 +2060,8 @@ class SchedulerService:
                 "preempted_mask": out["preempted_mask"][:J],
                 "fair_share": out["fair_share"][:Q],
                 "demand_capped_fair_share": out["demand_capped_fair_share"][:Q],
+                "uncapped_fair_share": out["uncapped_fair_share"][:Q],
+                "fairness": fairness_block,
                 "unschedulable_reason": None,
                 "termination_reason": "round_truncated" if truncated else "",
                 "truncated": truncated,
@@ -2026,38 +2078,7 @@ class SchedulerService:
 
         t_solve = _t.monotonic()
         res = ReferenceSolver(snap).solve(budget_s=budget_s)
-        if self.trace_recorder is not None:
-            # Oracle-backed services record too: the bundle's DeviceRound
-            # is the same device prep the kernel would see, so a trace
-            # captured here replays any candidate kernel against the
-            # oracle's decisions (spot price + loop accounting are
-            # oracle-specific and skipped by the replay compare).
-            import numpy as np
-
-            from ..solver.kernel_prep import pad_device_round, prep_device_round
-
-            self._trace_round(
-                snap,
-                pad_device_round(prep_device_round(snap)),
-                {
-                    "assigned_node": res.assigned_node,
-                    "scheduled_priority": res.scheduled_priority,
-                    "scheduled_mask": res.scheduled_mask,
-                    "preempted_mask": res.preempted_mask,
-                    "fair_share": res.fair_share,
-                    "demand_capped_fair_share": res.demand_capped_fair_share,
-                    "uncapped_fair_share": res.uncapped_fair_share,
-                    "spot_price": np.float64(
-                        np.nan if res.spot_price is None else res.spot_price
-                    ),
-                    "num_loops": int(res.num_loops),
-                },
-                solver={"backend": "oracle"},
-                truncated=bool(res.truncated),
-                solve_s=round(_t.monotonic() - t_solve, 4),
-            )
-        self._emit_solve_spans(snap.pool, None, _t.monotonic() - t_solve)
-        return {
+        result = {
             "spot_price": res.spot_price,
             "assigned_node": res.assigned_node,
             "scheduled_priority": res.scheduled_priority,
@@ -2065,11 +2086,110 @@ class SchedulerService:
             "preempted_mask": res.preempted_mask,
             "fair_share": res.fair_share,
             "demand_capped_fair_share": res.demand_capped_fair_share,
+            "uncapped_fair_share": res.uncapped_fair_share,
+            "fairness": None,
             "unschedulable_reason": res.unschedulable_reason,
             "termination_reason": res.termination_reason,
             "truncated": res.truncated,
             "num_loops": res.num_loops,
         }
+        if self.trace_recorder is not None:
+            # Oracle-backed services record too: the bundle's DeviceRound
+            # is the same device prep the kernel would see, so a trace
+            # captured here replays any candidate kernel against the
+            # oracle's decisions (spot price + loop accounting are
+            # oracle-specific and skipped by the replay compare). The
+            # fairness block is computed from that same DeviceRound so a
+            # replay recomputation compares against identical units.
+            import numpy as np
+
+            from ..solver.kernel_prep import pad_device_round, prep_device_round
+
+            dev = pad_device_round(prep_device_round(snap))
+            decisions = {
+                "assigned_node": res.assigned_node,
+                "scheduled_priority": res.scheduled_priority,
+                "scheduled_mask": res.scheduled_mask,
+                "preempted_mask": res.preempted_mask,
+                "fair_share": res.fair_share,
+                "demand_capped_fair_share": res.demand_capped_fair_share,
+                "uncapped_fair_share": res.uncapped_fair_share,
+                "spot_price": np.float64(
+                    np.nan if res.spot_price is None else res.spot_price
+                ),
+                "num_loops": int(res.num_loops),
+            }
+            if fairness:
+                try:
+                    from ..observe.fairness import ledger_from_device_round
+
+                    result["fairness"] = ledger_from_device_round(
+                        dev, decisions, snap.num_jobs, snap.num_queues
+                    )
+                except Exception as e:  # noqa: BLE001 - advisory path
+                    self.log_.with_fields(pool=snap.pool).error(
+                        "fairness ledger failed: %r", e
+                    )
+            self._trace_round(
+                snap,
+                dev,
+                decisions,
+                solver={"backend": "oracle"},
+                truncated=bool(res.truncated),
+                solve_s=round(_t.monotonic() - t_solve, 4),
+                fairness=result["fairness"],
+            )
+        # Oracle rounds with no recorder (no DeviceRound in hand) leave
+        # result["fairness"] None: _record_round computes the host-unit
+        # ledger_from_snapshot fallback for the live surfaces.
+        self._emit_solve_spans(snap.pool, None, _t.monotonic() - t_solve)
+        return result
+
+    def _decorate_fairness(self, snap, fairness: dict) -> dict:
+        """Copy of the canonical (index-based) fairness block with names
+        attached for the live surfaces: queue/node/job ids, the
+        aggressor's gang identity, and the rendered preemption reason
+        that JobRunPreempted events and job timelines carry."""
+        from ..observe.fairness import MECHANISM_PHRASE, resolve_names
+
+        resolved = resolve_names(
+            fairness, queue_names=snap.queue_names, job_ids=snap.job_ids
+        )
+        preemptions = []
+        for p in resolved["preemptions"]:
+            # Indices resolve_names could not map (e.g. aggressor_queue
+            # -1 on a headroom vacation) normalize to "".
+            if not isinstance(p.get("queue"), str):
+                p["queue"] = ""
+            if not isinstance(p.get("aggressor_queue"), str):
+                p["aggressor_queue"] = ""
+            p.setdefault("job_id", "")
+            node = int(p.get("node", -1))
+            p["node_id"] = (
+                snap.node_ids[node] if 0 <= node < len(snap.node_ids) else ""
+            )
+            agg = int(p.get("aggressor_job", -1))
+            p["aggressor_job_id"] = (
+                snap.job_ids[agg] if 0 <= agg < len(snap.job_ids) else ""
+            )
+            p["aggressor_gang"] = (
+                snap.job_gang_id[agg]
+                if 0 <= agg < len(snap.job_gang_id)
+                else ""
+            )
+            phrase = MECHANISM_PHRASE.get(p.get("mechanism", ""), "")
+            if p["aggressor_queue"]:
+                who = f"queue {p['aggressor_queue']}"
+                if p["aggressor_gang"]:
+                    who += f" gang {p['aggressor_gang']}"
+                p["reason"] = f"preempted by {who} {phrase}".strip()
+            else:
+                p["reason"] = (
+                    f"preempted by scheduler round {phrase} "
+                    "(node vacated for headroom)"
+                ).strip()
+            preemptions.append(p)
+        return {"ledger": resolved["ledger"], "preemptions": preemptions}
 
     def _record_round(self, pool, snap, result, started, indicative=None,
                       idealised=None, realised=None, now=None):
@@ -2079,6 +2199,23 @@ class SchedulerService:
         from .reports import QueueReport, RoundReport
 
         finished = _time.time()
+        fairness = result.get("fairness")
+        if fairness is None:
+            # Defensive fallback (a ledger failure inside _solve): the
+            # live surfaces still get a host-unit ledger.
+            try:
+                from ..observe.fairness import ledger_from_snapshot
+
+                fairness = ledger_from_snapshot(snap, result)
+            except Exception as e:  # noqa: BLE001 - advisory path
+                self.log_.with_fields(pool=pool).error(
+                    "fairness ledger fallback failed: %r", e
+                )
+        decorated = (
+            self._decorate_fairness(snap, fairness) if fairness else None
+        )
+        result["fairness_decorated"] = decorated
+        fair_rows = (decorated or {}).get("ledger", {}).get("queues", [])
         mult = snap.drf_multipliers()
         total = snap.total_resources.astype(float)
         report = RoundReport(
@@ -2106,11 +2243,17 @@ class SchedulerService:
                 alloc_by_q[q] += snap.job_req[j]
         actual = unweighted_cost(alloc_by_q, total, mult) if snap.num_queues else []
         for q, name in enumerate(snap.queue_names):
+            fr = fair_rows[q] if q < len(fair_rows) else {}
             report.queues[name] = QueueReport(
                 queue=name,
                 fair_share=float(result["fair_share"][q]),
                 adjusted_fair_share=float(result["demand_capped_fair_share"][q]),
                 actual_share=float(actual[q]),
+                uncapped_fair_share=float(fr.get("uncapped", 0.0)),
+                demand_share=float(fr.get("demand_share", 0.0)),
+                delivered_share=float(fr.get("delivered_share", 0.0)),
+                fairness_regret=float(fr.get("regret", 0.0)),
+                starved=bool(fr.get("starved", False)),
                 scheduled_jobs=sched_by_q.get(q, 0),
                 preempted_jobs=preempt_by_q.get(q, 0),
                 idealised_value=float((idealised or {}).get(name, 0.0)),
@@ -2194,6 +2337,19 @@ class SchedulerService:
                 f"priority={int(result['scheduled_priority'][int(j)])}"
             )
         self.reports.record(report)
+
+        if decorated is not None:
+            # Fairness observatory: starvation streaks + multiwindow
+            # alert, the scheduler_fairness_* families, attribution
+            # counters, and the /api/fairness document — all on the
+            # cycle clock (virtual in sims).
+            self.fairness.observe_round(
+                pool,
+                decorated,
+                now=now if now is not None else finished,
+                metrics=self.metrics,
+                slo=self.slo,
+            )
 
         if self.metrics is not None and self.metrics.registry is not None:
             self.metrics.solve_time.labels(pool=pool).observe(finished - started)

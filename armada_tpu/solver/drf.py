@@ -71,7 +71,13 @@ def update_fair_shares(
 
         for i in order:
             total_incl = total_weight + (weights[i] if achieved[i] else 0.0)
-            uncapped[i] += (weights[i] / total_incl) * (unallocated - spare[i])
+            # Guard the 0/0 of an unachieved zero-weight queue once every
+            # weighted queue has achieved (total_weight == 0): its share
+            # is 0, not NaN — same guard as the jitted kernel form.
+            if total_incl > 0.0:
+                uncapped[i] += (
+                    (weights[i] / total_incl) * (unallocated - spare[i])
+                )
 
         if total_weight <= 0.0:
             break

@@ -160,10 +160,14 @@ class TraceRecorder:
                 # bundle carry cost accounting in their profile blocks —
                 # `transfer` (bytes up/down, donated buffers) and
                 # `compiles` (trace/compile deltas) — so replay can diff
-                # COST against the recording, not just decisions. Older
-                # bundles simply lack the key (readers default absent).
+                # COST against the recording, not just decisions; plus
+                # the fairness ledger + preemption attribution per round
+                # (`fairness` blocks — a replay recomputation mismatch
+                # is the fairness_ledger divergence kind). Older bundles
+                # simply lack the keys (readers default absent).
                 "observatory": {"transfer_ledger": True,
-                                "compile_telemetry": True},
+                                "compile_telemetry": True,
+                                "fairness_ledger": True},
             },
             metrics=metrics,
         )
@@ -194,6 +198,7 @@ class TraceRecorder:
         profile: dict | None = None,
         solve_s: float | None = None,
         ids: dict | None = None,
+        fairness: dict | None = None,
         metrics=None,
     ) -> bool:
         """Append one round. `dev` is the padded DeviceRound exactly as
@@ -225,6 +230,13 @@ class TraceRecorder:
                 if k in decisions
             },
             "ids": dict(ids) if (ids and record_ids) else None,
+            # Fairness observatory (observe/fairness.py): the canonical
+            # index-based per-round share ledger + preemption
+            # attribution. Plain JSON (doubles round-trip exactly), so
+            # a replay's recomputation from this record's own dev +
+            # decisions compares bit-for-bit (the fairness_ledger
+            # divergence kind).
+            "fairness": dict(fairness) if fairness else None,
         }
         self._write(record, metrics=metrics, pool=pool)
         self.rounds_recorded += 1

@@ -17,6 +17,13 @@ classify as:
   loop_stream        — decisions identical but the pass-1 loop count
                        differs (the solver took a different path to the
                        same answer; kernel-recorded rounds only)
+  fairness_ledger    — the fairness block (per-queue share ledger +
+                       preemption attribution, observe/fairness.py)
+                       recomputed from the round's own DeviceRound and
+                       the REPLAYED decisions differs from the recorded
+                       block: the replay delivered different shares or
+                       attributed preemptions differently (rounds from
+                       pre-fairness bundles simply lack the block)
   profile_regression — replayed solve wall clock beyond
                        `profile_threshold` x the recorded solve time
                        (opt-in: wall clocks only compare on one host)
@@ -216,6 +223,49 @@ def _first_diffs(a, b, limit=4):
     return [int(i) for i in idx]
 
 
+def compare_fairness(rec: RoundRecord, dev, out: dict):
+    """`fairness_ledger` divergence: recompute the canonical fairness
+    block from the recorded DeviceRound + the REPLAYED output and diff
+    it against the recorded block (both normalized through JSON — the
+    recorded one crossed it, and doubles round-trip exactly). Returns a
+    divergence dict or None; rounds without a recorded block (pre-
+    fairness bundles) always pass."""
+    import json
+
+    recorded = rec.raw.get("fairness")
+    if not recorded:
+        return None
+    from ..observe.fairness import ledger_from_device_round
+
+    recomputed = ledger_from_device_round(
+        dev, out, rec.num_jobs, rec.num_queues
+    )
+    want = json.loads(json.dumps(recorded, sort_keys=True))
+    got = json.loads(json.dumps(recomputed, sort_keys=True))
+    if want == got:
+        return None
+    details = []
+    w_rows = (want.get("ledger") or {}).get("queues", [])
+    g_rows = (got.get("ledger") or {}).get("queues", [])
+    for q, (a, b) in enumerate(zip(w_rows, g_rows)):
+        if a != b:
+            fields = sorted(k for k in a.keys() | b.keys() if a.get(k) != b.get(k))
+            details.append(f"queue[{q}] differs on {fields}")
+            break
+    if want.get("preemptions") != got.get("preemptions"):
+        details.append("preemption attribution differs")
+    for key in ("jain", "max_regret", "delivered_total"):
+        if (want.get("ledger") or {}).get(key) != (got.get("ledger") or {}).get(key):
+            details.append(f"{key} differs")
+            break
+    return {
+        "kind": "fairness_ledger",
+        "key": "fairness",
+        "detail": "replayed fairness ledger diverges from the recorded "
+        "block: " + ("; ".join(details) or "structural mismatch"),
+    }
+
+
 def _shape_signature(dev) -> tuple:
     """The (treedef, shapes, dtypes) signature that determines which
     compiled programs a DeviceRound dispatches to. Two rounds with the
@@ -351,6 +401,9 @@ def replay_trace(
             out = solve(dev)
             replay_s = time.monotonic() - t0
             divergences = compare_round(rec, out)
+            fairness_div = compare_fairness(rec, dev, out)
+            if fairness_div is not None:
+                divergences.append(fairness_div)
             if telemetry_live:
                 delta = TELEMETRY.delta_since(comp0, thread=True)
                 seen_shapes[label].add(sig)

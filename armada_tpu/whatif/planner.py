@@ -84,6 +84,11 @@ class Plan:
     injected: list = field(default_factory=list)
     headroom: dict = field(default_factory=dict)
     drain: dict | None = None
+    # Fairness delta (armada_tpu/observe/fairness.py): per-queue
+    # delivered-share/regret movement between the live round's ledger
+    # and the rollout's settled ledger — which queues PAY for the plan
+    # (drain/inject) and which gain.
+    fairness_delta: dict = field(default_factory=dict)
     plan_seconds: float = 0.0
 
     def to_dict(self) -> dict:
@@ -99,6 +104,7 @@ class Plan:
             "injected": list(self.injected),
             "headroom": dict(self.headroom),
             "drain": dict(self.drain) if self.drain is not None else None,
+            "fairness_delta": dict(self.fairness_delta),
             "plan_seconds": self.plan_seconds,
         }
 
@@ -152,6 +158,24 @@ class Plan:
                 f"{k}={v}" for k, v in sorted(pool_room.get("free", {}).items())
             )
             lines.append(f"headroom: {free}")
+        delta_queues = self.fairness_delta.get("queues") or {}
+        movers = sorted(
+            (
+                (name, d)
+                for name, d in delta_queues.items()
+                if abs(d.get("delta_delivered", 0.0)) > 1e-9
+            ),
+            key=lambda kv: kv[1].get("delta_delivered", 0.0),
+        )
+        if movers:
+            lines.append("fairness delta (who pays):")
+            for name, d in movers[:8]:
+                lines.append(
+                    f"  queue {name}: delivered "
+                    f"{d.get('baseline_delivered', 0.0):.4f} -> "
+                    f"{d.get('planned_delivered', 0.0):.4f} "
+                    f"({d.get('delta_delivered', 0.0):+.4f})"
+                )
         return "\n".join(lines)
 
 
@@ -587,6 +611,7 @@ class WhatIfService:
         if rollout.drains:
             # One drain per plan today; report the first controller.
             drain_doc = rollout.drains[0].outcome().to_dict()
+        fairness_delta = self._fairness_delta(fork, rollout)
         plan = Plan(
             kind=kind,
             pool=fork.pool,
@@ -599,10 +624,60 @@ class WhatIfService:
             injected=injected_out,
             headroom=rollout.headroom(),
             drain=drain_doc,
+            fairness_delta=fairness_delta,
             plan_seconds=round(_time.monotonic() - t0, 4),
         )
         self.recent.appendleft(plan.to_dict())
         return plan
+
+    def _fairness_delta(self, fork: RoundFork, rollout: ForkRollout) -> dict:
+        """Which queues pay: the live round's fairness ledger (the
+        scheduler's tracker) vs the rollout's settled ledger (the
+        rollout scheduler runs the same fairness observatory). Either
+        side missing (no round yet / idle rollout) reports {}."""
+        base_doc = None
+        tracker = getattr(self.scheduler, "fairness", None)
+        if tracker is not None:
+            base_doc = tracker.latest(fork.pool)
+        roll_tracker = getattr(rollout.scheduler, "fairness", None)
+        plan_doc = roll_tracker.latest(fork.pool) if roll_tracker else None
+        if not base_doc or not plan_doc:
+            return {}
+
+        def rows(doc):
+            return {
+                str(r["queue"]): r
+                for r in (doc.get("ledger") or {}).get("queues", ())
+            }
+
+        base_rows, plan_rows = rows(base_doc), rows(plan_doc)
+        queues = {}
+        for name in sorted(base_rows.keys() | plan_rows.keys()):
+            b = base_rows.get(name, {})
+            p = plan_rows.get(name, {})
+            b_del = float(b.get("delivered_share", 0.0))
+            p_del = float(p.get("delivered_share", 0.0))
+            queues[name] = {
+                "baseline_delivered": b_del,
+                "planned_delivered": p_del,
+                "delta_delivered": p_del - b_del,
+                "baseline_regret": float(b.get("regret", 0.0)),
+                "planned_regret": float(p.get("regret", 0.0)),
+            }
+        payers = sorted(
+            (n for n, d in queues.items() if d["delta_delivered"] < -1e-9),
+            key=lambda n: queues[n]["delta_delivered"],
+        )
+        return {
+            "baseline_jain": float(
+                (base_doc.get("ledger") or {}).get("jain", 1.0)
+            ),
+            "planned_jain": float(
+                (plan_doc.get("ledger") or {}).get("jain", 1.0)
+            ),
+            "queues": queues,
+            "payers": payers,
+        }
 
     def _injection_feasibility(self, state: ForkState) -> dict:
         """Static could-this-EVER-fit verdicts for injected jobs, through

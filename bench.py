@@ -405,6 +405,32 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
         warm, out = warm_cycle(out)
         samples.append(warm)
 
+    # Fairness observatory (armada_tpu/observe/fairness.py): the last
+    # measured cycle's share ledger — Jain index + max regret land in
+    # extra.fairness so tools/bench_trend.py tracks fairness alongside
+    # speed. Computed OUTSIDE the measured window and outside any
+    # transfer ledger (the O(J) result readback must not book into
+    # extra.transfer or the cycle time).
+    fairness_extra = {}
+    try:
+        from armada_tpu.observe.fairness import ledger_from_device_round
+
+        snap_f = inc.snapshot()
+        block = ledger_from_device_round(
+            pad_device_round(inc.device_round()),
+            {k: np.asarray(v) for k, v in out.items()
+             if k not in ("profile", "truncated")},
+            snap_f.num_jobs,
+            snap_f.num_queues,
+        )
+        fairness_extra["fairness"] = {
+            "jain": block["ledger"]["jain"],
+            "max_regret": block["ledger"]["max_regret"],
+            "preemptions_attributed": len(block["preemptions"]),
+        }
+    except Exception as e:  # noqa: BLE001 - advisory, never fails the bench
+        fairness_extra["fairness"] = {"error": f"{e.__class__.__name__}: {e}"}
+
     import statistics
 
     times = sorted(s["cycle_s"] for s in samples)
@@ -479,6 +505,7 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
         **mesh_extra,
         **trace_extra,
         **params_extra,
+        **fairness_extra,
         "cycle_s": round(median, 4),
         **{k: v for k, v in rep.items() if k != "cycle_s"},
         "warm_cycles_measured": len(times),

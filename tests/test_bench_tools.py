@@ -206,7 +206,7 @@ def test_trend_tolerates_and_shows_whatif_block(tmp_path):
     assert "whatif" in proc.stdout
     lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
     assert "3@0.42s" in lines["BENCH_r02.json"]
-    assert lines["BENCH_r03.json"].split()[-3] == "yes"  # whatif column
+    assert lines["BENCH_r03.json"].split()[-4] == "yes"  # whatif column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_whatif))["warm"] == 3.0
 
@@ -243,9 +243,41 @@ def test_trend_tolerates_and_shows_frontdoor_block(tmp_path):
     assert lines["BENCH_r01.json"].rstrip().endswith("-")
     assert "17ms/13" in lines["BENCH_r02.json"]
     assert "300ms/5000!" in lines["BENCH_r03.json"]
-    assert lines["BENCH_r04.json"].split()[-2] == "yes"  # frontdoor column
+    assert lines["BENCH_r04.json"].split()[-3] == "yes"  # frontdoor column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_fd))["warm"] == 3.0
+
+
+def test_trend_tolerates_and_shows_fairness_block(tmp_path):
+    """Artifacts carrying extra.fairness (the fairness observatory's
+    headline Jain index + max regret) render a fairness column
+    (jJAIN/rREGRET); pre-fairness artifacts print '-' and the gate
+    ignores the block entirely."""
+    with_fair = json.loads(json.dumps(NEW_SCHEMA))
+    with_fair["parsed"]["extra"]["fairness"] = {
+        "jain": 0.9876, "max_regret": 0.125, "preemptions_attributed": 2,
+    }
+    bare = json.loads(json.dumps(NEW_SCHEMA))
+    bare["parsed"]["extra"]["fairness"] = {"error": "boom"}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(OLD_SCHEMA))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(with_fair))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(bare))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "bench_trend.py"),
+            "--dir", str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fairness" in proc.stdout
+    lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
+    assert lines["BENCH_r01.json"].rstrip().endswith("-")
+    assert "j0.988/r0.125" in lines["BENCH_r02.json"]
+    assert lines["BENCH_r03.json"].split()[-1] == "yes"  # fairness column
+    # The gate's metric extraction is unaffected by the extra block.
+    assert extract_metrics(parse_artifact(with_fair))["warm"] == 3.0
 
 
 def test_gate_transfer_ledger_and_compiles(tmp_path):

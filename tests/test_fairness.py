@@ -1,0 +1,694 @@
+"""Fairness observatory (armada_tpu/observe/fairness.py).
+
+The per-round share ledger must be internally consistent (delivered
+shares sum to the pool allocation) with entitlements bit-exact against
+the solver/drf.py water-filling oracle; every round preemption must
+carry exactly one attributed aggressor (and its attribution must reach
+the job timeline — no preemption from any producer may land as
+"unknown"); the starvation detector must fire for a weight-starved
+queue and stay silent in a balanced control run; the offline
+tools/fairness_report.py scorecard over the recorded `.atrace` of the
+same sim must equal the live one; a tampered recorded fairness block
+must trip the replayer's `fairness_ledger` divergence; and the drf.py
+numpy water-filling must bit-match the kernel's jitted fixed-point on
+its edge cases (zero-weight queue, all-demand-below-entitlement,
+10-iteration cap, zero-total pool).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.observe.fairness import (
+    FairnessTracker,
+    aggregate_scorecard,
+    jain_index,
+)
+from armada_tpu.solver import drf
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+CONTENTION_CFG = dict(
+    priority_classes={
+        "low": PriorityClass("low", 1000, preemptible=True),
+        "pinned": PriorityClass("pinned", 30000, preemptible=False),
+    },
+    default_priority_class="low",
+    protected_fraction_of_fair_share=0.5,
+)
+
+
+def contention_sim(*, backend="kernel", trace_path=None, starved=False,
+                   max_time=300.0):
+    """Deterministic 3-queue contention sim on a 2-node fleet: qa fills
+    the pool first, qb contends from t=30 (forcing DRF rebalance
+    preemptions), qc either competes at equal weight (balanced control)
+    or at a tiny weight behind non-preemptible hogs (starved=True)."""
+    from armada_tpu.sim.simulator import (
+        ClusterSpec,
+        JobTemplate,
+        NodeTemplate,
+        QueueSpecSim,
+        ShiftedExponential,
+        Simulator,
+        WorkloadSpec,
+    )
+
+    long = ShiftedExponential(minimum=500.0)
+    hog_class = "pinned" if starved else "low"
+    queues = (
+        QueueSpecSim(
+            name="qa",
+            job_templates=(
+                JobTemplate(id="a", number=4, cpu="4",
+                            priority_class=hog_class, runtime=long),
+            ),
+        ),
+        QueueSpecSim(
+            name="qb",
+            job_templates=(
+                JobTemplate(id="b", number=4, cpu="4", submit_time=30.0,
+                            priority_class=hog_class, runtime=long),
+            ),
+        ),
+        QueueSpecSim(
+            name="qc",
+            # weight = 1/priority_factor: 20.0 → weight 0.05, the
+            # weight-starved victim.
+            priority_factor=20.0 if starved else 1.0,
+            job_templates=(
+                JobTemplate(id="c", number=4, cpu="4", submit_time=60.0,
+                            runtime=long),
+            ),
+        ),
+    )
+    return Simulator(
+        [ClusterSpec(name="c", node_templates=(NodeTemplate(count=2, cpu="8"),))],
+        WorkloadSpec(queues=queues),
+        config=SchedulingConfig(**CONTENTION_CFG),
+        backend=backend,
+        cycle_interval=10.0,
+        max_time=max_time,
+        trace_path=trace_path,
+    )
+
+
+def tap_fairness(sim):
+    """Collect every decorated fairness block the scheduler feeds the
+    tracker, in round order."""
+    blocks = []
+    orig = sim.scheduler.fairness.observe_round
+
+    def tap(pool, fairness, **kw):
+        blocks.append(
+            {
+                "ledger": json.loads(json.dumps(fairness["ledger"])),
+                "preemptions": json.loads(
+                    json.dumps(fairness["preemptions"])
+                ),
+            }
+        )
+        return orig(pool, fairness, **kw)
+
+    sim.scheduler.fairness.observe_round = tap
+    return blocks
+
+
+# ---------------------------------------------------------------------------
+# drf.py water-filling edge cases vs the kernel's jitted fixed-point
+# (satellite: bit-parity numpy vs JAX).
+# ---------------------------------------------------------------------------
+
+
+def _jax_fair_shares(weights, demand_costs, total_is_zero):
+    import jax.numpy as jnp
+
+    from armada_tpu.solver.kernel import _fair_shares
+
+    fs, capped, uncapped = _fair_shares(
+        jnp.asarray(np.asarray(weights, np.float64)),
+        jnp.asarray(np.asarray(demand_costs, np.float64)),
+        jnp.asarray(bool(total_is_zero)),
+    )
+    return np.asarray(fs), np.asarray(capped), np.asarray(uncapped)
+
+
+def _assert_waterfill_parity(weights, demand_costs, total_is_zero=False,
+                             uncapped_ulp=0):
+    """fair_share and capped must ALWAYS be bitwise identical (they are
+    recorded decision keys the replay gate pins). uncapped accumulates
+    `unc + share*(unallocated - spare)` once per iteration, which XLA
+    legally contracts into an FMA — on ladders deep enough to run many
+    iterations the jitted result can sit 1 ULP off any pure-numpy
+    evaluation, so those cases pass `uncapped_ulp` (production parity
+    of the recorded uncapped stream is still asserted bit-exact by the
+    kernel-parity and replay suites on real rounds)."""
+    names = [f"q{i:02d}" for i in range(len(weights))]
+    want = drf.update_fair_shares(
+        names, np.asarray(weights, np.float64),
+        np.asarray(demand_costs, np.float64), total_is_zero,
+    )
+    got = _jax_fair_shares(weights, demand_costs, total_is_zero)
+    for name, w, g in zip(("fair_share", "capped", "uncapped"), want, got):
+        if name == "uncapped" and uncapped_ulp:
+            tol = uncapped_ulp * np.spacing(
+                np.maximum(np.abs(w), np.abs(g))
+            )
+            assert np.all(np.abs(w - g) <= tol), (
+                f"uncapped beyond {uncapped_ulp} ULP: numpy {w} != jax {g}"
+            )
+            continue
+        assert np.array_equal(w, g), (
+            f"{name}: numpy {w} != jax {g} for weights={weights} "
+            f"demand={demand_costs} total_is_zero={total_is_zero}"
+        )
+
+
+def test_waterfill_zero_weight_queue():
+    # A zero-weight queue holds no entitlement and releases nothing.
+    _assert_waterfill_parity([1.0, 0.0, 2.0], [0.5, 0.5, 0.5])
+    _assert_waterfill_parity([1.0, 0.0, 2.0], [0.1, 0.9, 0.05])
+
+
+def test_waterfill_all_demand_below_entitlement():
+    # Everyone achieves in iteration 1; the loop must terminate on
+    # total_weight == 0 with capped == demand for every queue.
+    weights = [1.0, 1.0, 1.0, 1.0]
+    demand = [0.01, 0.02, 0.03, 0.04]
+    _assert_waterfill_parity(weights, demand)
+    names = [f"q{i:02d}" for i in range(4)]
+    _, capped, _ = drf.update_fair_shares(
+        names, np.asarray(weights), np.asarray(demand), False
+    )
+    assert np.array_equal(capped, np.asarray(demand))
+
+
+def test_waterfill_iteration_cap_hit(monkeypatch):
+    """A demand ladder that still has >1% unallocated after 10
+    iterations: the numpy loop and the jitted while_loop must cut at
+    the same iteration and agree bitwise.
+
+    Construction: strongly dominant power-of-4 weights (weight sums are
+    sums of distinct powers of two — exact in any accumulation order,
+    so numpy's name-ordered loop and the vectorized kernel cannot
+    drift) with demands chosen so exactly ONE queue achieves per
+    iteration, releasing ~3/4 of the remaining pool each time:
+    unallocated decays ~0.75^k and is still > 0.01 at iteration 10."""
+    Q = 12
+    weights = 4.0 ** np.arange(Q - 1, -1, -1)
+    names = [f"q{i:02d}" for i in range(Q)]
+    demand = np.full(Q, 2.0)
+    capped = np.zeros(Q)
+    achieved = np.zeros(Q, bool)
+    unalloc = 1.0
+    for it in range(Q):
+        tw = weights[~achieved].sum()
+        inc = np.where(achieved, 0.0, (weights / tw) * unalloc)
+        capped = capped + inc
+        # Queue `it` (the dominant unachieved one) achieves exactly at
+        # this iteration: demand just above its PREVIOUS capped value.
+        demand[it] = capped[it] - inc[it] + 1e-6
+        spare = capped[it] - demand[it]
+        capped[it] = demand[it]
+        achieved[it] = True
+        unalloc = spare
+    # Prove the 10-iteration cap binds: one extra iteration changes the
+    # answer (i.e. the loop exited on the cap, not on convergence).
+    _, capped10, _ = drf.update_fair_shares(names, weights, demand, False)
+    monkeypatch.setattr(drf, "MAX_ITERATIONS", 11)
+    _, capped11, _ = drf.update_fair_shares(names, weights, demand, False)
+    monkeypatch.setattr(drf, "MAX_ITERATIONS", 10)
+    assert not np.array_equal(capped10, capped11), (
+        "ladder did not hit the 10-iteration cap"
+    )
+    _assert_waterfill_parity(weights, demand, uncapped_ulp=4)
+
+
+def test_waterfill_zero_total_pool():
+    # Zero-resource pool: every demand share reads 1.0
+    # (scheduling.go:257-259) — nobody achieves, shares stay pure
+    # weight ratios.
+    _assert_waterfill_parity([1.0, 3.0], [0.0, 0.0], total_is_zero=True)
+    names = ["a", "b"]
+    fs, capped, _ = drf.update_fair_shares(
+        names, np.asarray([1.0, 3.0]), np.asarray([0.0, 0.0]), True
+    )
+    assert np.allclose(capped, fs)
+
+
+def test_jain_index_bounds():
+    assert jain_index([]) == 1.0
+    assert jain_index([0.5, 0.5, 0.5]) == pytest.approx(1.0)
+    lopsided = jain_index([1.0, 0.0, 0.0])
+    assert lopsided == pytest.approx(1.0 / 3.0)
+
+
+# ---------------------------------------------------------------------------
+# The deterministic 3-queue contention sim: ledger consistency,
+# oracle-exact entitlements, one aggressor per preemption, offline
+# identity (the acceptance scenario).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def contention_run(tmp_path_factory):
+    trace_path = str(
+        tmp_path_factory.mktemp("fairness") / "contention.atrace"
+    )
+    sim = contention_sim(backend="kernel", trace_path=trace_path)
+    blocks = tap_fairness(sim)
+    result = sim.run()
+    return sim, result, blocks, trace_path
+
+
+def test_ledger_consistency_and_oracle_entitlement(contention_run):
+    from armada_tpu.trace import load_trace
+
+    sim, result, blocks, trace_path = contention_run
+    assert result.preemptions > 0, "contention sim produced no preemptions"
+    trace = load_trace(trace_path)
+    rounds_with_preemptions = 0
+    for rec in trace.rounds:
+        dev = rec.device_round()
+        decisions = rec.decisions()
+        block = rec.raw["fairness"]
+        J, Q = rec.num_jobs, rec.num_queues
+        ledger, preempts = block["ledger"], block["preemptions"]
+        # Delivered shares sum to the pool allocation: the per-queue
+        # delivered vectors add up to exactly the resources of every
+        # placed job.
+        jq = np.asarray(dev.job_queue)[:J]
+        placed = (np.asarray(decisions["assigned_node"])[:J] >= 0) & (jq >= 0)
+        want_total = (
+            np.asarray(dev.job_req, np.float64)[:J][placed].sum(axis=0)
+            if placed.any()
+            else np.zeros(dev.job_req.shape[1])
+        )
+        got_total = np.asarray(ledger["delivered_total"])
+        assert np.array_equal(want_total, got_total)
+        per_queue = np.asarray(
+            [row["delivered"] for row in ledger["queues"]]
+        ).sum(axis=0)
+        assert np.array_equal(per_queue, got_total)
+        # Entitlement matches the drf.py oracle bit-exactly: recompute
+        # the water-filling from the round's own constrained demand.
+        constrained = np.minimum(
+            np.asarray(dev.queue_demand_pc, np.float64),
+            np.asarray(dev.queue_pc_limit, np.float64),
+        ).sum(axis=1)
+        demand_costs = drf.unweighted_cost(
+            constrained, dev.total_resources, dev.drf_multipliers
+        )
+        names = (rec.raw.get("ids") or {}).get("queues") or [
+            f"q{i}" for i in range(Q)
+        ]
+        _, capped, uncapped = drf.update_fair_shares(
+            list(names),
+            np.asarray(dev.queue_weight)[:Q],
+            demand_costs[:Q],
+            bool((np.asarray(dev.total_resources) == 0).all()),
+        )
+        for q, row in enumerate(ledger["queues"]):
+            assert row["entitlement"] == capped[q]
+            assert row["uncapped"] == uncapped[q]
+        # Every preemption in the round has exactly one attributed
+        # aggressor.
+        victims = np.flatnonzero(
+            np.asarray(decisions["preempted_mask"], bool)[:J]
+        )
+        assert len(preempts) == len(victims)
+        assert sorted(p["job"] for p in preempts) == sorted(
+            int(v) for v in victims
+        )
+        for p in preempts:
+            assert p["mechanism"] in ("fairness", "urgency")
+            assert p["aggressor_queue"] >= 0 or p["aggressor_job"] >= 0
+        rounds_with_preemptions += bool(len(preempts))
+    assert rounds_with_preemptions > 0
+
+
+def test_offline_scorecard_matches_live_sim(contention_run, capsys):
+    """The acceptance identity: tools/fairness_report.py over the
+    recorded .atrace computes the exact scorecard the live run served
+    (same doubles — both sides are the canonical ledger, decorated with
+    the same queue-name vocabulary)."""
+    import fairness_report
+
+    sim, _result, blocks, trace_path = contention_run
+    live = aggregate_scorecard(blocks)
+    rc = fairness_report.main(["--json", trace_path])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip())
+    offline = doc["scorecard"]
+    live = json.loads(json.dumps(live))
+    assert offline == live
+    # And the rendered form mentions every queue.
+    rc = fairness_report.main([trace_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for q in ("qa", "qb", "qc"):
+        assert q in out
+
+
+def test_preemptions_attributed_in_events_and_timeline(contention_run):
+    """Round preemption events carry their aggressor attribution into
+    the job timeline ("preempted by queue B ... under DRF rebalance")."""
+    sim, result, _blocks, _path = contention_run
+    preempted_entries = []
+    for jid, j in sim.scheduler.timeline._jobs.items():
+        for _ts, kind, detail in j.entries:
+            if kind == "preempted":
+                preempted_entries.append((jid, detail))
+    assert preempted_entries
+    for jid, detail in preempted_entries:
+        assert detail and detail != "unknown", (jid, detail)
+        assert "preempted by queue " in detail or "scheduler round" in detail
+        assert "under " in detail
+
+
+def test_starvation_alert_fires_for_weight_starved_queue():
+    sim = contention_sim(backend="oracle", starved=True, max_time=250.0)
+    sim.run()
+    tracker = sim.scheduler.fairness
+    snap = tracker.snapshot()
+    alert_queues = {a["queue"] for a in snap["alerts"]}
+    assert "qc" in alert_queues, snap["alerts"]
+    doc = snap["pools"]["default"]
+    rows = {r["queue"]: r for r in doc["ledger"]["queues"]}
+    assert rows["qc"]["starved"]
+    assert rows["qc"]["starved_rounds"] >= tracker.k_rounds
+    assert rows["qc"]["regret"] > 0
+    # The triple separates starved from capped-by-demand: qc's demand
+    # exceeds what it was delivered.
+    assert rows["qc"]["demand_share"] > rows["qc"]["delivered_share"]
+
+
+def test_starvation_silent_in_balanced_control():
+    """The control run: the same 3 queues with demand that fits their
+    entitlements — every queue is delivered its share, no starvation
+    streak ever arms, the alert stays silent."""
+    from armada_tpu.sim.simulator import (
+        ClusterSpec,
+        JobTemplate,
+        NodeTemplate,
+        QueueSpecSim,
+        ShiftedExponential,
+        Simulator,
+        WorkloadSpec,
+    )
+
+    sim = Simulator(
+        [ClusterSpec(name="c", node_templates=(NodeTemplate(count=2, cpu="8"),))],
+        WorkloadSpec(
+            queues=tuple(
+                QueuesSpec
+                for QueuesSpec in (
+                    QueueSpecSim(
+                        name=q,
+                        job_templates=(
+                            JobTemplate(
+                                id="j", number=1, cpu="4",
+                                submit_time=float(i * 10),
+                                runtime=ShiftedExponential(minimum=400.0),
+                            ),
+                        ),
+                    )
+                    for i, q in enumerate(("qa", "qb", "qc"))
+                )
+            )
+        ),
+        config=SchedulingConfig(**CONTENTION_CFG),
+        backend="oracle",
+        cycle_interval=10.0,
+        max_time=200.0,
+    )
+    sim.run()
+    snap = sim.scheduler.fairness.snapshot()
+    assert snap["alerts"] == []
+    doc = snap["pools"]["default"]
+    for row in doc["ledger"]["queues"]:
+        assert not row.get("alerting"), row
+        assert row["regret"] == pytest.approx(0.0, abs=1e-9), row
+
+
+def test_fair_share_triple_metrics_exported():
+    """Satellite: uncapped entitlement + demand share export alongside
+    the existing demand-capped scheduler_queue_fair_share."""
+    from armada_tpu.services.metrics import (
+        HAVE_PROMETHEUS,
+        SchedulerMetrics,
+    )
+
+    if not HAVE_PROMETHEUS:
+        pytest.skip("prometheus_client unavailable")
+    sim = contention_sim(backend="oracle", max_time=150.0)
+    m = SchedulerMetrics()
+    sim.scheduler.attach_metrics(m)
+    sim.run()
+    body = m.render().decode()
+    doc = sim.scheduler.fairness.latest("default")
+    rows = {r["queue"]: r for r in doc["ledger"]["queues"]}
+    for family in (
+        "scheduler_queue_fair_share_uncapped",
+        "scheduler_queue_demand_share",
+        "scheduler_fairness_regret",
+        "scheduler_fairness_starved_rounds",
+    ):
+        for q in rows:
+            assert f'{family}{{pool="default",queue="{q}"}}' in body, family
+    assert 'scheduler_fairness_jain{pool="default"}' in body
+    # The gauge values mirror the tracker's latest ledger.
+    for line in body.splitlines():
+        if line.startswith('scheduler_queue_demand_share{pool="default"'):
+            q = line.split('queue="')[1].split('"')[0]
+            assert float(line.rsplit(" ", 1)[1]) == pytest.approx(
+                rows[q]["demand_share"]
+            )
+
+
+def test_replayer_trips_on_tampered_fairness_block(contention_run):
+    from armada_tpu.trace import load_trace, replay_trace
+
+    _sim, _result, _blocks, trace_path = contention_run
+    clean = replay_trace(load_trace(trace_path), solvers=("LOCAL",),
+                         flag_retraces=False)
+    assert clean["ok"], clean["divergences"]
+    tampered = load_trace(trace_path)
+    victim = next(r for r in tampered.rounds if r.raw.get("fairness"))
+    victim.raw["fairness"]["ledger"]["queues"][0]["delivered_share"] += 0.25
+    report = replay_trace(tampered, solvers=("LOCAL",), flag_retraces=False)
+    assert report["divergences"].get("fairness_ledger", 0) >= 1, report
+
+
+def test_no_unknown_preemption_reason_in_chaos_sim(tmp_path):
+    """Satellite: under chaos (executor crash mid-run) plus contention
+    preemptions plus a staged drain, NO JobRunPreempted from any
+    producer lands in the timeline without attribution."""
+    from armada_tpu.services.chaos import FaultPlan, FaultSpec
+
+    sim = contention_sim(backend="oracle", max_time=400.0)
+    sim.fault_plan = None  # the plan below rides the executors directly
+    plan = FaultPlan(
+        [FaultSpec("executor_crash", "c", start=110.0, duration=30.0)]
+    )
+    for ex in sim.executors:
+        ex.fault_plan = plan
+    # A staged drain mid-run exercises the drain-preemption producer.
+    drained = {"started": False}
+    orig_cycle = sim.scheduler.cycle
+
+    def cycle(now=None):
+        if not drained["started"] and (now or 0) >= 80.0:
+            drained["started"] = True
+            sim.scheduler.drains.start("c", deadline_s=20.0)
+        return orig_cycle(now=now)
+
+    sim.scheduler.cycle = cycle
+    result = sim.run()
+    assert result.preemptions > 0
+    preempted = []
+    for jid, j in sim.scheduler.timeline._jobs.items():
+        for _ts, kind, detail in j.entries:
+            if kind == "preempted":
+                preempted.append((jid, detail))
+    assert preempted
+    unknown = [(jid, d) for jid, d in preempted if not d or d == "unknown"]
+    assert not unknown, unknown
+
+
+def test_fairness_tracker_multiwindow_needs_both_conditions():
+    """Both conditions must gate independently: a short starved burst
+    under K rounds never alerts (fast fails); a fresh K-streak right
+    after healthy history stays silent too (slow fails: under half of
+    the 4K window is starved); only sustained starvation fires; and
+    recovery clears the alert state."""
+    tracker = FairnessTracker(k_rounds=3)
+    assert tracker.window == 12
+
+    def block(starved):
+        return {
+            "ledger": {
+                "queues": [
+                    {
+                        "queue": "q",
+                        "weight": 1.0,
+                        "fair_share": 0.5,
+                        "entitlement": 0.5,
+                        "uncapped": 0.5,
+                        "demand_share": 0.8,
+                        "delivered_share": 0.1 if starved else 0.5,
+                        "regret": 0.4 if starved else 0.0,
+                        "starved": starved,
+                        "delivered": [],
+                    }
+                ],
+                "jain": 1.0,
+                "max_regret": 0.4 if starved else 0.0,
+                "delivered_total": [],
+            },
+            "preemptions": [],
+        }
+
+    for i in range(2):  # 2 < K: fast condition fails, silent
+        doc = tracker.observe_round("p", block(True), now=float(i))
+    assert not doc["alerts"]
+    doc = tracker.observe_round("p", block(False), now=2.0)
+    assert doc["ledger"]["queues"][0]["starved_rounds"] == 0
+    for i in range(3):  # a fresh K-streak: fast passes...
+        doc = tracker.observe_round("p", block(True), now=3.0 + i)
+    # ...but only 5 of the 12-round window is starved: slow fails,
+    # still silent — the condition the vacuous 2K window could never
+    # exercise.
+    assert doc["ledger"]["queues"][0]["starved_rounds"] == 3
+    assert not doc["alerts"]
+    # Starvation sustains: once half the window's capacity is starved
+    # (6 of 12), the alert fires.
+    doc = tracker.observe_round("p", block(True), now=6.0)
+    assert doc["alerts"] and doc["alerts"][0]["queue"] == "q"
+    assert tracker.snapshot()["alerts"]
+    doc = tracker.observe_round("p", block(False), now=7.0)
+    assert not doc["alerts"]
+    assert not tracker.snapshot()["alerts"]
+
+
+def test_fairness_tracker_clears_state_for_vanished_queue():
+    """A queue that leaves the round (drained/deleted — the snapshot
+    only carries queues with jobs) stops starving by definition: its
+    alert and streak clear instead of paging forever."""
+    tracker = FairnessTracker(k_rounds=2)  # window 8: fires at 4 starved
+
+    def block(queues):
+        return {
+            "ledger": {
+                "queues": [
+                    {
+                        "queue": q,
+                        "weight": 1.0,
+                        "fair_share": 0.5,
+                        "entitlement": 0.5,
+                        "uncapped": 0.5,
+                        "demand_share": 0.8,
+                        "delivered_share": 0.1,
+                        "regret": 0.4,
+                        "starved": True,
+                        "delivered": [],
+                    }
+                    for q in queues
+                ],
+                "jain": 1.0,
+                "max_regret": 0.4,
+                "delivered_total": [],
+            },
+            "preemptions": [],
+        }
+
+    for i in range(4):
+        tracker.observe_round("p", block(["doomed"]), now=float(i))
+    assert tracker.snapshot()["alerts"]
+    # The queue disappears from the round entirely.
+    tracker.observe_round("p", block(["other"]), now=4.0)
+    alerts = tracker.snapshot()["alerts"]
+    assert all(a["queue"] != "doomed" for a in alerts), alerts
+
+
+def test_fairness_report_rpc_lookout_and_cli(capsys):
+    """FairnessReport over a real gRPC socket (raw client + `armadactl
+    fairness` rendering) and GET /api/fairness serve the tracker's
+    document; a pool with no rounds is NOT_FOUND."""
+    import urllib.request
+
+    import grpc
+
+    from armada_tpu.services.grpc_api import ApiClient, ApiServer
+    from armada_tpu.services.lookout_http import LookoutHttpServer
+
+    sim = contention_sim(backend="oracle", max_time=150.0)
+    sim.run()
+    api = ApiServer(sim.submit, sim.scheduler, None, sim.log)
+    server, port = api.serve(0)
+    try:
+        client = ApiClient(f"127.0.0.1:{port}")
+        doc = client.fairness_report()
+        assert "default" in doc["pools"]
+        rows = {
+            r["queue"]: r
+            for r in doc["pools"]["default"]["ledger"]["queues"]
+        }
+        assert set(rows) == {"qa", "qb", "qc"}
+        scoped = client.fairness_report(pool="default")
+        assert set(scoped["pools"]) == {"default"}
+        with pytest.raises(grpc.RpcError) as err:
+            client.fairness_report(pool="nope")
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+        from armada_tpu.clients.cli import main as cli_main
+
+        cli_main(["--server", f"127.0.0.1:{port}", "fairness"])
+        out = capsys.readouterr().out
+        assert "pool default" in out and "jain" in out
+        for q in ("qa", "qb", "qc"):
+            assert f"queue {q}" in out
+        cli_main(["--server", f"127.0.0.1:{port}", "fairness", "--json"])
+        parsed = json.loads(capsys.readouterr().out)
+        assert "default" in parsed["pools"]
+    finally:
+        server.stop(None)
+    http = LookoutHttpServer(None, sim.scheduler, None, port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http.port}/api/fairness"
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert "default" in doc["pools"]
+    finally:
+        http.stop()
+
+
+def test_whatif_plan_reports_fairness_delta():
+    """A drain plan reports which queues pay: Plan.fairness_delta
+    carries per-queue baseline vs planned delivered shares."""
+    from armada_tpu.whatif import WhatIfService
+
+    sim = contention_sim(backend="oracle", max_time=150.0)
+    wi = WhatIfService(sim.scheduler)
+    sim.whatif = wi
+    sim.scheduler.attach_whatif(wi)
+    sim.run()
+    plan = wi.plan_drain("c", rounds=3, deadline_s=0.0)
+    delta = plan.fairness_delta
+    assert delta, "plan carried no fairness delta"
+    assert set(delta["queues"]) >= {"qa", "qb"}
+    for row in delta["queues"].values():
+        assert {"baseline_delivered", "planned_delivered",
+                "delta_delivered"} <= row.keys()
+    assert "payers" in delta and "planned_jain" in delta
+    assert "fairness_delta" in plan.to_dict()
+    # Draining the only executor zeroes delivered shares: every queue
+    # that held capacity pays.
+    assert delta["payers"], delta
+    rendered = plan.render()
+    assert "who pays" in rendered

@@ -71,6 +71,8 @@ EXEMPT_LABELED = {
     # preemption rounds only (tests/test_fill.py etc. cover)
     "scheduler_jobs_preempted",
     "scheduler_jobs_preempted_by_type",
+    # preemption rounds only (tests/test_fairness.py covers attribution)
+    "scheduler_preemption_attributed",
 }
 
 # Front-door families are exempt from the sim sweep BY PREFIX (the sim

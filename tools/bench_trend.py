@@ -43,7 +43,7 @@ def rows(search_dir: str) -> list[dict]:
         row = {"round": os.path.basename(path), "warm": None,
                "tracking": None, "burst": None, "solve": None,
                "trace": False, "params": None, "whatif": None,
-               "frontdoor": None, "transfer": None}
+               "frontdoor": None, "transfer": None, "fairness": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -113,6 +113,20 @@ def rows(search_dir: str) -> list[dict]:
                 )
             else:
                 row["transfer"] = "yes"
+        fairness = extra.get("fairness") if isinstance(extra, dict) else None
+        if isinstance(fairness, dict):
+            # Fairness-observatory block (armada_tpu/observe/fairness.py):
+            # the headline cycle's Jain index + max fairness regret as
+            # one token, jJAIN/rREGRET. Older artifacts simply lack the
+            # block and print "-".
+            jain = fairness.get("jain")
+            regret = fairness.get("max_regret")
+            row["fairness"] = (
+                f"j{jain:.3f}/r{regret:.3f}"
+                if isinstance(jain, (int, float))
+                and isinstance(regret, (int, float))
+                else "yes"
+            )
         params = extra.get("params") if isinstance(extra, dict) else None
         if isinstance(params, dict):
             # Effective headline solver parameters (window/chunk, "*"
@@ -138,7 +152,7 @@ def main(argv=None) -> int:
     header = (
         f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} "
         f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6} {'whatif':>9} "
-        f"{'frontdoor':>10} {'transfer':>16}"
+        f"{'frontdoor':>10} {'transfer':>16} {'fairness':>15}"
     )
     print(header)
     print("-" * len(header))
@@ -150,7 +164,8 @@ def main(argv=None) -> int:
             f"{'yes' if r.get('trace') else '-':>6} "
             f"{r.get('whatif') or '-':>9} "
             f"{r.get('frontdoor') or '-':>10} "
-            f"{r.get('transfer') or '-':>16}"
+            f"{r.get('transfer') or '-':>16} "
+            f"{r.get('fairness') or '-':>15}"
         )
     return 0
 
