@@ -211,6 +211,49 @@ def cmd_slo(args):
         )
 
 
+def cmd_doctor(args):
+    """Print the self-healing solve path's state: failover ladder rung
+    breaker states, recent admission-firewall rejections with their
+    quarantine bundle paths, recent failovers (scheduler.doctor_report;
+    GET /api/doctor serves the same)."""
+    client = connect(args.server, ca_cert=args.ca_cert or None)
+    doc = client.doctor()
+    if args.json:
+        _print(doc)
+        return
+    print(
+        f"cycle {doc.get('cycle', 0)}  "
+        f"validation {'on' if doc.get('validation_enabled') else 'OFF'}  "
+        f"failover {'on' if doc.get('failover_enabled') else 'OFF'}"
+    )
+    for row in doc.get("ladder", []):
+        mark = " (terminal)" if row.get("terminal") else ""
+        fails = row.get("consecutive_failures", 0)
+        tail = f"  {fails} consecutive failures" if fails else ""
+        print(f"  rung {row['rung']}: {row['state']}{tail}{mark}")
+    rejections = doc.get("rejections") or []
+    if rejections:
+        print("recent rejections:")
+        for r in rejections:
+            bundle = r.get("bundle") or "(postmortem not captured)"
+            print(
+                f"  cycle {r['cycle']} pool {r['pool']} rung {r['rung']}: "
+                f"{r['invariant']} — {r['detail']}\n    postmortem: {bundle}"
+            )
+    else:
+        print("no recent rejections")
+    failovers = doc.get("failovers") or []
+    if failovers:
+        print("recent failovers:")
+        for f in failovers:
+            print(
+                f"  cycle {f['cycle']} pool {f['pool']}: "
+                f"{f['from']} -> {f['to']} ({f['cause']})"
+            )
+    else:
+        print("no recent failovers")
+
+
 def cmd_fairness(args):
     """Print the fairness observatory's latest per-pool scorecard:
     entitlement vs delivered share per queue, regret, Jain index,
@@ -530,6 +573,15 @@ def build_parser():
     )
     slo.add_argument("--json", action="store_true")
     slo.set_defaults(fn=cmd_slo)
+
+    doctor = sub.add_parser(
+        "doctor",
+        help="show the self-healing solve path's state (failover "
+        "ladder breakers, recent round rejections + quarantine "
+        "bundles, recent failovers)",
+    )
+    doctor.add_argument("--json", action="store_true")
+    doctor.set_defaults(fn=cmd_doctor)
 
     fair = sub.add_parser(
         "fairness",

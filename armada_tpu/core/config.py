@@ -277,6 +277,23 @@ class SchedulingConfig:
     # backpressure trips (services/backpressure.RoundDeadlinePressure)
     # and the health surface turns unhealthy.
     truncated_rounds_backpressure: int = 3
+    # Self-healing solve path (solver/validate.py + solver/failover.py):
+    # `solver_validate` runs the round admission firewall before any
+    # round commits (a violation rejects the round, captures a
+    # single-round .atrace postmortem, and requeues the work);
+    # `solver_failover` retries a raising/hanging/rejected round down
+    # the backend ladder (mesh -> hotwindow LOCAL -> LOCAL -> oracle)
+    # within the same cycle. A rung failing
+    # `solver_failover_threshold` consecutive rounds opens its circuit
+    # breaker and is skipped for `solver_failover_cooldown_rounds`
+    # rounds, then re-probed via a shadow solve before restoration.
+    # `quarantine_dir` holds rejected-round postmortem bundles (empty =
+    # a per-process directory under the system temp dir).
+    solver_validate: bool = True
+    solver_failover: bool = True
+    solver_failover_threshold: int = 3
+    solver_failover_cooldown_rounds: int = 8
+    quarantine_dir: str = ""
     # Store backpressure (common/etcdhealth re-targeted at the event log;
     # services/backpressure.py): reject submissions and pause executor pod
     # creation when the log's disk footprint exceeds this fraction of the
@@ -563,6 +580,15 @@ class SchedulingConfig:
                 "truncated_rounds_backpressure",
                 int,
             ),
+            ("solverRoundValidation", "solver_validate", bool),
+            ("solverFailover", "solver_failover", bool),
+            ("solverFailoverThreshold", "solver_failover_threshold", int),
+            (
+                "solverFailoverCooldown",
+                "solver_failover_cooldown_rounds",
+                int,
+            ),
+            ("quarantineDir", "quarantine_dir", str),
             (
                 "maxUnacknowledgedJobsPerExecutor",
                 "max_unacknowledged_jobs_per_executor",
@@ -742,6 +768,10 @@ def validate_config(config: SchedulingConfig):
             )
     if config.truncated_rounds_backpressure < 1:
         problems.append("truncatedRoundsBackpressure must be >= 1")
+    if config.solver_failover_threshold < 1:
+        problems.append("solverFailoverThreshold must be >= 1")
+    if config.solver_failover_cooldown_rounds < 1:
+        problems.append("solverFailoverCooldown must be >= 1")
     for name, frac in config.maximum_resource_fraction_to_schedule.items():
         if frac < 0:
             problems.append(f"maximumResourceFractionToSchedule[{name}] < 0")

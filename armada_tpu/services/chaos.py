@@ -55,6 +55,26 @@ NETWORK_FAULT_KINDS = (
     "network_rst",
 )
 
+# Solver faults — injected at the kernel seam by SolverChaos below, the
+# failure family the self-healing solve path (round admission firewall +
+# backend failover ladder, solver/validate.py + solver/failover.py)
+# exists to contain. Targets are ladder-rung labels ("LOCAL", "oracle",
+# "mesh:2x4", "hotwindow:64"); "*" poisons every rung:
+#
+#   solver_raise            the solve raises mid-round (XLA runtime
+#                           error / device lost / OOM stand-in)
+#   solver_hang             the solve hangs past its budget (surfaced as
+#                           SolverHangError — the watchdog's verdict)
+#   solver_nan_poison       chosen output arrays are corrupted with NaN
+#   solver_wrong_placement  decisions are perturbed (à la the replayer's
+#                           tiebreak perturbation) into invalid bindings
+SOLVER_FAULT_KINDS = (
+    "solver_raise",
+    "solver_hang",
+    "solver_nan_poison",
+    "solver_wrong_placement",
+)
+
 FAULT_KINDS = (
     "executor_crash",
     "executor_hang",
@@ -62,13 +82,16 @@ FAULT_KINDS = (
     "lease_timeout",
     "torn_log_write",
     "leader_flap",
-) + NETWORK_FAULT_KINDS
+) + NETWORK_FAULT_KINDS + SOLVER_FAULT_KINDS
 
 # Process-lifecycle kinds only: FaultPlan.generate defaults to these so
-# pre-existing seeded soaks keep their schedules; network kinds are opted
-# into explicitly (tools/chaos_soak.py partition plans, netchaos tests).
+# pre-existing seeded soaks keep their schedules; network and solver
+# kinds are opted into explicitly (tools/chaos_soak.py partition and
+# solver-fault plans, netchaos tests).
 PROCESS_FAULT_KINDS = tuple(
-    k for k in FAULT_KINDS if k not in NETWORK_FAULT_KINDS
+    k
+    for k in FAULT_KINDS
+    if k not in NETWORK_FAULT_KINDS + SOLVER_FAULT_KINDS
 )
 
 
@@ -335,6 +358,100 @@ class CircuitBreaker:
             self._probing.discard(key)
             if count >= self.failure_threshold:
                 self._opened_at[key] = now
+
+    def failures(self, key: str) -> int:
+        """Consecutive failures recorded against a key (doctor surface)."""
+        with self._lock:
+            return self._failures.get(key, 0)
+
+
+class SolverFaultError(RuntimeError):
+    """An injected solver fault: the solve raised mid-round (the
+    XLA-runtime-error / device-lost / OOM stand-in)."""
+
+
+class SolverHangError(SolverFaultError):
+    """An injected solver hang past its round budget, surfaced the way a
+    watchdog would report it (the in-process seam cannot preempt a truly
+    wedged XLA call, so the chaos plan raises the verdict directly)."""
+
+
+class SolverChaos:
+    """Injects solver faults at the kernel seam (scheduler._solve).
+
+    Attached via SchedulerService.attach_solver_chaos; runs on the same
+    clock as the rest of the plan (virtual in the simulator). Fault
+    targets match failover-ladder rung labels — a fault targeting
+    "LOCAL" fails that rung and the ladder retries below it; a "*"
+    fault poisons every rung and the round is rejected and requeued.
+
+    `before_solve` fires raise/hang faults; `corrupt` mutates the solve
+    output in place (NaN poison into chosen float arrays, wrong-
+    placement perturbation of scheduled bindings) and returns the kinds
+    applied so callers can account injections.
+    """
+
+    def __init__(self, plan: FaultPlan, clock=None):
+        self.plan = plan
+        self.clock = clock if clock is not None else _time.monotonic
+        self.injected: dict[str, int] = {}
+
+    def _note(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def before_solve(self, rung_label: str) -> None:
+        now = self.clock()
+        if self.plan.fire("solver_raise", rung_label, now) is not None:
+            self._note("solver_raise")
+            raise SolverFaultError(
+                f"injected solver_raise on rung {rung_label!r}"
+            )
+        if self.plan.fire("solver_hang", rung_label, now) is not None:
+            self._note("solver_hang")
+            raise SolverHangError(
+                f"injected solver_hang on rung {rung_label!r}: solve "
+                "exceeded its round budget"
+            )
+
+    def corrupt(self, rung_label: str, out: dict) -> list:
+        import numpy as np
+
+        now = self.clock()
+        applied = []
+        if self.plan.fire("solver_nan_poison", rung_label, now) is not None:
+            self._note("solver_nan_poison")
+            for key in ("fair_share", "uncapped_fair_share"):
+                arr = out.get(key)
+                if arr is None:
+                    continue
+                arr = np.array(arr, dtype=np.float64, copy=True)
+                if arr.size:
+                    arr.flat[0] = np.nan
+                out[key] = arr
+            applied.append("solver_nan_poison")
+        if (
+            self.plan.fire("solver_wrong_placement", rung_label, now)
+            is not None
+        ):
+            self._note("solver_wrong_placement")
+            sched = np.array(out.get("scheduled_mask"), dtype=bool, copy=True)
+            assigned = np.array(out.get("assigned_node"), copy=True)
+            if sched.any():
+                # Reflect scheduled bindings into invalid negative
+                # indices (NO_NODE is -1; anything below is garbage a
+                # miscompiled gather could emit — and would silently
+                # wrap to the wrong node if committed).
+                assigned[sched] = -2 - assigned[sched]
+            elif sched.size:
+                # Nothing scheduled this round: fabricate a scheduled
+                # binding onto a garbage node so the window still lands
+                # a detectable fault.
+                sched.flat[0] = True
+                assigned.flat[0] = -5
+                out["scheduled_mask"] = sched
+            out["assigned_node"] = assigned
+            applied.append("solver_wrong_placement")
+        return applied
 
 
 class CrashRecoveringLog:

@@ -33,9 +33,27 @@ FORMAT_VERSION = 1
 class CheckpointStore:
     """One atomic (cursor, state) file per view name."""
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, crash_hook=None):
         self.dir = directory
+        # Crash-point seam (tests/test_checkpoint.py fuzz): called with a
+        # site label at each durability boundary; a hook that raises
+        # simulates a process crash at exactly that point.
+        self.crash_hook = crash_hook
         os.makedirs(directory, exist_ok=True)
+        # A crash between tmp-write and rename strands a stale ".tmp":
+        # never loaded (load reads only the renamed file) but never
+        # cleaned up either. Sweep on open — any writer of these files
+        # is dead by the time a store is constructed over the directory.
+        for fn in os.listdir(directory):
+            if fn.endswith(".ckpt.tmp"):
+                try:
+                    os.remove(os.path.join(directory, fn))
+                except OSError:
+                    pass
+
+    def _crash_point(self, site: str) -> None:
+        if self.crash_hook is not None:
+            self.crash_hook(site)
 
     def _path(self, name: str) -> str:
         return os.path.join(self.dir, f"{name}.ckpt")
@@ -45,11 +63,14 @@ class CheckpointStore:
             (FORMAT_VERSION, cursor, state), protocol=pickle.HIGHEST_PROTOCOL
         )
         tmp = self._path(name) + ".tmp"
+        self._crash_point(f"save:{name}:before-tmp")
         with open(tmp, "wb") as f:
             f.write(zlib.crc32(payload).to_bytes(4, "big") + payload)
             f.flush()
             os.fsync(f.fileno())
+        self._crash_point(f"save:{name}:after-tmp")
         os.replace(tmp, self._path(name))
+        self._crash_point(f"save:{name}:after-rename")
 
     def load(self, name: str):
         """Returns (cursor, state) or None (absent/corrupt — corrupt means
@@ -104,4 +125,6 @@ class CheckpointManager:
     def checkpoint_and_compact(self) -> int:
         """One maintenance pass: save all views, drop fully-covered log
         segments. Returns the number of segments removed."""
-        return self.log.compact(self.save_all())
+        cursor = self.save_all()
+        self.store._crash_point("compact:before")
+        return self.log.compact(cursor)

@@ -680,6 +680,19 @@ class ApiServer:
             raise KeyError("SLO tracking not enabled on this server")
         return tracker.snapshot()
 
+    def _doctor(self, req):
+        """Self-healing-solve state (scheduler.doctor_report): failover
+        ladder breaker states, recent admission-firewall rejections with
+        their quarantine bundle paths, recent failovers. Leader-proxied
+        — the ladder describes the leader's rounds."""
+        proxied = self._proxy_to_leader("Doctor", req)
+        if proxied is not None:
+            return proxied
+        report = getattr(self.scheduler, "doctor_report", None)
+        if report is None:
+            raise KeyError("doctor report not available on this server")
+        return report()
+
     def _fairness_report(self, req):
         """Fairness observatory (observe/fairness.py): the latest per
         -pool share ledger, preemption attribution map and starvation
@@ -1428,6 +1441,7 @@ class ApiServer:
             "JobReport": self._job_report,
             "JobTrace": self._job_trace,
             "SLOStatus": self._slo_status,
+            "Doctor": self._doctor,
             "FairnessReport": self._fairness_report,
             "GetJobLogs": self._get_logs,
             "CordonNode": self._cordon_node,
@@ -1769,6 +1783,13 @@ class ApiClient:
     def slo_status(self):
         """Declared SLOs + compliance + burn rates (services/slo.py)."""
         return self._call("SLOStatus", {})
+
+    def doctor(self):
+        """Self-healing-solve state: failover ladder breaker states,
+        recent round rejections (+ quarantine bundle paths), recent
+        failovers (scheduler.doctor_report; GET /api/doctor serves the
+        same)."""
+        return self._call("Doctor", {})
 
     def fairness_report(self, pool=None):
         """Fairness observatory document: {"pools": {pool: {ledger,

@@ -119,6 +119,41 @@ class FencedExecutorChecker:
         )
 
 
+class SolverLadderChecker:
+    """Advisory surface for the self-healing solve path (solver/
+    failover.py): names ladder rungs whose circuit breakers are open or
+    half-open, and the count of recent admission-firewall rejections.
+    Always healthy: a degraded ladder means the containment is WORKING
+    (rounds still land on lower rungs, poisoned rounds are quarantined,
+    nothing invalid commits) — restarting the scheduler for it would
+    throw away the breaker state that is routing around the fault. The
+    detail string is the operator's cue to run `armadactl doctor`."""
+
+    def __init__(self, scheduler, name: str = "solver-ladder"):
+        self.name = name
+        self.scheduler = scheduler
+
+    def check(self) -> tuple[bool, str]:
+        report = getattr(self.scheduler, "doctor_report", None)
+        if report is None:
+            return True, "no solve ladder on this scheduler"
+        doc = report()
+        degraded = [
+            f"{row['rung']}={row['state']}"
+            for row in doc.get("ladder", ())
+            if row.get("state") not in ("closed", "disabled")
+        ]
+        rejections = len(doc.get("rejections") or ())
+        if not degraded and not rejections:
+            return True, "all solver rungs closed, no recent rejections"
+        return True, (
+            "advisory (degraded but live): "
+            f"rungs [{', '.join(degraded) or 'all closed'}], "
+            f"{rejections} recent round rejection(s) — "
+            "see `armadactl doctor`"
+        )
+
+
 class MultiChecker:
     """health/multi_checker.go: all registered checkers must pass."""
 

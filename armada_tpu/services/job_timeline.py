@@ -231,6 +231,15 @@ class JobTimelineStore:
                 agg.pools.add(pool)
         return totals
 
+    def note_solver_failover(self, job_ids, now: float, detail: str) -> None:
+        """Stamp a round's solver-failover attribution onto every job it
+        leased: the journey then explains that the placement came from a
+        fallback rung (`armadactl job-trace`), not the primary solve."""
+        with self._lock:
+            for job_id in job_ids:
+                self._append(self._journey(job_id), now, "solver-failover",
+                             detail)
+
     # ---- reads -------------------------------------------------------
 
     def rounds_unschedulable(self, job_id: str) -> int:

@@ -22,6 +22,9 @@ view, scheduling report.
   GET /api/logs/<job_id>?tail=N   (binoculars log fetch, when wired)
   GET /api/runs/<run_id>/error|debug|termination
   GET /api/slo                   (SLO compliance + burn rates)
+  GET /api/doctor                (self-healing solve path: ladder
+                                  breakers, round rejections +
+                                  quarantine bundles, failovers)
   GET /api/jobtrace/<job_id>     (job journey: transitions + reasons)
   GET /api/details/<job_id>      (row + runs incl. debug)
   GET /api/job/<id>              (spec + runs)
@@ -402,6 +405,22 @@ class LookoutHttpServer:
                                    503)
                         return
                     self._json(tracker.snapshot())
+                elif parsed.path == "/api/doctor":
+                    # Self-healing solve path (solver/validate.py +
+                    # solver/failover.py): ladder breaker states, recent
+                    # admission-firewall rejections with quarantine
+                    # bundle paths, recent failovers — the "Responding
+                    # to a quarantined round" runbook's first stop
+                    # (docs/operations.md).
+                    report = getattr(
+                        outer.scheduler, "doctor_report", None
+                    )
+                    if report is None:
+                        self._json(
+                            {"error": "doctor report not available"}, 503
+                        )
+                        return
+                    self._json(report())
                 elif parsed.path == "/api/frontdoor":
                     # Front-door overload view (armada_tpu/frontdoor):
                     # per-shard ingest lag / delivery counters and the

@@ -178,6 +178,29 @@ class SchedulerMetrics:
             ["pool"],
             registry=r,
         )
+        # ---- self-healing solve path (solver/validate.py admission
+        # firewall + solver/failover.py backend ladder) ----
+        self.round_rejected = Counter(
+            "scheduler_round_rejected_total",
+            "Scheduling rounds rejected by the admission firewall, by "
+            "first violated invariant (nothing committed; a postmortem "
+            ".atrace bundle was captured for offline replay)",
+            ["pool", "invariant"],
+            registry=r,
+        )
+        self.solver_failover = Counter(
+            "scheduler_solver_failover_total",
+            "Rounds retried down the solver backend failover ladder",
+            ["from", "to", "cause"],
+            registry=r,
+        )
+        self.solver_rung_state = Gauge(
+            "scheduler_solver_rung_state",
+            "Failover-ladder circuit-breaker state per backend rung "
+            "(0 = closed, 1 = half-open, 2 = open)",
+            ["rung"],
+            registry=r,
+        )
         self.executor_heartbeat_age = Gauge(
             "scheduler_executor_heartbeat_age_seconds",
             "Seconds since each executor's last heartbeat",

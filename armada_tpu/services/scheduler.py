@@ -17,6 +17,7 @@ scheduler.go:257-281). The solve runs either on the vectorized JAX kernel
 from __future__ import annotations
 
 import time as _time
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..core.config import SchedulingConfig
@@ -192,6 +193,30 @@ class SchedulerService:
         self.round_pressure = RoundDeadlinePressure(
             config.truncated_rounds_backpressure
         )
+        # Self-healing solve path (solver/validate.py admission firewall
+        # + solver/failover.py backend ladder): every solve attempt's
+        # output is validated against host-side invariants before
+        # anything commits; a raising/hanging/rejected round retries
+        # down the ladder within the same cycle. `solver_chaos` is the
+        # seeded fault-injection seam (services/chaos.SolverChaos,
+        # attach_solver_chaos); the deques + quarantine dir back the
+        # doctor surfaces (`armadactl doctor`, GET /api/doctor).
+        from ..solver.failover import FailoverLadder, build_ladder
+
+        self.solver_chaos = None
+        self.quarantine_dir = config.quarantine_dir or ""
+        self.recent_rejections: deque = deque(maxlen=32)
+        self.recent_failovers: deque = deque(maxlen=32)
+        self._rungs = build_ladder(backend, mesh, config)
+        self.failover = (
+            FailoverLadder(
+                self._rungs,
+                failure_threshold=config.solver_failover_threshold,
+                cooldown_rounds=config.solver_failover_cooldown_rounds,
+            )
+            if config.solver_failover
+            else None
+        )
         # Market mode: bid-price provider + last applied snapshot
         # (scheduler.go:540-585 updateBidPrices; bids are not event-sourced,
         # a restarted leader re-fetches).
@@ -272,6 +297,43 @@ class SchedulerService:
         """Attach the what-if planner service (armada_tpu/whatif): the
         gRPC/lookout surfaces reach it via `scheduler.whatif`."""
         self.whatif = service
+
+    def attach_solver_chaos(self, chaos):
+        """Attach the solver-fault injection seam
+        (services/chaos.SolverChaos): raise/hang faults fire before each
+        rung's solve, poison faults corrupt its output — proving the
+        admission firewall + failover ladder contain every kind."""
+        self.solver_chaos = chaos
+
+    def doctor_report(self) -> dict:
+        """The self-healing-solve state the doctor surfaces render
+        (`armadactl doctor`, GET /api/doctor, the Doctor RPC): ladder
+        breaker states, recent firewall rejections with their postmortem
+        bundle paths, and recent failovers."""
+        ladder = (
+            self.failover.snapshot(self.cycle_count)
+            if self.failover is not None
+            else [
+                {
+                    "rung": r.label,
+                    "kind": r.kind,
+                    "state": "disabled",
+                    "state_code": -1,
+                    "consecutive_failures": 0,
+                    "terminal": i == len(self._rungs) - 1,
+                }
+                for i, r in enumerate(self._rungs)
+            ]
+        )
+        return {
+            "cycle": self.cycle_count,
+            "validation_enabled": bool(self.config.solver_validate),
+            "failover_enabled": self.failover is not None,
+            "ladder": ladder,
+            "rejections": list(self.recent_rejections),
+            "failovers": list(self.recent_failovers),
+            "quarantine_dir": self.quarantine_dir,
+        }
 
     def _trace_round(self, snap, dev, decisions, *, solver, truncated,
                      solve_s, profile=None, fairness=None):
@@ -1231,6 +1293,15 @@ class SchedulerService:
             )
         solve_started = _time.time()
         result = self._solve(snap, inc=inc)
+        if result is None:
+            # The admission firewall rejected every usable rung's round
+            # (or the ladder ran out of budget): NOTHING commits this
+            # cycle — no leases, no preemptions, no ledger entry — and
+            # the queued work simply waits for the next round.
+            self.log_.with_fields(
+                cycle=self.cycle_count, pool=pool, stage="scheduling-round",
+            ).warning("round rejected; committing nothing, work requeued")
+            return []
         if self.fork_capture is not None and inc is None:
             # What-if fork seam (armada_tpu/whatif/fork.py): references
             # to the round's already-built inputs + decision arrays —
@@ -1369,8 +1440,9 @@ class SchedulerService:
                 idealised = calculate_idealised_value(
                     self.config, pool, nodes, queues, running, queued,
                     # Hypothetical mega-node solves: skip the fairness
-                    # ledger (nothing reads it off this path).
-                    lambda s: self._solve(s, fairness=False),
+                    # ledger and the ladder/firewall guard (nothing off
+                    # this path is ever committed).
+                    lambda s: self._solve(s, fairness=False, guard=False),
                     unit,
                 )
             except Exception as e:
@@ -1394,6 +1466,14 @@ class SchedulerService:
                     preempted=self.last_cycle_stats["preempted"],
                     truncated=truncated,
                 )
+                if result.get("failover"):
+                    # Failover attribution: the round span names the rung
+                    # that actually produced the committed placement.
+                    round_span.attrs.update(
+                        failover_from=result["failover"]["from"],
+                        failover_to=result["failover"]["to"],
+                        failover_cause=result["failover"]["cause"],
+                    )
         self.log_.with_fields(
             cycle=self.cycle_count, pool=pool, stage="scheduling-round",
             jobs=snap.num_jobs, nodes=snap.num_nodes,
@@ -1422,6 +1502,18 @@ class SchedulerService:
                 scheduled_at_priority=int(result["scheduled_priority"][j]),
             )
             by_jobset.setdefault((job.queue, job.jobset), []).append(event)
+
+        fo = result.get("failover")
+        if fo and by_jobset:
+            # Failover attribution on the job journey: every job leased
+            # this round was placed by a fallback rung, and `armadactl
+            # job-trace` should say so.
+            self.timeline.note_solver_failover(
+                [e.job_id for events in by_jobset.values() for e in events],
+                now,
+                f"placed by fallback solver {fo['to']} after "
+                f"{fo['cause']} on {fo['from']}",
+            )
 
         # Preemption attribution (armada_tpu/observe/fairness.py): every
         # round preemption's event carries its aggressor queue/gang and
@@ -1902,12 +1994,275 @@ class SchedulerService:
             return None
         return max(1e-9, self._round_deadline - _time.monotonic())
 
-    def _solve(self, snap, inc=None, fairness=True):
-        """`fairness=False` skips the per-round fairness block: the
+    def _solve(self, snap, inc=None, fairness=True, guard=True):
+        """Solve one round, guarded by the self-healing solve path:
+        every attempt's output passes the admission firewall
+        (solver/validate.py) before anything commits, and a
+        raising/hanging/rejected attempt retries down the failover
+        ladder (solver/failover.py) within the same cycle. Returns the
+        round's result dict, or None when every usable rung failed —
+        the caller then commits NOTHING and the work stays queued.
+
+        `fairness=False` skips the per-round fairness block: the
         idealised-value pass re-solves hypothetical mega-node snapshots
-        whose ledger no caller reads."""
+        whose ledger no caller reads. `guard=False` additionally
+        bypasses ladder and firewall — hypothetical solves are never
+        committed, so there is nothing to protect."""
+        from ..services.chaos import SolverHangError
+        from ..solver.validate import RoundRejected
+
+        if not guard:
+            return self._attempt_round(
+                snap, self._rungs[0], inc=inc, fairness=fairness,
+                validate=False,
+            )
+        validate = bool(self.config.solver_validate)
+        ladder = self.failover
+        if ladder is None:
+            try:
+                return self._attempt_round(
+                    snap, self._rungs[0], inc=inc, fairness=fairness,
+                    validate=validate,
+                )
+            except RoundRejected as rj:
+                self._note_rejection(snap, self._rungs[0], rj)
+                return None
+        live, probes = ladder.plan(self.cycle_count)
+        result = None
+        chosen = None
+        first_failed = None
+        last_cause = None
+        for i, rung in enumerate(live):
+            if i > 0 and self._round_deadline is not None and (
+                self._round_deadline - _time.monotonic() <= 0.0
+            ):
+                # Budget-bounded retries: no wall clock left for another
+                # rung this cycle — give up, requeue everything.
+                self.log_.with_fields(
+                    cycle=self.cycle_count, pool=snap.pool
+                ).warning(
+                    "failover ladder out of round budget before rung %s;"
+                    " round rejected", rung.label,
+                )
+                break
+            cause = None
+            try:
+                result = self._attempt_round(
+                    snap, rung, inc=inc, fairness=fairness,
+                    validate=validate,
+                )
+            except RoundRejected as rj:
+                self._note_rejection(snap, rung, rj)
+                cause = "validation"
+            except SolverHangError as e:
+                cause = "hang"
+                self.log_.with_fields(
+                    cycle=self.cycle_count, pool=snap.pool, rung=rung.label
+                ).error("solver rung hung past budget: %r", e)
+            except Exception as e:  # noqa: BLE001 - any solve fault fails over
+                cause = "raise"
+                self.log_.with_fields(
+                    cycle=self.cycle_count, pool=snap.pool, rung=rung.label
+                ).error("solver rung raised: %r", e)
+            if cause is None:
+                chosen = rung
+                ladder.record_success(rung.label, self.cycle_count)
+                break
+            ladder.record_failure(rung.label, self.cycle_count)
+            last_cause = cause
+            if first_failed is None:
+                first_failed = rung
+            nxt = live[i + 1] if i + 1 < len(live) else None
+            self._note_failover(snap.pool, rung, nxt, cause)
+        if result is not None:
+            # Half-open rungs earn their way back via a shadow solve:
+            # validated, then DISCARDED — never committed.
+            for rung in probes:
+                if self._round_deadline is not None and (
+                    self._round_deadline - _time.monotonic() <= 0.0
+                ):
+                    break
+                try:
+                    self._attempt_round(
+                        snap, rung, inc=inc, fairness=False,
+                        validate=True, shadow=True,
+                    )
+                except Exception:  # noqa: BLE001 - probe failure re-opens
+                    ladder.record_failure(rung.label, self.cycle_count)
+                else:
+                    ladder.record_success(rung.label, self.cycle_count)
+                    self.log_.with_fields(
+                        cycle=self.cycle_count, rung=rung.label
+                    ).info("solver rung restored after clean shadow probe")
+        if self.metrics is not None and self.metrics.registry is not None:
+            for row in ladder.snapshot(self.cycle_count):
+                self.metrics.solver_rung_state.labels(rung=row["rung"]).set(
+                    row["state_code"]
+                )
+        if result is None:
+            return None
+        if first_failed is not None and chosen is not None:
+            result["failover"] = {
+                "from": first_failed.label,
+                "to": chosen.label,
+                "cause": last_cause,
+            }
+        return result
+
+    def _note_rejection(self, snap, rung, rj):
+        """Book a firewall rejection: metric, doctor ledger, log line."""
+        v = rj.violation
+        if self.metrics is not None and self.metrics.registry is not None:
+            self.metrics.round_rejected.labels(
+                pool=snap.pool, invariant=v.invariant
+            ).inc()
+        self.recent_rejections.append(
+            {
+                "cycle": self.cycle_count,
+                "pool": snap.pool,
+                "rung": rung.label,
+                "invariant": v.invariant,
+                "detail": v.detail,
+                "bundle": rj.bundle or "",
+            }
+        )
+        self.log_.with_fields(
+            cycle=self.cycle_count, pool=snap.pool, rung=rung.label,
+            invariant=v.invariant,
+        ).error(
+            "round admission firewall rejected the round: %s (postmortem: %s)",
+            v.detail, rj.bundle or "not captured",
+        )
+
+    def _note_failover(self, pool, from_rung, to_rung, cause):
+        """Book one ladder step: metric, doctor ledger, log line.
+        to_rung None means the ladder was exhausted (round rejected)."""
+        to_label = to_rung.label if to_rung is not None else "rejected"
+        if self.metrics is not None and self.metrics.registry is not None:
+            self.metrics.solver_failover.labels(
+                **{"from": from_rung.label, "to": to_label, "cause": cause}
+            ).inc()
+        self.recent_failovers.append(
+            {
+                "cycle": self.cycle_count,
+                "pool": pool,
+                "from": from_rung.label,
+                "to": to_label,
+                "cause": cause,
+            }
+        )
+        self.log_.with_fields(cycle=self.cycle_count, pool=pool).warning(
+            "solver failover %s -> %s (%s)", from_rung.label, to_label, cause
+        )
+
+    def _capture_postmortem(self, snap, dev, decisions, *, violation, rung):
+        """Quarantine a rejected round as a single-round .atrace bundle
+        so tools/replay_gate.py reproduces the rejection offline.
+        Advisory: capture failure must never mask the rejection."""
+        import os
+        import tempfile
+
+        import numpy as np
+
+        from ..trace import TraceRecorder
+
+        try:
+            if dev is None:
+                # Oracle rounds never touched a device: prep the same
+                # DeviceRound the kernel would consume so the bundle
+                # replays offline.
+                from ..solver.kernel_prep import (
+                    pad_device_round,
+                    prep_device_round,
+                )
+
+                dev = pad_device_round(prep_device_round(snap))
+                sp = decisions.get("spot_price")
+                decisions = {
+                    **{
+                        k: np.asarray(decisions[k])
+                        for k in (
+                            "assigned_node",
+                            "scheduled_priority",
+                            "scheduled_mask",
+                            "preempted_mask",
+                            "fair_share",
+                            "demand_capped_fair_share",
+                            "uncapped_fair_share",
+                        )
+                        if decisions.get(k) is not None
+                    },
+                    "spot_price": np.float64(
+                        np.nan if sp is None else float(sp)
+                    ),
+                    "num_loops": int(decisions.get("num_loops") or 0),
+                }
+            qdir = self.quarantine_dir or os.path.join(
+                tempfile.gettempdir(), f"armada-quarantine-{os.getpid()}"
+            )
+            os.makedirs(qdir, exist_ok=True)
+            safe_rung = rung.label.replace(":", "-").replace("/", "-")
+            path = os.path.join(
+                qdir,
+                f"round-c{self.cycle_count:06d}-{snap.pool}-"
+                f"{violation.invariant}-{safe_rung}.atrace",
+            )
+            rec = TraceRecorder(
+                path,
+                source="postmortem",
+                config=snap.config,
+                max_rounds=1,
+                meta={
+                    "pool": snap.pool,
+                    "cycle": self.cycle_count,
+                    "rung": rung.label,
+                    "invariant": violation.invariant,
+                    "detail": violation.detail,
+                },
+            )
+            try:
+                ids = None
+                if rec.wants_ids(snap.num_jobs):
+                    ids = {
+                        "jobs": list(snap.job_ids),
+                        "nodes": list(snap.node_ids),
+                        "queues": list(snap.queue_names),
+                    }
+                rec.record_round(
+                    pool=snap.pool,
+                    dev=dev,
+                    decisions=decisions,
+                    num_jobs=snap.num_jobs,
+                    num_queues=snap.num_queues,
+                    config=snap.config,
+                    cycle=self.cycle_count,
+                    solver={"backend": rung.label, "postmortem": True},
+                    truncated=False,
+                    ids=ids,
+                )
+            finally:
+                rec.close()
+            return path
+        except Exception as e:  # noqa: BLE001 - advisory path
+            self.log_.with_fields(pool=snap.pool).error(
+                "postmortem capture failed: %r", e
+            )
+            return None
+
+    def _attempt_round(self, snap, rung, *, inc=None, fairness=True,
+                       validate=True, shadow=False):
+        """One solve attempt on a single ladder rung. Raises the
+        solver's own faults (the ladder catches them) and RoundRejected
+        when the admission firewall refuses the output. `shadow=True`
+        is the half-open probe mode: the solve runs and validates, but
+        no advisory round seam (recorder, metrics, autotune, spans)
+        observes it, no fault is injected into it, and no postmortem is
+        captured — its output is discarded either way."""
         budget_s = self._remaining_budget()
-        if self.backend == "kernel":
+        chaos = self.solver_chaos if not shadow else None
+        if chaos is not None:
+            chaos.before_solve(rung.label)
+        if rung.kind != "oracle":
             from ..solver.kernel import solve_round
             from ..solver.kernel_prep import pad_device_round, prep_device_round
 
@@ -1936,7 +2291,7 @@ class SchedulerService:
             _xla.install()
             _comp0 = _xla.thread_snapshot()
             with _tledger.round_ledger() as _led:
-                if self.mesh is not None:
+                if rung.kind == "mesh":
                     # The sharded solve is one fused program; the budget is
                     # enforced between pools only (chunked pass 1 is
                     # single-device for now).
@@ -1956,17 +2311,27 @@ class SchedulerService:
                     out = {k: np.asarray(v) for k, v in out.items()}
                     _tledger.note_down(out, site="mesh.d2h")
                     out["truncated"] = False
-                    self._note_mesh_metrics(snap.pool, _t.monotonic() - t0)
+                    if not shadow:
+                        self._note_mesh_metrics(
+                            snap.pool, _t.monotonic() - t0
+                        )
                     shape = run.mesh_shape
                     hosts, chips = shape if len(shape) == 2 else (1, shape[0])
                     solver_info = {"backend": "kernel", "mesh": f"{hosts}x{chips}"}
                 else:
                     tuned = (
                         self.autotune.params_for(snap.pool)
-                        if self.autotune is not None
+                        if self.autotune is not None and rung.kind == "local"
                         else None
                     )
-                    if tuned is not None:
+                    if rung.kind == "hotwindow":
+                        # Degraded retry on a DIFFERENT compiled program:
+                        # the forced small window re-jits pass 1, dodging
+                        # a single poisoned executable.
+                        window = int(rung.param or 64)
+                        window_min_slots = 0
+                        chunk_loops = 1
+                    elif tuned is not None:
                         window = tuned.hot_window_slots or None
                         window_min_slots = tuned.hot_window_min_slots
                         chunk_loops = tuned.chunk_loops
@@ -1984,11 +2349,21 @@ class SchedulerService:
                     solver_info = {
                         "backend": "kernel",
                         "mesh": None,
+                        "rung": rung.label,
                         "window": int(window or 0),
                         "budget": bool(budget_s),
                         "autotuned": tuned is not None,
                     }
             truncated = bool(out.get("truncated", False))
+            # Materialize the decisions on host: the admission firewall,
+            # fault injection, and every downstream consumer read numpy
+            # views (downstream slicing forced this implicitly anyway).
+            out = {
+                k: (v if k in ("profile", "truncated") else np.asarray(v))
+                for k, v in out.items()
+            }
+            if chaos is not None:
+                chaos.corrupt(rung.label, out)
             # Fold the round's cost accounting into one profile view:
             # the scheduler-round ledger (covers mesh placement AND the
             # solve's own books) plus the compile delta. The same
@@ -2000,9 +2375,6 @@ class SchedulerService:
             cost_profile = dict(out.get("profile") or {})
             cost_profile["transfer"] = transfer
             cost_profile["compiles"] = compiles
-            if "profile" in out:
-                out["profile"] = cost_profile
-            self._note_transfer(snap.pool, transfer, compiles)
             # Fairness observatory (armada_tpu/observe/fairness.py): the
             # canonical per-round share ledger + preemption attribution,
             # computed host-side from the EXACT padded DeviceRound the
@@ -2023,35 +2395,59 @@ class SchedulerService:
                     self.log_.with_fields(pool=snap.pool).error(
                         "fairness ledger failed: %r", e
                     )
-            if self.trace_recorder is not None:
-                self._trace_round(
-                    snap,
-                    dev,
-                    out,
-                    solver=solver_info,
-                    truncated=truncated,
-                    solve_s=round(_t.monotonic() - t_solve, 4),
-                    profile=cost_profile,
-                    fairness=fairness_block,
+            if validate:
+                # Round admission firewall (solver/validate.py): cheap
+                # host-side invariants against the same padded
+                # DeviceRound the solve consumed. A violation quarantines
+                # the round BEFORE the recorder/metrics/autotune seams
+                # observe it — nothing downstream ever sees a poisoned
+                # decision stream.
+                from ..solver.validate import RoundRejected, validate_round
+
+                t_v = _t.monotonic()
+                violation = validate_round(out, dev=dev, fairness=fairness_block)
+                cost_profile["validate_s"] = round(_t.monotonic() - t_v, 6)
+                if violation is not None:
+                    bundle = None
+                    if not shadow:
+                        bundle = self._capture_postmortem(
+                            snap, dev, out, violation=violation, rung=rung
+                        )
+                    raise RoundRejected(violation, bundle)
+            if "profile" in out:
+                out["profile"] = cost_profile
+            if not shadow:
+                self._note_transfer(snap.pool, transfer, compiles)
+                if self.trace_recorder is not None:
+                    self._trace_round(
+                        snap,
+                        dev,
+                        out,
+                        solver=solver_info,
+                        truncated=truncated,
+                        solve_s=round(_t.monotonic() - t_solve, 4),
+                        profile=cost_profile,
+                        fairness=fairness_block,
+                    )
+                self._note_solve_profile(snap.pool, out.get("profile"))
+                if self.autotune is not None and rung.kind == "local":
+                    # Between-rounds adjustment. Only rounds the
+                    # single-device kernel actually solved on its tuned
+                    # parameters feed the loop: the sharded (mesh) solve
+                    # takes no window vector, and a hotwindow fallback
+                    # round ran a forced degraded window — either would
+                    # read as a false disengagement signal.
+                    self.autotune.observe_round(
+                        snap.pool,
+                        out.get("profile"),
+                        solve_s=_t.monotonic() - t_solve,
+                        metrics=self.metrics,
+                        log=self.log_,
+                    )
+                self._emit_solve_spans(
+                    snap.pool, out.get("profile"), _t.monotonic() - t_solve,
+                    transfer=transfer, compiles=compiles,
                 )
-            self._note_solve_profile(snap.pool, out.get("profile"))
-            if self.autotune is not None and self.mesh is None:
-                # Between-rounds adjustment. Only rounds the
-                # single-device kernel actually solved feed the loop:
-                # the sharded (mesh) solve takes no window vector, so
-                # its profile-less rounds would read as a false
-                # disengagement signal.
-                self.autotune.observe_round(
-                    snap.pool,
-                    out.get("profile"),
-                    solve_s=_t.monotonic() - t_solve,
-                    metrics=self.metrics,
-                    log=self.log_,
-                )
-            self._emit_solve_spans(
-                snap.pool, out.get("profile"), _t.monotonic() - t_solve,
-                transfer=transfer, compiles=compiles,
-            )
             J, Q = snap.num_jobs, snap.num_queues
             return {
                 "assigned_node": out["assigned_node"][:J],
@@ -2093,7 +2489,30 @@ class SchedulerService:
             "truncated": res.truncated,
             "num_loops": res.num_loops,
         }
-        if self.trace_recorder is not None:
+        if chaos is not None:
+            chaos.corrupt(rung.label, result)
+        if validate:
+            # No DeviceRound in hand on the oracle path: validate the
+            # decision-intrinsic invariants (NaN/inf, node bounds,
+            # double-bind, preemption victims) straight off the
+            # snapshot; capacity/gang checks need the padded arrays and
+            # run only on kernel rungs.
+            from ..solver.validate import RoundRejected, validate_round
+
+            violation = validate_round(
+                result,
+                num_jobs=snap.num_jobs,
+                num_nodes=len(snap.node_ids),
+                job_is_running=snap.job_is_running,
+            )
+            if violation is not None:
+                bundle = None
+                if not shadow:
+                    bundle = self._capture_postmortem(
+                        snap, None, result, violation=violation, rung=rung
+                    )
+                raise RoundRejected(violation, bundle)
+        if self.trace_recorder is not None and not shadow:
             # Oracle-backed services record too: the bundle's DeviceRound
             # is the same device prep the kernel would see, so a trace
             # captured here replays any candidate kernel against the
@@ -2142,7 +2561,8 @@ class SchedulerService:
         # Oracle rounds with no recorder (no DeviceRound in hand) leave
         # result["fairness"] None: _record_round computes the host-unit
         # ledger_from_snapshot fallback for the live surfaces.
-        self._emit_solve_spans(snap.pool, None, _t.monotonic() - t_solve)
+        if not shadow:
+            self._emit_solve_spans(snap.pool, None, _t.monotonic() - t_solve)
         return result
 
     def _decorate_fairness(self, snap, fairness: dict) -> dict:

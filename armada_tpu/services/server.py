@@ -293,6 +293,7 @@ class ControlPlane:
             FuncChecker,
             HeartbeatChecker,
             MultiChecker,
+            SolverLadderChecker,
             StartupCompleteChecker,
             serve_health,
         )
@@ -335,6 +336,10 @@ class ControlPlane:
         # until its anti-entropy sync) — name it for operators without
         # tripping liveness.
         checkers.append(FencedExecutorChecker(self.scheduler))
+        # The solve ladder is advisory as well: open breakers and recent
+        # round rejections mean the firewall/failover containment is
+        # doing its job — surface them, don't restart over them.
+        checkers.append(SolverLadderChecker(self.scheduler))
         self.health = MultiChecker(*checkers)
         self.health_server = None
         if health_port is not None:

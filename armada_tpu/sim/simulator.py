@@ -197,6 +197,24 @@ class Simulator:
             self.config, self.log, backend=backend, mesh=mesh,
             snapshot_mode=snapshot_mode, is_leader=is_leader,
         )
+        if fault_plan is not None:
+            from ..services.chaos import SOLVER_FAULT_KINDS, SolverChaos
+
+            if any(f.kind in SOLVER_FAULT_KINDS for f in fault_plan.faults):
+                # Solver-fault seam: raise/hang faults fire before each
+                # ladder rung's solve, poison faults corrupt its output
+                # — the admission firewall + failover ladder must
+                # contain every one (tools/chaos_soak.py asserts no
+                # poisoned round ever commits).
+                self.scheduler.attach_solver_chaos(
+                    SolverChaos(fault_plan, clock=self.chaos_clock)
+                )
+        if data_dir is not None and not self.scheduler.quarantine_dir:
+            import os as _os
+
+            self.scheduler.quarantine_dir = _os.path.join(
+                data_dir, "quarantine"
+            )
         self.frontdoor = None
         if frontdoor:
             from ..frontdoor import FrontDoor

@@ -46,7 +46,12 @@ def update_fair_shares(
     """
     Q = len(queue_names)
     weights = np.asarray(weights, dtype=np.float64)
-    fair_share = weights / weights.sum() if Q else np.zeros(0)
+    # Guard the all-zero-weight pool (every queue cordoned down to
+    # weight 0): 0/0 here would NaN-poison every fair-share output and
+    # trip the round admission firewall. Zero total weight means no
+    # queue holds entitlement — every share is 0.
+    wsum = weights.sum()
+    fair_share = weights / wsum if Q and wsum > 0.0 else np.zeros(Q)
     demand_share = (
         np.ones(Q) if total_is_zero else np.asarray(constrained_demand_costs, np.float64)
     )
@@ -97,4 +102,10 @@ def update_fair_shares(
             else:
                 spare[i] = 0.0
 
+    from .validate import maybe_assert_finite
+
+    maybe_assert_finite(
+        {"fair_share": fair_share, "demand_capped": capped, "uncapped": uncapped},
+        "drf.update_fair_shares",
+    )
     return fair_share, capped, uncapped

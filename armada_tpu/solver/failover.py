@@ -1,0 +1,121 @@
+"""Solver backend failover ladder: retry a failed round down-backend.
+
+A round that raises (XLA runtime error, device lost, OOM), hangs past
+its budget, or fails the admission firewall (solver/validate.py) is
+retried WITHIN the same cycle down a configured ladder of backends:
+
+    mesh "HxC"  ->  hotwindow LOCAL  ->  plain LOCAL  ->  oracle
+
+Each rung carries a per-backend circuit breaker (services/chaos.py's
+CircuitBreaker, the PR-1 class, driven on the ROUND counter instead of
+wall clock): `failure_threshold` consecutive failures open the rung and
+it is skipped for `solverFailoverCooldown` rounds; after the cooldown
+the rung goes half-open and is re-probed via a SHADOW solve — the live
+round runs on a healthy rung while the probe's output is validated and
+discarded — so a flaky backend earns its way back without ever touching
+a committed placement. The TERMINAL rung (oracle: pure host python, no
+device to lose) is always allowed even with its breaker open; with it
+the ladder can only fail a round by rejection, never by having nowhere
+left to run.
+
+Failovers carry attribution into round spans, job timelines, and
+`scheduler_solver_failover_total{from,to,cause}`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+_STATE_CODE = {"closed": 0, "half-open": 1, "open": 2}
+
+
+@dataclass(frozen=True)
+class Rung:
+    """One ladder entry. kind: "mesh" | "local" | "hotwindow" | "oracle";
+    param is the mesh spec (mesh) or the forced window size (hotwindow)."""
+
+    kind: str
+    label: str
+    param: object = None
+
+
+def build_ladder(backend: str, mesh, config) -> tuple:
+    """The default rung sequence for a scheduler's configured solve
+    path. Primary first; every ladder terminates at the oracle."""
+    rungs = []
+    if backend == "kernel":
+        if mesh is not None:
+            rungs.append(Rung("mesh", f"mesh:{mesh}", mesh))
+        rungs.append(Rung("local", "LOCAL"))
+        # A degraded retry on a DIFFERENT compiled program: a forced
+        # small hot window (fixed, independent of the configured/tuned
+        # size) re-jits pass 1, dodging a single poisoned executable the
+        # way the replayer's hotwindow spec does.
+        rungs.append(Rung("hotwindow", "hotwindow:64", 64))
+    rungs.append(Rung("oracle", "oracle"))
+    return tuple(rungs)
+
+
+class FailoverLadder:
+    """Breaker-gated rung selection, clocked on the round counter."""
+
+    def __init__(self, rungs, *, failure_threshold: int = 3,
+                 cooldown_rounds: int = 8):
+        from ..services.chaos import CircuitBreaker
+
+        self.rungs = tuple(rungs)
+        if not self.rungs:
+            raise ValueError("failover ladder needs at least one rung")
+        self.cooldown_rounds = max(1, int(cooldown_rounds))
+        # cooldown_s is denominated in ROUNDS: every query passes the
+        # cycle counter as `now`, so "seconds" of cooldown are rounds.
+        self.breaker = CircuitBreaker(
+            failure_threshold=failure_threshold,
+            cooldown_s=float(self.cooldown_rounds),
+        )
+
+    def plan(self, cycle: int) -> tuple:
+        """(live, probes) for this round: `live` is the ordered rung
+        list the round may solve on (closed breakers, terminal rung
+        always included last); `probes` are half-open rungs granted
+        their one shadow probe this round."""
+        live = []
+        probes = []
+        for rung in self.rungs[:-1]:
+            state = self.breaker.state(rung.label, now=float(cycle))
+            if state == "closed":
+                live.append(rung)
+            elif state == "half-open" and self.breaker.allow(
+                rung.label, now=float(cycle)
+            ):
+                probes.append(rung)
+        live.append(self.rungs[-1])  # terminal fallback, breaker or not
+        return live, probes
+
+    def record_success(self, label: str, cycle: int) -> None:
+        self.breaker.record_success(label)
+
+    def record_failure(self, label: str, cycle: int) -> None:
+        self.breaker.record_failure(label, now=float(cycle))
+
+    def state(self, label: str, cycle: int) -> str:
+        return self.breaker.state(label, now=float(cycle))
+
+    def snapshot(self, cycle: int) -> list:
+        """Per-rung breaker view for the doctor surfaces (`armadactl
+        doctor`, GET /api/doctor)."""
+        out = []
+        for i, rung in enumerate(self.rungs):
+            state = self.breaker.state(rung.label, now=float(cycle))
+            failures = self.breaker.failures(rung.label)
+            out.append(
+                {
+                    "rung": rung.label,
+                    "kind": rung.kind,
+                    "state": state,
+                    "state_code": _STATE_CODE[state],
+                    "consecutive_failures": int(failures),
+                    "terminal": i == len(self.rungs) - 1,
+                }
+            )
+        return out

@@ -125,7 +125,13 @@ def _drf_cost(alloc, total, mult):
 def _fair_shares(weights, demand_costs, total_is_zero):
     """Water-filling fair shares (context/scheduling.go:252-331), jit form."""
     Q = weights.shape[0]
-    fair_share = weights / jnp.sum(weights)
+    # Zero total weight (every queue cordoned to weight 0) must yield
+    # zero shares, not 0/0 NaNs — mirrors drf.update_fair_shares and
+    # keeps the round admission firewall's nan_inf invariant clean.
+    wsum = jnp.sum(weights)
+    fair_share = jnp.where(
+        wsum > 0.0, weights / jnp.where(wsum > 0.0, wsum, 1.0), 0.0
+    )
     demand = jnp.where(total_is_zero, 1.0, demand_costs)
 
     def body(state):
@@ -2231,6 +2237,9 @@ def solve_round(
         out = _solve(dev)
         out = {k: np.asarray(v) for k, v in out.items()}
         _tledger.note_down(out, site="solve.d2h")
+        from .validate import maybe_assert_finite
+
+        maybe_assert_finite(out, "kernel.solve_round[fused]")
         return out
 
     import time as _time
@@ -2362,6 +2371,12 @@ def solve_round(
         seg_np = np.asarray(segc)
         out = {k: np.asarray(v) for k, v in out.items()}
         _tledger.note_down(out, site="solve.d2h")
+        # ARMADA_DEBUG_FINITE=1 debug net: name the first non-finite
+        # output array at the seam it left the device, before any
+        # downstream consumer can launder the NaN into a placement.
+        from .validate import maybe_assert_finite
+
+        maybe_assert_finite(out, "kernel.solve_round[host-driven]")
         if use_budget:
             out["truncated"] = truncated
         out["profile"] = {

@@ -188,6 +188,7 @@ def _rsa_keypair():
 
 
 def test_jwks_rs256_roundtrip_and_failures(tmp_path):
+    pytest.importorskip("cryptography")
     import json as _json
 
     from armada_tpu.services.auth import (
@@ -239,6 +240,7 @@ def test_jwks_rs256_roundtrip_and_failures(tmp_path):
 
 
 def test_jwks_file_rotation(tmp_path):
+    pytest.importorskip("cryptography")
     import json as _json
 
     from armada_tpu.services.auth import (
@@ -314,6 +316,7 @@ def _self_signed(tmp_path):
 
 
 def test_grpc_tls_roundtrip(tmp_path):
+    pytest.importorskip("cryptography")
     cert_file, key_file = _self_signed(tmp_path)
     config = SchedulingConfig(
         priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
@@ -338,6 +341,7 @@ def test_grpc_tls_roundtrip(tmp_path):
 
 
 def test_rest_gateway_tls(tmp_path):
+    pytest.importorskip("cryptography")
     import json as _json
     import ssl
     import urllib.request

@@ -203,3 +203,28 @@ def test_soak_subset_deterministic():
         assert first["digest"] == second["digest"]
         assert first["finished"] == first["total"]
         assert first["faults_fired"] > 0  # chaos actually landed
+
+
+@pytest.mark.chaos
+def test_solver_fault_soak_subset():
+    """One solver-fault soak plan (kernel backend: raise / hang /
+    NaN-poison / wrong-placement windows through the failover ladder and
+    the admission firewall) with the determinism check — the tier-1
+    slice of `tools/chaos_soak.py --solver-faults`. run_solver_plan
+    itself asserts containment: every planned fault fired, nothing
+    invalid committed, all jobs terminal, every rejection left a
+    postmortem bundle that replays DIVERGED offline."""
+    from tools.chaos_soak import run_solver_plan
+
+    first = run_solver_plan(0, 24)
+    second = run_solver_plan(0, 24, replay=False)
+    assert first["digest"] == second["digest"]
+    assert first["finished"] == first["total"] == 24
+    assert all(
+        first["injected"].get(k)
+        for k in ("solver_raise", "solver_hang", "solver_nan_poison",
+                  "solver_wrong_placement")
+    )
+    assert first["bundles_replayed"] == len(first["rejections"]) >= 2
+    causes = {fo["cause"] for fo in first["failovers"]}
+    assert {"raise", "hang", "validation"} <= causes

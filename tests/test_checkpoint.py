@@ -283,3 +283,67 @@ def test_churn_with_rolling_compaction(tmp_path):
     # generation writes ~the same amount and compaction removes it).
     assert max(seg_counts) <= seg_counts[0] + 2, seg_counts
     plane.stop()
+
+
+class _SimulatedCrash(BaseException):
+    """Raised by the crash hook; BaseException so no advisory except
+    Exception on the checkpoint path can accidentally swallow the
+    'process died here' simulation."""
+
+
+def test_checkpoint_crash_point_fuzz(tmp_path):
+    """Crash at EVERY durability boundary of a checkpoint+compact pass —
+    between tmp-write and rename, between per-view saves, between
+    save_all and compaction — and restart over the directory. Recovery
+    must never be torn: the restarted plane reconstructs exactly the
+    pre-crash state at every crash point, and a subsequent clean pass
+    completes."""
+    # Enumerate the pass's crash sites with a recording (non-raising)
+    # hook first, so the fuzz below covers each one exactly once.
+    probe_dir = str(tmp_path / "probe")
+    probe = _plane(probe_dir)
+    probe.log.segment_size = 16
+    _drive(probe, n_jobs=16)
+    sites: list = []
+    probe.checkpoints.store.crash_hook = sites.append
+    probe.checkpoints.checkpoint_and_compact()
+    probe.checkpoints.store.crash_hook = None  # stop() checkpoints too
+    probe.stop()
+    assert len(sites) > 5, sites  # per-view tmp/rename points + compact
+
+    for k, site in enumerate(sites):
+        d = str(tmp_path / f"crash-{k}")
+        plane = _plane(d)
+        plane.log.segment_size = 16
+        _drive(plane, n_jobs=16)
+        want = _state_fingerprint(plane)
+
+        seen = {"n": 0}
+
+        def hook(label, _k=k):
+            if seen["n"] == _k:
+                raise _SimulatedCrash(label)
+            seen["n"] += 1
+
+        plane.checkpoints.store.crash_hook = hook
+        try:
+            plane.checkpoints.checkpoint_and_compact()
+        except _SimulatedCrash:
+            pass
+        else:
+            raise AssertionError(f"crash hook {k} ({site}) never fired")
+        plane.log.flush()
+        # Plane abandoned (simulated kill -9 mid-checkpoint); restart.
+        plane2 = _plane(d)
+        plane2.lookout_store.sync()
+        plane2.submit.sync()
+        plane2.event_index.sync()
+        assert _state_fingerprint(plane2) == want, f"torn at {site!r}"
+        # No stale tmp survives recovery, and a clean pass completes.
+        ckpt_dir = os.path.join(d, "checkpoints")
+        assert not [
+            f for f in os.listdir(ckpt_dir) if f.endswith(".tmp")
+        ], f"stale tmp after crash at {site!r}"
+        plane2.checkpoints.checkpoint_and_compact()
+        assert _state_fingerprint(plane2) == want, f"post-pass at {site!r}"
+        plane2.stop()

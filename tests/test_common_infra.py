@@ -43,6 +43,42 @@ def test_heartbeat_checker_times_out():
     assert not ok and "last beat" in detail
 
 
+def test_solver_ladder_checker_is_advisory():
+    """A degraded ladder or recent rejections stay HEALTHY (restarting
+    would discard the breaker state routing around the fault) but name
+    the degraded rungs and point at `armadactl doctor`."""
+    from armada_tpu.services.health import SolverLadderChecker
+
+    class Degraded:
+        def doctor_report(self):
+            return {
+                "ladder": [
+                    {"rung": "LOCAL", "state": "closed"},
+                    {"rung": "hotwindow:64", "state": "half-open"},
+                ],
+                "rejections": [{"cycle": 3}],
+            }
+
+    ok, detail = SolverLadderChecker(Degraded()).check()
+    assert ok
+    assert "hotwindow:64=half-open" in detail and "LOCAL" not in detail
+    assert "1 recent round rejection" in detail and "doctor" in detail
+
+    class Healthy:
+        def doctor_report(self):
+            return {"ladder": [{"rung": "oracle", "state": "closed"}],
+                    "rejections": []}
+
+    ok, detail = SolverLadderChecker(Healthy()).check()
+    assert ok and "all solver rungs closed" in detail
+
+    class NoLadder:
+        doctor_report = None
+
+    ok, detail = SolverLadderChecker(NoLadder()).check()
+    assert ok and "no solve ladder" in detail
+
+
 def test_compress_roundtrip_and_threshold():
     small = {"id": "x"}
     assert compress_obj(small) == small  # below threshold: unchanged

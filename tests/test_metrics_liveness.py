@@ -64,6 +64,11 @@ EXEMPT_LABELED = {
     "scheduler_executor_fence",
     "scheduler_executor_reconnects",
     "scheduler_anti_entropy_resolutions",
+    # solver-fault chaos only (tests/test_chaos.py solver soak subset and
+    # tests/test_solver_selfheal.py cover; scheduler_solver_rung_state is
+    # NOT exempt — the ladder gauge refreshes every round, faults or not)
+    "scheduler_round_rejected",
+    "scheduler_solver_failover",
     # replay gate only (tests/test_trace_replay.py covers)
     "scheduler_trace_replay_divergences",
     # round-deadline truncation only (tests/test_round_deadline.py)

@@ -1,0 +1,410 @@
+"""Self-healing solve path: the round admission firewall
+(solver/validate.py) fuzzed over the recorded steady-state fixture, and
+the solver backend failover ladder (solver/failover.py) unit-tested —
+breaker lifecycle, terminal fallback, budget-bounded retries.
+
+The fixture fuzz mirrors the solver-fault chaos corruptions
+(services/chaos.SolverChaos): seeded NaN/inf poisoning and
+wrong-placement perturbations over real recorded rounds, asserting each
+mutation classifies as the RIGHT invariant — a misclassified rejection
+would send an operator chasing the wrong failure mode from the
+postmortem bundle's filename.
+"""
+
+import os
+import types
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import SchedulingConfig
+from armada_tpu.solver.failover import FailoverLadder, build_ladder
+from armada_tpu.solver.validate import (
+    INVARIANTS,
+    RoundViolation,
+    validate_round,
+)
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "sim_steady.atrace"
+)
+
+
+def _rounds():
+    from armada_tpu.trace import load_trace
+
+    trace = load_trace(FIXTURE)
+    rounds = [r for r in trace.rounds if not r.truncated]
+    assert rounds, "fixture carries no replayable rounds"
+    return rounds
+
+
+def _copy_decisions(rec) -> dict:
+    return {k: np.array(v, copy=True) for k, v in rec.decisions().items()}
+
+
+# ------------------------------------------------------ admission firewall
+
+
+def test_firewall_admits_every_recorded_round():
+    """Every committed round in the fixture passes the full invariant
+    set — the firewall must never reject legitimate solver output."""
+    for rec in _rounds():
+        v = validate_round(
+            _copy_decisions(rec), dev=rec.device_round(),
+            num_jobs=rec.num_jobs,
+        )
+        assert v is None, f"round {rec['i']}: {v}"
+
+
+def test_firewall_fuzz_classifies_corruption():
+    """Seeded NaN/inf + wrong-placement mutations over the fixture's
+    rounds: each corruption family must classify as its own invariant,
+    on every round it applies to."""
+    rng = np.random.default_rng(20260807)
+    hit: set[str] = set()
+    for rec in _rounds():
+        dev = rec.device_round()
+        J = rec.num_jobs
+        N = int(np.asarray(dev.node_total).shape[0])
+        running = np.asarray(dev.job_is_running, dtype=bool)[:J]
+
+        def verdict(mutate) -> RoundViolation | None:
+            d = _copy_decisions(rec)
+            mutate(d)
+            return validate_round(d, dev=dev, num_jobs=J)
+
+        # NaN poison, the SolverChaos corruption verbatim.
+        def nan_poison(d, _rng=rng):
+            fs = d["fair_share"]
+            fs.flat[int(_rng.integers(max(fs.size, 1)))] = np.nan
+
+        v = verdict(nan_poison)
+        assert v is not None and v.invariant == "nan_inf", v
+        hit.add("nan_inf")
+
+        # Inf in a share tensor is corruption too (an unguarded x/0).
+        def inf_poison(d, _rng=rng):
+            us = d["uncapped_fair_share"]
+            us.flat[int(_rng.integers(max(us.size, 1)))] = np.inf
+
+        v = verdict(inf_poison)
+        assert v is not None and v.invariant == "nan_inf", v
+
+        # Wrong placement: a scheduled job pointing outside the node
+        # table (the SolverChaos perturbation `-2 - assigned`).
+        j = int(rng.integers(J))
+
+        def bad_node(d, j=j):
+            d["scheduled_mask"][j] = True
+            d["assigned_node"][j] = -2 - int(d["assigned_node"][j])
+
+        v = verdict(bad_node)
+        assert v is not None and v.invariant == "invalid_node", v
+        hit.add("invalid_node")
+
+        if N > 0:
+            # One job, two bindings in one round.
+            def both_bound(d, j=j):
+                d["scheduled_mask"][j] = True
+                d["assigned_node"][j] = 0
+                d["preempted_mask"][j] = True
+
+            v = verdict(both_bound)
+            assert v is not None and v.invariant == "double_bound", v
+            hit.add("double_bound")
+
+            if running.any():
+                r = int(np.flatnonzero(running)[0])
+
+                def rebind_running(d, r=r):
+                    d["scheduled_mask"][r] = True
+                    d["assigned_node"][r] = 0
+                    d["preempted_mask"][r] = False
+
+                v = verdict(rebind_running)
+                assert v is not None and v.invariant == "double_bound", v
+
+        if (~running).any():
+            q = int(np.flatnonzero(~running)[0])
+
+            def victimless(d, q=q):
+                d["preempted_mask"][q] = True
+                d["scheduled_mask"][q] = False
+
+            v = verdict(victimless)
+            assert (
+                v is not None and v.invariant == "preemption_victim"
+            ), v
+            hit.add("preemption_victim")
+
+    assert {"nan_inf", "invalid_node", "double_bound",
+            "preemption_victim"} <= hit
+
+
+def test_firewall_gang_and_capacity_invariants():
+    """gang_atomicity and node_over_capacity on a hand-built round (the
+    fixture's steady rounds carry no conveniently torn gangs)."""
+    dev = types.SimpleNamespace(
+        job_is_running=np.array([True, True, False, False]),
+        job_node=np.array([0, 0, -1, -1]),
+        # one resource column; node 0 holds 4, node 1 holds 2
+        node_total=np.array([[4], [2]]),
+        job_req_fit=np.array([[2], [2], [2], [2]]),
+        # slot 0: gang of jobs 0+1; slots for singletons 2, 3
+        slot_members=np.array([[0, 1], [2, -1], [3, -1]]),
+        slot_count=np.array([2, 1, 1]),
+    )
+
+    def decisions(**kw):
+        d = {
+            "assigned_node": np.array([0, 0, 0, 0]),
+            "scheduled_mask": np.zeros(4, dtype=bool),
+            "preempted_mask": np.zeros(4, dtype=bool),
+            "fair_share": np.zeros(4),
+            "demand_capped_fair_share": np.zeros(4),
+            "uncapped_fair_share": np.zeros(4),
+        }
+        d.update(kw)
+        return d
+
+    assert validate_round(decisions(), dev=dev, num_jobs=4) is None
+
+    # Torn gang eviction: one of two members preempted.
+    v = validate_round(
+        decisions(preempted_mask=np.array([True, False, False, False])),
+        dev=dev, num_jobs=4,
+    )
+    assert v is not None and v.invariant == "gang_atomicity", v
+
+    # Torn gang placement... but via the SCHEDULED mask: evict the whole
+    # gang and re-place only half of it.
+    v = validate_round(
+        decisions(
+            preempted_mask=np.array([True, True, False, False]),
+            scheduled_mask=np.array([False, False, True, False]),
+            assigned_node=np.array([0, 0, 1, 0]),
+        ),
+        dev=dev, num_jobs=4,
+    )
+    assert v is None  # gang fully evicted + singleton placed: legal
+
+    # Overstuffed node: both queued singletons land on node 1 (cap 2)
+    # next to nothing evicted — 4 > 2.
+    v = validate_round(
+        decisions(
+            scheduled_mask=np.array([False, False, True, True]),
+            assigned_node=np.array([0, 0, 1, 1]),
+        ),
+        dev=dev, num_jobs=4,
+    )
+    assert v is not None and v.invariant == "node_over_capacity", v
+
+    # The same placement is legal once node 0's gang frees its capacity
+    # on node 0 — and node 1 gets only one newcomer.
+    v = validate_round(
+        decisions(
+            preempted_mask=np.array([True, True, False, False]),
+            scheduled_mask=np.array([False, False, True, True]),
+            assigned_node=np.array([0, 0, 1, 0]),
+        ),
+        dev=dev, num_jobs=4,
+    )
+    assert v is None, v
+
+
+def test_firewall_fairness_ledger_invariant():
+    ok = {"ledger": {"queues": [
+        {"fair_share": 0.5, "delivered_share": 0.5, "regret": 0.0},
+        {"fair_share": 0.5, "delivered_share": 0.4, "regret": 0.1},
+    ]}}
+    assert validate_round(
+        {"assigned_node": np.zeros(0, dtype=int),
+         "scheduled_mask": np.zeros(0, dtype=bool),
+         "preempted_mask": np.zeros(0, dtype=bool),
+         "fair_share": np.zeros(0),
+         "demand_capped_fair_share": np.zeros(0),
+         "uncapped_fair_share": np.zeros(0)},
+        num_jobs=0, fairness=ok,
+    ) is None
+    bad = {"ledger": {"queues": [
+        {"fair_share": float("nan"), "delivered_share": 0.5},
+    ]}}
+    v = validate_round(
+        {"assigned_node": np.zeros(0, dtype=int),
+         "scheduled_mask": np.zeros(0, dtype=bool),
+         "preempted_mask": np.zeros(0, dtype=bool),
+         "fair_share": np.zeros(0),
+         "demand_capped_fair_share": np.zeros(0),
+         "uncapped_fair_share": np.zeros(0)},
+        num_jobs=0, fairness=bad,
+    )
+    assert v is not None and v.invariant == "fairness_ledger", v
+    over = {"ledger": {"queues": [
+        {"delivered_share": 0.7}, {"delivered_share": 0.7},
+    ]}}
+    v = validate_round(
+        {"assigned_node": np.zeros(0, dtype=int),
+         "scheduled_mask": np.zeros(0, dtype=bool),
+         "preempted_mask": np.zeros(0, dtype=bool),
+         "fair_share": np.zeros(0),
+         "demand_capped_fair_share": np.zeros(0),
+         "uncapped_fair_share": np.zeros(0)},
+        num_jobs=0, fairness=over,
+    )
+    assert v is not None and v.invariant == "fairness_ledger", v
+
+
+def test_invariant_names_are_closed():
+    """Every invariant the firewall can emit is declared in INVARIANTS —
+    the metric label set and the postmortem filenames key off it."""
+    assert set(INVARIANTS) == {
+        "nan_inf", "invalid_node", "double_bound", "preemption_victim",
+        "gang_atomicity", "node_over_capacity", "fairness_ledger",
+    }
+
+
+# ------------------------------------------------------- failover ladder
+
+
+def test_build_ladder_shapes():
+    cfg = SchedulingConfig()
+    kernel = build_ladder("kernel", None, cfg)
+    assert [r.label for r in kernel] == ["LOCAL", "hotwindow:64", "oracle"]
+    assert kernel[-1].kind == "oracle"
+    meshed = build_ladder("kernel", "2x4", cfg)
+    assert [r.label for r in meshed] == [
+        "mesh:2x4", "LOCAL", "hotwindow:64", "oracle",
+    ]
+    oracle = build_ladder("oracle", None, cfg)
+    assert [r.label for r in oracle] == ["oracle"]
+    # The degraded-retry rung is a FIXED small window, independent of the
+    # configured hot window: it must re-jit a DIFFERENT program than the
+    # primary, or a poisoned executable would poison the retry too.
+    big = SchedulingConfig(hot_window_slots=4096)
+    assert build_ladder("kernel", None, big)[1].param == 64
+
+
+def test_ladder_breaker_lifecycle():
+    cfg = SchedulingConfig()
+    ladder = FailoverLadder(
+        build_ladder("kernel", None, cfg),
+        failure_threshold=2, cooldown_rounds=3,
+    )
+    live, probes = ladder.plan(0)
+    assert [r.label for r in live] == ["LOCAL", "hotwindow:64", "oracle"]
+    assert probes == []
+    # Two consecutive failures open LOCAL; it leaves the live list.
+    ladder.record_failure("LOCAL", 0)
+    ladder.record_failure("LOCAL", 1)
+    assert ladder.state("LOCAL", 1) == "open"
+    live, probes = ladder.plan(2)
+    assert [r.label for r in live] == ["hotwindow:64", "oracle"]
+    assert probes == []
+    # After the cooldown the rung goes half-open: offered as a SHADOW
+    # probe, still not live.
+    live, probes = ladder.plan(5)
+    assert [r.label for r in live] == ["hotwindow:64", "oracle"]
+    assert [r.label for r in probes] == ["LOCAL"]
+    # A clean probe restores it to the live ladder.
+    ladder.record_success("LOCAL", 5)
+    live, probes = ladder.plan(6)
+    assert [r.label for r in live] == ["LOCAL", "hotwindow:64", "oracle"]
+    assert probes == []
+    # A FAILED probe re-opens for another full cooldown.
+    ladder.record_failure("LOCAL", 6)
+    ladder.record_failure("LOCAL", 7)
+    live, probes = ladder.plan(8)
+    assert [r.label for r in live] == ["hotwindow:64", "oracle"]
+    _, probes = ladder.plan(11)
+    assert [r.label for r in probes] == ["LOCAL"]
+    ladder.record_failure("LOCAL", 11)
+    live, probes = ladder.plan(12)
+    assert [r.label for r in live] == ["hotwindow:64", "oracle"]
+    assert probes == []
+
+
+def test_ladder_terminal_rung_always_offered():
+    """Even with EVERY breaker open — terminal included — the plan still
+    offers the oracle: the ladder can reject a round, never strand it."""
+    cfg = SchedulingConfig()
+    ladder = FailoverLadder(
+        build_ladder("kernel", None, cfg),
+        failure_threshold=1, cooldown_rounds=100,
+    )
+    for rung in ("LOCAL", "hotwindow:64", "oracle"):
+        ladder.record_failure(rung, 0)
+        assert ladder.state(rung, 0) == "open"
+    live, probes = ladder.plan(1)
+    assert [r.label for r in live] == ["oracle"]
+    assert probes == []
+    snap = ladder.snapshot(1)
+    assert [row["terminal"] for row in snap] == [False, False, True]
+    assert all(row["state"] == "open" for row in snap)
+
+
+def test_solve_budget_bounds_failover_retries(monkeypatch):
+    """With the round budget exhausted, a failed primary does NOT walk
+    the rest of the ladder — the round rejects and work stays queued."""
+    import time as _time
+
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.scheduler import SchedulerService
+
+    cfg = SchedulingConfig()
+    sched = SchedulerService(cfg, InMemoryEventLog(), backend="kernel")
+    assert sched.failover is not None
+    calls = []
+
+    def failing_attempt(snap, rung, **kw):
+        calls.append(rung.label)
+        raise RuntimeError("injected solve fault")
+
+    monkeypatch.setattr(sched, "_attempt_round", failing_attempt)
+    snap = types.SimpleNamespace(pool="default")
+
+    # No deadline: every live rung is tried before the round rejects.
+    sched._round_deadline = None
+    assert sched._solve(snap) is None
+    assert calls == ["LOCAL", "hotwindow:64", "oracle"]
+
+    # Deadline already blown: only the primary runs; retries are skipped.
+    calls.clear()
+    sched.failover = FailoverLadder(
+        build_ladder("kernel", None, cfg)
+    )  # fresh breakers
+    sched._round_deadline = _time.monotonic() - 1.0
+    assert sched._solve(snap) is None
+    assert calls == ["LOCAL"]
+
+
+def test_solve_failover_attribution(monkeypatch):
+    """A round that fails over carries {from,to,cause} attribution, and
+    the rejection/failover ledgers the doctor surfaces read are fed."""
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.scheduler import SchedulerService
+
+    cfg = SchedulingConfig()
+    sched = SchedulerService(cfg, InMemoryEventLog(), backend="kernel")
+    sched._round_deadline = None
+
+    def flaky_attempt(snap, rung, **kw):
+        if rung.label == "LOCAL":
+            raise RuntimeError("injected solve fault")
+        return {"scheduled_mask": np.zeros(0, dtype=bool)}
+
+    monkeypatch.setattr(sched, "_attempt_round", flaky_attempt)
+    result = sched._solve(types.SimpleNamespace(pool="default"))
+    assert result is not None
+    assert result["failover"] == {
+        "from": "LOCAL", "to": "hotwindow:64", "cause": "raise",
+    }
+    fo = list(sched.recent_failovers)
+    assert fo and fo[-1]["from"] == "LOCAL"
+    assert fo[-1]["to"] == "hotwindow:64" and fo[-1]["cause"] == "raise"
+    doc = sched.doctor_report()
+    assert doc["failover_enabled"] and doc["validation_enabled"]
+    assert [row["rung"] for row in doc["ladder"]] == [
+        "LOCAL", "hotwindow:64", "oracle",
+    ]
+    assert doc["ladder"][0]["consecutive_failures"] == 1

@@ -20,8 +20,14 @@ slowdown hidden by a faster host phase, a gather/scatter blowup from a
 bad window) gates even when the end-to-end number still squeaks under
 the threshold. A metric regresses when current > baseline * threshold;
 a metric missing on either side is reported but never gates (old
-artifacts predate burst_50k and the segment profile). Exits 1 on
-regression, 2 when no comparable baseline exists, 0 otherwise.
+artifacts predate burst_50k and the segment profile).
+
+One gate is ABSOLUTE (needs no baseline): the round admission
+firewall's host-side invariant sweep (extra.validate_s, timed by
+bench.py outside the measured cycle) must cost under 5% of the
+headline solve time — the firewall runs before every committed round,
+so its cost taxes the whole control loop. Exits 1 on regression, 2
+when no comparable baseline exists, 0 otherwise.
 """
 
 from __future__ import annotations
@@ -65,6 +71,8 @@ GATED = ("warm", "tracking", "burst", "pass1", "gather")
 # increase — zero compiles IS the warm steady state, so one compile
 # sneaking into a warm cycle is a regression however fast it was.
 GATED_TRANSFER = ("bytes_up", "bytes_down", "compiles")
+# Absolute ceiling on the admission firewall's share of solve time.
+VALIDATE_FRAC_LIMIT = 0.05
 
 
 def extract_metrics(result: dict | None) -> dict:
@@ -143,6 +151,36 @@ def gate(current: dict, baseline: dict, threshold: float) -> tuple[list, list]:
     return regressions, notes
 
 
+def absolute_gate(result: dict | None) -> tuple[list, list]:
+    """(regressions, notes) for baseline-free gates on the CURRENT
+    artifact alone. validate_frac: extra.validate_s over extra.solve_s
+    must stay under VALIDATE_FRAC_LIMIT. Missing fields never gate
+    (artifacts predate the firewall round)."""
+    regressions, notes = [], []
+    extra = result.get("extra") if isinstance(result, dict) else None
+    if not isinstance(extra, dict):
+        return regressions, notes
+    val, solve = extra.get("validate_s"), extra.get("solve_s")
+    if not isinstance(val, (int, float)) or not isinstance(
+        solve, (int, float)
+    ) or solve <= 0:
+        notes.append(
+            "validate_frac: not comparable "
+            f"(validate_s={val} solve_s={solve})"
+        )
+        return regressions, notes
+    frac = val / solve
+    line = (
+        f"validate_frac: validate {val:.4f}s / solve {solve:.4f}s = "
+        f"{frac:.3f} (limit {VALIDATE_FRAC_LIMIT})"
+    )
+    if frac > VALIDATE_FRAC_LIMIT:
+        regressions.append(line)
+    else:
+        notes.append("OK " + line)
+    return regressions, notes
+
+
 def _round_num(path: str) -> int:
     m = re.search(r"BENCH_r(\d+)\.json$", path)
     return int(m.group(1)) if m else -1
@@ -197,6 +235,9 @@ def main(argv=None) -> int:
         print("bench_gate: no usable BENCH_r*.json baseline found")
         return 2
     regressions, notes = gate(current, baseline, args.threshold)
+    abs_regressions, abs_notes = absolute_gate(parse_artifact(doc))
+    regressions += abs_regressions
+    notes += abs_notes
     print(f"baseline: {os.path.basename(base_path)}")
     for line in notes:
         print(line)

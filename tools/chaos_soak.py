@@ -23,6 +23,14 @@ workload includes gang waves so partitions land during gang placement.
 Usage:
   python tools/chaos_soak.py [--plans 20] [--backend oracle]
                              [--jobs 40] [--no-determinism-check]
+  python tools/chaos_soak.py --solver-faults --plans 3 --jobs 24
+
+--solver-faults switches to the self-healing-solve-path soak (kernel
+backend): seeded windows of solver_raise / solver_hang /
+solver_nan_poison / solver_wrong_placement over live rounds, asserting
+every fault fired and was contained (no invalid round committed, all
+jobs terminal), every rejection left a loadable .atrace postmortem that
+replays DIVERGED offline, and the run is seed-deterministic.
 
 Exit code 0 = clean soak; prints one JSON line per plan and a summary.
 """
@@ -291,6 +299,208 @@ def run_plan(seed: int, backend: str = "oracle", n_jobs: int = 40,
             tmp.cleanup()
 
 
+# ------------------------------------------------- solver-fault soak mode
+
+def build_solver_sim(seed: int, n_jobs: int, data_dir: str):
+    """Kernel-backend sim under a solver-fault plan: each fault kind the
+    self-healing solve path contains (services/chaos.SOLVER_FAULT_KINDS)
+    gets its own window over cycles where backlogged work guarantees a
+    live solve. One small cluster, multi-wave backlog (jobs >> cores, 60s+
+    runtimes) so rounds keep solving through every window:
+
+      - solver_hang over the first wave's backlog: the primary rung
+        fails over same-cycle;
+      - solver_raise with count=9 on "*": every rung raises for 3
+        consecutive rounds, opening the non-terminal circuit breakers
+        (threshold 3) — rounds land on the oracle terminal rung (always
+        offered, open breaker or not) until the cooldown's shadow probe
+        restores the ladder;
+      - solver_nan_poison / solver_wrong_placement on later waves: the
+        admission firewall rejects the poisoned round on each corrupted
+        rung (nothing commits, work requeues) and quarantines a
+        single-round .atrace postmortem under data_dir/quarantine.
+    """
+    from armada_tpu.core.config import SchedulingConfig
+    from armada_tpu.services.chaos import FaultPlan, FaultSpec
+    from armada_tpu.sim.simulator import (
+        ClusterSpec,
+        JobTemplate,
+        NodeTemplate,
+        QueueSpecSim,
+        ShiftedExponential,
+        Simulator,
+        WorkloadSpec,
+    )
+
+    j = (seed % 4) * 5.0  # per-seed window jitter: vary the hit rounds
+    faults = (
+        FaultSpec("solver_hang", "*", start=12.0 + j, duration=40.0,
+                  count=1),
+        FaultSpec("solver_raise", "*", start=102.0 + j, duration=40.0,
+                  count=9),
+        FaultSpec("solver_nan_poison", "*", start=202.0 + j, duration=40.0,
+                  count=2),
+        FaultSpec("solver_wrong_placement", "*", start=302.0 + j,
+                  duration=40.0, count=2),
+    )
+    plan = FaultPlan(faults, seed=seed)
+    config = SchedulingConfig(
+        enable_assertions=True,
+        solver_validate=True,
+        solver_failover=True,
+        max_retries=10,
+    )
+    clusters = [
+        ClusterSpec(
+            name="solver-c0",
+            node_templates=(NodeTemplate(count=1, cpu="8", memory="64Gi"),),
+        )
+    ]
+    waves = 4
+    per_wave = max(2, n_jobs // waves)
+    workload = WorkloadSpec(
+        queues=(
+            QueueSpecSim(
+                name="q0",
+                job_templates=tuple(
+                    JobTemplate(
+                        id=f"sw{w}",
+                        number=per_wave,
+                        cpu="2",
+                        memory="4Gi",
+                        runtime=ShiftedExponential(minimum=60.0,
+                                                   tail_mean=30.0),
+                        submit_time=w * 100.0,
+                    )
+                    for w in range(waves)
+                ),
+            ),
+        )
+    )
+    return Simulator(
+        clusters,
+        workload,
+        config,
+        backend="kernel",
+        seed=seed,
+        cycle_interval=10.0,
+        max_time=2 * 3600.0,
+        fault_plan=plan,
+        data_dir=data_dir,
+    ), plan
+
+
+def run_solver_plan(seed: int, n_jobs: int = 24, replay: bool = True) -> dict:
+    """One solver-fault soak iteration; raises when containment failed:
+    a planned fault kind never fired, an invariant violation committed
+    (jobdb assert_valid / double-active-run sweep), a job never reached
+    a terminal state, a rejection has no loadable postmortem bundle, or
+    (with replay=True) a quarantined round replays CLEAN under a healthy
+    solver — the bundle must reproduce the corruption offline as a
+    placement divergence."""
+    from armada_tpu.services.chaos import SOLVER_FAULT_KINDS
+
+    with tempfile.TemporaryDirectory(
+        prefix=f"chaos-solver-{seed}-"
+    ) as data_dir:
+        sim, plan = build_solver_sim(seed, n_jobs, data_dir)
+        result = sim.run()
+        txn = sim.scheduler.jobdb.read_txn()
+        txn.assert_valid()
+        from armada_tpu.jobdb.jobdb import RunState
+
+        live = (RunState.LEASED, RunState.PENDING, RunState.RUNNING)
+        for job in txn.all_jobs():
+            active = [r.id for r in job.runs if r.state in live]
+            if len(active) > 1:
+                raise AssertionError(
+                    f"seed {seed}: job {job.id} holds two active runs "
+                    f"{active} after the solver-fault soak"
+                )
+        unfinished = result.total_jobs - sum(
+            1 for s in result.events_by_job.values() if s.terminal
+        )
+        if unfinished:
+            raise AssertionError(
+                f"seed {seed}: {unfinished}/{result.total_jobs} jobs never "
+                "reached a terminal state under solver faults"
+            )
+        chaos = sim.scheduler.solver_chaos
+        injected = dict(chaos.injected) if chaos is not None else {}
+        for kind in SOLVER_FAULT_KINDS:
+            if not injected.get(kind):
+                raise AssertionError(
+                    f"seed {seed}: planned fault {kind} never fired "
+                    f"(injected={injected}) — the plan windows missed "
+                    "every live solve"
+                )
+        rejections = list(sim.scheduler.recent_rejections)
+        if not rejections:
+            raise AssertionError(
+                f"seed {seed}: corruption faults fired but the admission "
+                "firewall rejected nothing"
+            )
+        failovers = list(sim.scheduler.recent_failovers)
+        if not failovers:
+            raise AssertionError(
+                f"seed {seed}: solver faults fired but no failover was "
+                "recorded"
+            )
+        replayed = 0
+        for rej in rejections:
+            bundle = rej.get("bundle")
+            if not bundle or not os.path.exists(bundle):
+                raise AssertionError(
+                    f"seed {seed}: rejection {rej['invariant']} on "
+                    f"{rej['rung']} (cycle {rej['cycle']}) has no "
+                    f"postmortem bundle at {bundle!r}"
+                )
+            from armada_tpu.trace import load_trace, replay_trace
+
+            trace = load_trace(bundle)
+            if not replay:
+                continue
+            # The quarantined round must reproduce its corruption
+            # offline: a healthy LOCAL replay of the recorded (poisoned)
+            # decisions diverges on placement. The recording process IS
+            # this process, so no target/x64 mismatch arises.
+            report = replay_trace(
+                trace, solvers=["LOCAL"], log=lambda msg: None
+            )
+            if not report["divergences"].get("placement"):
+                raise AssertionError(
+                    f"seed {seed}: quarantined round {os.path.basename(bundle)} "
+                    "replayed CLEAN — the bundle does not reproduce the "
+                    f"corruption (divergences={report['divergences']})"
+                )
+            replayed += 1
+        ladder = (
+            sim.scheduler.doctor_report().get("ladder")
+            if hasattr(sim.scheduler, "doctor_report")
+            else None
+        )
+        return {
+            "seed": seed,
+            "mode": "solver-faults",
+            "digest": jobdb_digest(sim),
+            "finished": result.finished_jobs,
+            "total": result.total_jobs,
+            "cycles": result.cycles,
+            "makespan": round(result.makespan, 1),
+            "injected": injected,
+            "rejections": [
+                {k: rej[k] for k in ("cycle", "rung", "invariant")}
+                for rej in rejections
+            ],
+            "failovers": [
+                {k: fo[k] for k in ("cycle", "from", "to", "cause")}
+                for fo in failovers
+            ],
+            "bundles_replayed": replayed,
+            "ladder": ladder,
+        }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="chaos-soak")
     ap.add_argument("--plans", type=int, default=20)
@@ -298,6 +508,12 @@ def main(argv=None) -> int:
                     choices=["oracle", "kernel"])
     ap.add_argument("--jobs", type=int, default=40)
     ap.add_argument("--no-determinism-check", action="store_true")
+    ap.add_argument("--solver-faults", action="store_true",
+                    help="run the solver-fault soak instead (kernel "
+                    "backend, solver_raise/hang/nan_poison/"
+                    "wrong_placement windows; asserts containment, "
+                    "quarantine bundles, and offline replay — use with "
+                    "--plans 3 and --jobs 24)")
     ap.add_argument("--slo", action="store_true",
                     help="gate each plan on the soak's declared SLOs "
                     "(services/slo.py): real-wall round latency and "
@@ -314,6 +530,20 @@ def main(argv=None) -> int:
     failures = 0
     for seed in range(args.plans):
         try:
+            if args.solver_faults:
+                first = run_solver_plan(seed, args.jobs)
+                if not args.no_determinism_check:
+                    # Replay already proved the bundles diverge on the
+                    # first run; the determinism pass only needs digests.
+                    second = run_solver_plan(seed, args.jobs, replay=False)
+                    if first["digest"] != second["digest"]:
+                        raise AssertionError(
+                            f"seed {seed}: nondeterministic final jobdb "
+                            f"({first['digest'][:12]} != "
+                            f"{second['digest'][:12]})"
+                        )
+                print(json.dumps(first))
+                continue
             first = run_plan(seed, args.backend, args.jobs, slos=slos)
             if not args.no_determinism_check:
                 second = run_plan(seed, args.backend, args.jobs, slos=slos)
