@@ -294,6 +294,12 @@ class SchedulingConfig:
     solver_failover_threshold: int = 3
     solver_failover_cooldown_rounds: int = 8
     quarantine_dir: str = ""
+    # Device-resident round state (snapshot/residency.py): every N-th
+    # cycle a pool running in "resident" snapshot mode byte-compares its
+    # persistent device buffers against the host mirror and resets the
+    # resident state on drift (a new `resident_drift` counter fires).
+    # 0 disables the sweep.
+    resident_drift_check_every: int = 64
     # Store backpressure (common/etcdhealth re-targeted at the event log;
     # services/backpressure.py): reject submissions and pause executor pod
     # creation when the log's disk footprint exceeds this fraction of the
@@ -589,6 +595,11 @@ class SchedulingConfig:
                 int,
             ),
             ("quarantineDir", "quarantine_dir", str),
+            (
+                "residentDriftCheckEvery",
+                "resident_drift_check_every",
+                int,
+            ),
             (
                 "maxUnacknowledgedJobsPerExecutor",
                 "max_unacknowledged_jobs_per_executor",

@@ -143,6 +143,26 @@ class SchedulerMetrics:
             ["pool"],
             registry=r,
         )
+        # Device-resident round state (snapshot/residency.py): which
+        # snapshot strategy each pool round actually used, so residency
+        # engagement (and per-pool demotions back to rebuild) is
+        # observable; and the live drift guard behind the
+        # resident_drift divergence kind.
+        self.snapshot_mode_total = Counter(
+            "scheduler_snapshot_mode_total",
+            "Pool rounds by the snapshot strategy actually used",
+            ["pool", "mode"],
+            registry=r,
+        )
+        self.resident_drift = Counter(
+            "scheduler_resident_drift_total",
+            "Device-resident round buffers found drifted from the host "
+            "mirror (the resident state was reset and re-uploads next "
+            "cycle; the already-committed round was validated against "
+            "the mirror by the admission firewall)",
+            ["pool"],
+            registry=r,
+        )
         self.solve_loops = Gauge(
             "scheduler_solve_loops",
             "while-loop iterations of the last device solve",

@@ -79,12 +79,22 @@ class SchedulerService:
         self.mesh = mesh
         self._sharded_run = None
         # Snapshot strategy: "auto" uses incremental O(delta) cycles when
-        # eligible (kernel backend, single pool, no market/away);
-        # "rebuild" always rebuilds; "incremental" forces eligibility
-        # checks only (still falls back per cycle on structure changes).
+        # eligible (kernel backend, no market/away) and keeps the padded
+        # round device-resident across warm cycles (snapshot/residency.py)
+        # on single-device solves; "resident" is the same engagement
+        # spelled explicitly; "incremental" keeps the O(delta) host state
+        # but re-uploads every cycle (no device residency); "rebuild"
+        # always rebuilds. A pool that cannot run incrementally this
+        # cycle (exclude/pending-leases, structure change) demotes to
+        # rebuild for THAT cycle only — the resident device state
+        # survives and resyncs by delta on re-engagement.
         self.snapshot_mode = snapshot_mode
         self._inc_state: dict = {}
         self._cycle_incremental_ok = False
+        # pool -> snapshot.residency.ResidentRound (device-resident
+        # padded round + owned host mirror), kept outside _inc_state so
+        # an incremental rebuild does not discard warm device buffers.
+        self._resident: dict = {}
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
         self.priority_overrides: dict[str, float] = {}
         self.cordoned_queues: set[str] = set()
@@ -1287,12 +1297,39 @@ class SchedulerService:
                 global_rate_tokens=g_tokens,
                 queue_rate_tokens=q_tokens,
             )
+        # Device-resident round state (snapshot/residency.py): keep the
+        # padded DeviceRound on device across warm cycles and delta-sync
+        # it in _attempt_round. Mesh solves re-pad and re-place the node
+        # axis per round, so residency engages on single-device solves
+        # only; "incremental" mode keeps the legacy re-upload path. A
+        # cycle that demoted to rebuild (inc is None) keeps the resident
+        # buffers — the next incremental cycle resyncs them by delta.
+        use_resident = (
+            inc is not None
+            and self.mesh is None
+            and self.snapshot_mode in ("auto", "resident")
+        )
+        if self.snapshot_mode not in ("auto", "resident") or self.mesh is not None:
+            self._resident.pop(pool, None)
+        elif use_resident and pool not in self._resident:
+            from ..snapshot.residency import ResidentRound
+
+            self._resident[pool] = ResidentRound()
+        snapshot_mode_used = (
+            "resident" if use_resident
+            else ("incremental" if inc is not None else "rebuild")
+        )
         if self.metrics is not None and self.metrics.registry is not None:
             self.metrics.snapshot_build_seconds.labels(pool=pool).observe(
                 _time.monotonic() - t_build
             )
+            self.metrics.snapshot_mode_total.labels(
+                pool=pool, mode=snapshot_mode_used
+            ).inc()
         solve_started = _time.time()
         result = self._solve(snap, inc=inc)
+        if use_resident:
+            self._maybe_check_resident_drift(pool)
         if result is None:
             # The admission firewall rejected every usable rung's round
             # (or the ladder ran out of budget): NOTHING commits this
@@ -1737,14 +1774,16 @@ class SchedulerService:
     # ------------------------------------------------------------------
 
     def _incremental_eligible(self, pools) -> bool:
-        """v1 scope: the flagship single-pool kernel configuration. Market
-        mode re-prices existing queued specs in place (bid refresh), and
-        cross-pool away classification depends on multi-pool run state —
-        both use the rebuild path."""
+        """Kernel-backend rounds run incrementally per pool (each pool
+        keeps its own _inc_state; a pool that cannot — cross-pool
+        exclude set, pending leases, structure change — demotes to
+        rebuild for that cycle only). Market mode re-prices existing
+        queued specs in place (bid refresh), and cross-pool away
+        classification depends on multi-pool run state — both use the
+        rebuild path."""
         return (
             self.backend == "kernel"
             and self.snapshot_mode != "rebuild"
-            and len(pools) == 1
             and not self.config.market_driven
             and not any(p.away_pools for p in self.config.pools)
         )
@@ -1993,6 +2032,37 @@ class SchedulerService:
         if self._round_deadline is None:
             return None
         return max(1e-9, self._round_deadline - _time.monotonic())
+
+    def _maybe_check_resident_drift(self, pool: str) -> None:
+        """Periodic integrity sweep of the pool's device-resident round
+        buffers: byte-compare every device leaf against the host mirror
+        (a d2h pull of the whole tree — cheap relative to cadence). On
+        drift the resident state is reset so the next cycle re-uploads
+        from scratch; the already-committed round is safe either way
+        because the admission firewall validated it against the host
+        mirror, which is authoritative. Advisory: a check failure must
+        never fail the round."""
+        resident = self._resident.get(pool)
+        if resident is None or not resident.last_sync:
+            return
+        every = int(getattr(self.config, "resident_drift_check_every", 0) or 0)
+        if every <= 0 or self.cycle_count % every != 0:
+            return
+        try:
+            drifted = resident.check_drift()
+        except Exception as e:  # noqa: BLE001 - advisory path
+            self.log_.with_fields(pool=pool).error(
+                "resident drift check failed: %r", e
+            )
+            return
+        if not drifted:
+            return
+        self.log_.with_fields(
+            pool=pool, cycle=self.cycle_count, fields=",".join(drifted),
+        ).error("device-resident round drifted from host mirror; resetting")
+        if self.metrics is not None and self.metrics.registry is not None:
+            self.metrics.resident_drift.labels(pool=pool).inc()
+        resident.reset()
 
     def _solve(self, snap, inc=None, fairness=True, guard=True):
         """Solve one round, guarded by the self-healing solve path:
@@ -2268,10 +2338,25 @@ class SchedulerService:
 
             import numpy as np
 
-            if inc is not None:
-                dev = pad_device_round(inc.device_round())
+            # Device-resident path (snapshot/residency.py): the pool's
+            # persistent device buffers are delta-synced inside the round
+            # ledger below so the (delta-sized) upload books against this
+            # round; every host-side consumer downstream — admission
+            # firewall, fairness ledger, recorder, postmortem — reads the
+            # host mirror (dev_host) so nothing pulls the resident tree
+            # back to host. The mesh rung re-pads and re-places the node
+            # axis per round, so it always takes the legacy prep.
+            resident = (
+                self._resident.get(snap.pool)
+                if inc is not None and rung.kind != "mesh"
+                else None
+            )
+            if resident is not None:
+                dev = dev_host = None  # synced inside the round ledger
+            elif inc is not None:
+                dev = dev_host = pad_device_round(inc.device_round())
             else:
-                dev = pad_device_round(prep_device_round(snap))
+                dev = dev_host = pad_device_round(prep_device_round(snap))
             import time as _t
 
             from ..observe import ledger as _tledger
@@ -2291,6 +2376,9 @@ class SchedulerService:
             _xla.install()
             _comp0 = _xla.thread_snapshot()
             with _tledger.round_ledger() as _led:
+                if resident is not None:
+                    dev = resident.device_round(inc)
+                    dev_host = resident.host_round()
                 if rung.kind == "mesh":
                     # The sharded solve is one fused program; the budget is
                     # enforced between pools only (chunked pass 1 is
@@ -2353,6 +2441,7 @@ class SchedulerService:
                         "window": int(window or 0),
                         "budget": bool(budget_s),
                         "autotuned": tuned is not None,
+                        "resident": resident is not None,
                     }
             truncated = bool(out.get("truncated", False))
             # Materialize the decisions on host: the admission firewall,
@@ -2389,7 +2478,7 @@ class SchedulerService:
                     from ..observe.fairness import ledger_from_device_round
 
                     fairness_block = ledger_from_device_round(
-                        dev, out, snap.num_jobs, snap.num_queues
+                        dev_host, out, snap.num_jobs, snap.num_queues
                     )
                 except Exception as e:  # noqa: BLE001 - advisory path
                     self.log_.with_fields(pool=snap.pool).error(
@@ -2405,13 +2494,15 @@ class SchedulerService:
                 from ..solver.validate import RoundRejected, validate_round
 
                 t_v = _t.monotonic()
-                violation = validate_round(out, dev=dev, fairness=fairness_block)
+                violation = validate_round(
+                    out, dev=dev_host, fairness=fairness_block
+                )
                 cost_profile["validate_s"] = round(_t.monotonic() - t_v, 6)
                 if violation is not None:
                     bundle = None
                     if not shadow:
                         bundle = self._capture_postmortem(
-                            snap, dev, out, violation=violation, rung=rung
+                            snap, dev_host, out, violation=violation, rung=rung
                         )
                     raise RoundRejected(violation, bundle)
             if "profile" in out:
@@ -2421,7 +2512,7 @@ class SchedulerService:
                 if self.trace_recorder is not None:
                     self._trace_round(
                         snap,
-                        dev,
+                        dev_host,
                         out,
                         solver=solver_info,
                         truncated=truncated,

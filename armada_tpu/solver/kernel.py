@@ -2221,6 +2221,14 @@ def solve_round(
     host-driven run attaches out["profile"]: wall clock per solve
     segment (setup / pass-1 / gather+scatter / finish) and pass-1 loop
     counts by kind (gang / fill / merged-fill), plus rewindow counts.
+
+    Device-resident inputs (snapshot/residency.py): `dev` may arrive
+    with leaves already on device. Both paths keep the ledger honest —
+    `note_up` books host (numpy) leaves only, so an already-resident
+    tree books ZERO upload here, and `jax.device_put` below is a no-op
+    for committed device arrays. Neither path donates `dev` (only the
+    pass-1 carries are donated), so the resident buffers survive the
+    solve and the next cycle delta-syncs them in place.
     """
     from ..observe import ledger as _tledger
 

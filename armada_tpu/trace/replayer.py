@@ -32,6 +32,14 @@ classify as:
                        solver (observe/xla.py telemetry): a warm cycle
                        must dispatch cached executables, so any compile
                        here is the silent-warm-recompile failure mode
+  resident_drift     — two-bundle differential only (`diff_traces`): the
+                       same scenario recorded under rebuild and under
+                       device-resident snapshot mode
+                       (snapshot/residency.py) disagrees — a solver
+                       input leaf, a decision array, or the fairness
+                       block differs between the paired rounds, meaning
+                       the delta-applied resident round drifted from
+                       the rebuilt-from-jobdb truth
 
 Replay REFUSES a bundle whose target signature (host CPU features,
 effective XLA target, x64 mode) differs from this process unless
@@ -339,6 +347,155 @@ def compare_round(rec: RoundRecord, out: dict, *, compare_loops: bool | None = N
                 }
             )
     return divergences
+
+
+def _diff_device_rounds(dev_a, dev_b) -> list[str]:
+    """Field names (with a short detail) where two padded DeviceRounds
+    are not bit-identical. NaNs compare by bits, so a NaN payload equal
+    on both sides does NOT read as drift."""
+    diffs = []
+    for f in dataclasses.fields(dev_a):
+        a, b = getattr(dev_a, f.name), getattr(dev_b, f.name)
+        if hasattr(a, "shape") or hasattr(b, "shape"):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.shape != b.shape or a.dtype != b.dtype:
+                diffs.append(
+                    f"{f.name}: {a.dtype}{a.shape} != {b.dtype}{b.shape}"
+                )
+                continue
+            ab, bb = a.tobytes(), b.tobytes()
+            if ab != bb:
+                raw = np.flatnonzero(
+                    np.frombuffer(ab, np.uint8) != np.frombuffer(bb, np.uint8)
+                )
+                first = int(raw[0] // max(1, a.itemsize))
+                diffs.append(f"{f.name}: first differing element flat[{first}]")
+        else:
+            same = a == b
+            try:
+                same = bool(same) or (np.isnan(a) and np.isnan(b))
+            except (TypeError, ValueError):
+                same = bool(same)
+            if not same:
+                diffs.append(f"{f.name}: {a!r} != {b!r}")
+    return diffs
+
+
+def diff_traces(
+    trace_a: Trace,
+    trace_b: Trace,
+    *,
+    max_rounds: int | None = None,
+    log=None,
+) -> dict:
+    """Two-bundle differential: pair rounds of two recordings of the
+    SAME scenario by (pool, cycle) and diff each pair bit-for-bit —
+    every DeviceRound leaf the solver consumed, the unpadded decision
+    stream (including num_loops and spot_price), and the fairness
+    block. The intended use is the residency correctness gate: record
+    one run with `snapshot_mode="incremental"` (rebuild/re-upload every
+    cycle) and one with `snapshot_mode="resident"` (delta scatter
+    updates into persistent device buffers); any difference means the
+    delta-applied round drifted from the rebuilt truth and classifies
+    as `resident_drift`. Rounds present in only one bundle are listed
+    under "unmatched" and fail the gate too — a cycle that solved under
+    one mode but not the other is itself a divergence.
+
+    Returns {"pairs", "unmatched", "results", "divergences", "ok"}.
+    """
+    import json
+
+    def index(trace):
+        by_key = {}
+        for rec in trace.rounds:
+            cyc = rec.raw.get("cycle")
+            key = (rec.pool, cyc if cyc is not None else rec.raw.get("i"))
+            by_key.setdefault(key, []).append(rec)
+        return by_key
+
+    a_idx, b_idx = index(trace_a), index(trace_b)
+    unmatched = sorted(
+        f"{pool}@cycle={cyc}"
+        for pool, cyc in set(a_idx) ^ set(b_idx)
+    )
+    results = []
+    by_kind: dict[str, int] = {}
+    pairs = 0
+    for key in sorted(set(a_idx) & set(b_idx), key=lambda k: (str(k[0]), str(k[1]))):
+        for rec_a, rec_b in zip(a_idx[key], b_idx[key]):
+            if max_rounds is not None and pairs >= max_rounds:
+                break
+            pairs += 1
+            divergences = []
+            for d in _diff_device_rounds(rec_a.device_round(), rec_b.device_round()):
+                divergences.append(
+                    {
+                        "kind": "resident_drift",
+                        "key": "dev",
+                        "detail": f"solver input differs: {d}",
+                    }
+                )
+            dec_a, dec_b = rec_a.decisions(), rec_b.decisions()
+            J, Q = rec_a.num_jobs, rec_a.num_queues
+            for dk in _JOB_KEYS + _QUEUE_KEYS + ("num_loops", "spot_price"):
+                if dk not in dec_a or dk not in dec_b:
+                    if dk in dec_a or dk in dec_b:
+                        divergences.append(
+                            {
+                                "kind": "resident_drift",
+                                "key": dk,
+                                "detail": f"{dk} recorded in one bundle only",
+                            }
+                        )
+                    continue
+                n = J if dk in _JOB_KEYS else Q if dk in _QUEUE_KEYS else None
+                want = np.asarray(dec_a[dk])[:n] if n else np.asarray(dec_a[dk])
+                got = np.asarray(dec_b[dk])[:n] if n else np.asarray(dec_b[dk])
+                if not np.array_equal(want, got, equal_nan=True):
+                    divergences.append(
+                        {
+                            "kind": "resident_drift",
+                            "key": dk,
+                            "detail": f"decision {dk} differs at indices "
+                            f"{_first_diffs(want, got)}",
+                        }
+                    )
+            fair_a = json.loads(json.dumps(rec_a.raw.get("fairness"), sort_keys=True))
+            fair_b = json.loads(json.dumps(rec_b.raw.get("fairness"), sort_keys=True))
+            if fair_a != fair_b:
+                divergences.append(
+                    {
+                        "kind": "resident_drift",
+                        "key": "fairness",
+                        "detail": "fairness ledger differs between bundles",
+                    }
+                )
+            for d in divergences:
+                by_kind[d["kind"]] = by_kind.get(d["kind"], 0) + 1
+            results.append(
+                {
+                    "pool": key[0],
+                    "cycle": key[1],
+                    "solver_a": rec_a.raw.get("solver"),
+                    "solver_b": rec_b.raw.get("solver"),
+                    "divergences": divergences,
+                }
+            )
+            if log:
+                status = "OK" if not divergences else (
+                    "DRIFT " + "; ".join(d["detail"] for d in divergences)
+                )
+                log(f"pool={key[0]} cycle={key[1]}: {status}")
+    ok = not by_kind and not unmatched
+    return {
+        "trace_a": trace_a.path,
+        "trace_b": trace_b.path,
+        "pairs": pairs,
+        "unmatched": unmatched,
+        "results": results,
+        "divergences": by_kind,
+        "ok": ok,
+    }
 
 
 def replay_trace(

@@ -309,8 +309,24 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
     inc = IncrementalRound(*inputs)
     setup_s = time.time() - t_setup
 
+    # Device-resident round state (armada_tpu/snapshot/residency.py):
+    # the default warm cycle keeps the padded DeviceRound on device and
+    # delta-syncs it, the way the scheduler's "resident" snapshot mode
+    # runs. BENCH_RESIDENT=0 restores the legacy re-upload-every-cycle
+    # path (the before/after axis for the transfer ledger). The sharded
+    # solve re-pads and re-places the node axis per round, so mesh runs
+    # always re-upload.
+    resident = None
+    if sharded is None and os.environ.get("BENCH_RESIDENT", "1") not in ("0", "false"):
+        from armada_tpu.snapshot.residency import ResidentRound
+
+        resident = ResidentRound()
+
     t0 = time.time()
-    dev = _put(pad_device_round(inc.device_round()))
+    if resident is not None:
+        dev = resident.device_round(inc)  # full reset upload, cold
+    else:
+        dev = _put(pad_device_round(inc.device_round()))
     h2d_cold_s = time.time() - t0
     t0 = time.time()
     out = solve_round(dev)  # compile + run on the padded flagship shape
@@ -361,12 +377,21 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
             inc.add_jobs(new_jobs)
             delta_s = time.time() - t0
             t0 = time.time()
-            dev = inc.device_round()
-            prep_s = time.time() - t0
-            t0 = time.time()
-            dev_h = pad_device_round(dev)
-            dev = _put(dev_h)
-            h2d_s = time.time() - t0
+            if resident is not None:
+                # Delta sync into the persistent device buffers: prep
+                # (inc.device_round), diff against the host mirror, and
+                # the scatter upload are one fused step, booked as h2d.
+                dev = resident.device_round(inc)
+                dev_h = resident.host_round()
+                prep_s = 0.0
+                h2d_s = time.time() - t0
+            else:
+                dev = inc.device_round()
+                prep_s = time.time() - t0
+                t0 = time.time()
+                dev_h = pad_device_round(dev)
+                dev = _put(dev_h)
+                h2d_s = time.time() - t0
             t0 = time.time()
             out = solve_round(dev)
             solve_s = time.time() - t0
@@ -458,6 +483,17 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
     # The reported component breakdown comes from the median-cycle sample
     # (closest to the reported headline), spread from all samples.
     rep = min(samples, key=lambda s: abs(s["cycle_s"] - median))
+    residency_extra = {}
+    if resident is not None and resident.last_sync:
+        # Self-describing artifact: which snapshot path produced the
+        # headline (resident delta vs full reset) and the warm upload it
+        # booked — tools/bench_trend.py shows this as the residency
+        # column, tools/bench_gate.py holds bytes_up under the budget.
+        residency_extra["residency"] = {
+            "mode": str(resident.last_sync.get("mode")),
+            "bytes_up": (rep.get("transfer") or {}).get("bytes_up"),
+            "permuted": bool(resident.last_sync.get("permuted")),
+        }
     mesh_extra = {}
     if sharded is not None:
         shape = sharded.mesh_shape
@@ -487,7 +523,8 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
         dev_np = pad_device_round(inc.device_round())
         out_rec = solve_round(_put(dev_np))
         solver_info = {"backend": "kernel", "mesh": str(mesh) if mesh else None,
-                       "window": hot_window or 0, "budget": bool(budget_s)}
+                       "window": hot_window or 0, "budget": bool(budget_s),
+                       "resident": resident is not None}
         with TraceRecorder(
             trace_path, source="bench", config=inputs[0],
             seeds={"workload_seed": 0},
@@ -525,6 +562,7 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
         **trace_extra,
         **params_extra,
         **fairness_extra,
+        **residency_extra,
         "cycle_s": round(median, 4),
         **{k: v for k, v in rep.items() if k != "cycle_s"},
         "warm_cycles_measured": len(times),

@@ -16,6 +16,7 @@ from bench_gate import (  # noqa: E402
     gate,
     latest_baseline,
     parse_artifact,
+    residency_gate,
 )
 
 NEW_SCHEMA = {
@@ -206,7 +207,7 @@ def test_trend_tolerates_and_shows_whatif_block(tmp_path):
     assert "whatif" in proc.stdout
     lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
     assert "3@0.42s" in lines["BENCH_r02.json"]
-    assert lines["BENCH_r03.json"].split()[-4] == "yes"  # whatif column
+    assert lines["BENCH_r03.json"].split()[-5] == "yes"  # whatif column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_whatif))["warm"] == 3.0
 
@@ -243,7 +244,7 @@ def test_trend_tolerates_and_shows_frontdoor_block(tmp_path):
     assert lines["BENCH_r01.json"].rstrip().endswith("-")
     assert "17ms/13" in lines["BENCH_r02.json"]
     assert "300ms/5000!" in lines["BENCH_r03.json"]
-    assert lines["BENCH_r04.json"].split()[-3] == "yes"  # frontdoor column
+    assert lines["BENCH_r04.json"].split()[-4] == "yes"  # frontdoor column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_fd))["warm"] == 3.0
 
@@ -330,6 +331,80 @@ def test_trend_shows_transfer_column(tmp_path):
     assert lines["BENCH_r01.json"].rstrip().endswith("-")
     assert "2.0K/512B,c0" in lines["BENCH_r02.json"]
     assert "3.0G/5.0M,c2" in lines["BENCH_r03.json"]
+
+
+def test_residency_budget_gate(tmp_path):
+    """--residency-budget-mb is an ABSOLUTE gate on the warm cycle's
+    booked upload: under budget passes, over budget regresses, and —
+    because passing the flag asserts residency is measured — a current
+    artifact with no extra.transfer.bytes_up regresses too. Without the
+    flag the gate is inert on every schema."""
+    parsed = parse_artifact(NEW_SCHEMA)  # bytes_up: 2048
+    regressions, notes = residency_gate(parsed, None)
+    assert not regressions and not notes
+    regressions, notes = residency_gate(parsed, 1.0)
+    assert not regressions and sum("OK residency" in n for n in notes) == 1
+    regressions, _ = residency_gate(parsed, 0.001)  # 2048B > 0.001MB
+    assert len(regressions) == 1 and regressions[0].startswith("residency")
+    # Artifact that cannot prove its upload size fails the asserted gate.
+    regressions, _ = residency_gate(parse_artifact(OLD_SCHEMA), 1.0)
+    assert len(regressions) == 1 and "no extra.transfer.bytes_up" in regressions[0]
+    # The mode lands in the gate line when the artifact records it.
+    with_mode = json.loads(json.dumps(NEW_SCHEMA))
+    with_mode["parsed"]["extra"]["residency"] = {
+        "mode": "delta", "bytes_up": 2048, "permuted": True,
+    }
+    _, notes = residency_gate(parse_artifact(with_mode), 1.0)
+    assert any("mode=delta" in n for n in notes)
+
+
+def test_residency_budget_gate_cli(tmp_path):
+    """End-to-end: the flag turns a green run red when the warm upload
+    blows the absolute budget, independent of the baseline compare."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(NEW_SCHEMA))
+    current = tmp_path / "current.json"
+    current.write_text(json.dumps(NEW_SCHEMA["parsed"]))
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+        "--current", str(current), "--baseline-dir", str(tmp_path),
+    ]
+    proc = subprocess.run(cmd + ["--residency-budget-mb", "1.0"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK residency" in proc.stdout
+    proc = subprocess.run(cmd + ["--residency-budget-mb", "0.001"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1 and "REGRESSION residency" in proc.stdout
+
+
+def test_trend_shows_residency_column(tmp_path):
+    """Artifacts carrying extra.residency (device-resident round state)
+    render mode@MBup; artifacts without the block print '-'."""
+    delta = json.loads(json.dumps(NEW_SCHEMA))
+    delta["parsed"]["extra"]["residency"] = {
+        "mode": "delta", "bytes_up": 13_400_000, "permuted": True,
+    }
+    bare = json.loads(json.dumps(NEW_SCHEMA))
+    bare["parsed"]["extra"]["residency"] = {"mode": "reset"}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(OLD_SCHEMA))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(delta))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(bare))
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "bench_trend.py"),
+            "--dir", str(tmp_path),
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "residency" in proc.stdout
+    lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
+    assert lines["BENCH_r01.json"].rstrip().endswith("-")
+    assert "delta@13.4MB" in lines["BENCH_r02.json"]
+    assert lines["BENCH_r03.json"].split()[-2] == "reset"  # residency column
+    # The gate's metric extraction is unaffected by the extra block.
+    assert extract_metrics(parse_artifact(delta))["warm"] == 3.0
 
 
 def test_trend_shows_effective_params_column(tmp_path):

@@ -78,6 +78,11 @@ EXEMPT_LABELED = {
     "scheduler_jobs_preempted_by_type",
     # preemption rounds only (tests/test_fairness.py covers attribution)
     "scheduler_preemption_attributed",
+    # device-resident buffer corruption only — never ticks in a healthy
+    # run by design (tests/test_residency.py covers drift detection;
+    # scheduler_snapshot_mode_total is NOT exempt — every round counts
+    # the path that carried it)
+    "scheduler_resident_drift",
 }
 
 # Front-door families are exempt from the sim sweep BY PREFIX (the sim

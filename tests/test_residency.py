@@ -1,0 +1,577 @@
+"""Device-resident round state (armada_tpu/snapshot/residency.py).
+
+The correctness contract is bit-exactness by construction: a warm cycle
+that delta-scatters into the persistent device buffers must hand the
+solver the SAME bits a fresh pad_device_round upload would have, so
+every decision stream, fairness ledger and loop count is identical to
+the rebuild path. Proven here at three scales:
+
+  - unit: delta sync vs fresh upload on a lifecycle delta sequence
+    (adds, binds, slot-table reshuffles), including the cached
+    same-generation re-entry booking ZERO transfer bytes;
+  - regrow: a submission burst past the padded pow2 capacity resets the
+    residency (one full upload) and stays bit-exact;
+  - system: a chaos sim (executor crash + partition windows, a queue
+    cordon window, a staged executor drain) run under
+    snapshot_mode="rebuild" and snapshot_mode="resident" produces
+    identical fleet histories AND bit-identical flight-recorder bundles
+    (solver inputs, decisions, fairness — trace.replayer.diff_traces'
+    `resident_drift` divergence kind stays empty).
+
+Plus the seams around the tentpole: the transfer ledger books zero
+upload for an already-device-resident tree through both solve_round
+paths (the headline bytes_up number must be honest), and what-if
+planning keeps working while rounds run resident (the fork seam skips
+incremental rounds; the planner's jobdb fork covers it).
+"""
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import PriorityClass, SchedulingConfig
+from armada_tpu.core.types import JobSpec, NodeSpec, QueueSpec, RunningJob
+from armada_tpu.observe import round_ledger
+from armada_tpu.snapshot.incremental import IncrementalRound
+from armada_tpu.snapshot.residency import ResidentRound
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round
+
+QUEUES = [QueueSpec("q-a", 1.0), QueueSpec("q-b", 2.0)]
+
+DECISION_KEYS = (
+    "assigned_node",
+    "scheduled_priority",
+    "scheduled_mask",
+    "preempted_mask",
+    "fair_share",
+    "demand_capped_fair_share",
+    "uncapped_fair_share",
+    "num_loops",
+    "spot_price",
+)
+
+
+def make_config(**kw):
+    return SchedulingConfig(
+        priority_classes={
+            "high": PriorityClass("high", 30000, preemptible=False),
+            "low": PriorityClass("low", 1000, preemptible=True),
+        },
+        default_priority_class="low",
+        **kw,
+    )
+
+
+def make_nodes(n=8):
+    return [
+        NodeSpec(
+            id=f"node-{i:03d}",
+            pool="default",
+            labels={"zone": f"z{i % 2}"},
+            total_resources={"cpu": "16", "memory": "64Gi"},
+        )
+        for i in range(n)
+    ]
+
+
+def job(i, queue="q-a", cpu=2, pc="low"):
+    return JobSpec(
+        id=f"job-{i:04d}",
+        queue=queue,
+        priority_class=pc,
+        requests={"cpu": str(cpu), "memory": f"{cpu * 2}Gi"},
+        submitted_ts=float(i),
+    )
+
+
+def assert_same_bits(resident, inc):
+    """Every materialized resident device leaf must equal the fresh
+    padded round bit-for-bit (through the same dtype canonicalization
+    the upload path applies), and the drift check must agree."""
+    import dataclasses
+
+    fresh = pad_device_round(inc.device_round())
+    dev = resident._dev
+    for f in dataclasses.fields(fresh):
+        want = getattr(fresh, f.name)
+        got = getattr(dev, f.name)
+        if isinstance(want, np.ndarray) and want.ndim >= 1:
+            got = np.asarray(got)
+            if want.dtype != got.dtype:  # x64-off canonicalization
+                want = want.astype(got.dtype)
+            assert want.shape == got.shape, f.name
+            assert want.tobytes() == got.tobytes(), f.name
+    assert resident.check_drift() == []
+
+
+def lease_some(inc, out, n):
+    """Bind the first n of last round's scheduled decisions."""
+    snap = inc.snapshot()
+    J = snap.num_jobs
+    sched = np.flatnonzero(np.asarray(out["scheduled_mask"])[:J])[:n]
+    assigned = np.asarray(out["assigned_node"])[:J]
+    prio = np.asarray(out["scheduled_priority"])[:J]
+    inc.bind(
+        [
+            (
+                str(snap.job_ids[j]),
+                snap.node_ids[int(assigned[j])],
+                int(prio[j]),
+                1.0,
+            )
+            for j in sched
+        ]
+    )
+
+
+def test_delta_sync_bit_exact_and_solve_identical():
+    """Warm-cycle delta syncs (including a lease-driven slot-table
+    reshuffle) keep device == fresh upload bit-for-bit, and the solver
+    run on the resident tree reproduces the rebuild decisions exactly."""
+    cfg = make_config()
+    inc = IncrementalRound(
+        cfg, "default", make_nodes(8), QUEUES, [],
+        [job(i, queue="q-a" if i % 2 else "q-b", cpu=1 + i % 3)
+         for i in range(40)],
+    )
+    resident = ResidentRound()
+    with round_ledger() as led:
+        dev = resident.device_round(inc)
+    assert resident.last_sync["mode"] == "reset"
+    assert led.as_dict()["bytes_up"] > 0
+    assert_same_bits(resident, inc)
+
+    out = solve_round(dev)
+    # Cycle: lease a handful (reshuffles the slot table between the
+    # running and queued segments) and submit fresh work.
+    lease_some(inc, out, 6)
+    inc.add_jobs([job(100 + i) for i in range(4)])
+    inc.set_round_params(global_rate_tokens=1e9)
+    with round_ledger() as led:
+        dev = resident.device_round(inc)
+    sync = resident.last_sync
+    assert sync["mode"] == "delta"
+    assert sync["permuted"], "leases must reshuffle the slot table"
+    assert led.as_dict()["bytes_up"] == sync["bytes_up"] > 0
+    assert_same_bits(resident, inc)
+
+    # The resident tree and a fresh upload must solve to identical bits.
+    out_res = solve_round(dev)
+    out_fresh = solve_round(pad_device_round(inc.device_round()))
+    for k in DECISION_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(out_res[k]), np.asarray(out_fresh[k]), err_msg=k
+        )
+
+    # Same-generation re-entry (ladder retries, shadow probes) returns
+    # the committed tree and books NOTHING.
+    with round_ledger() as led:
+        again = resident.device_round(inc)
+    assert again is dev
+    assert led.as_dict()["bytes_up"] == 0
+
+
+def test_delta_cheaper_than_reset():
+    """The point of the tentpole: a small-delta warm cycle uploads far
+    less than the full round (here < 1/4 of the reset bytes)."""
+    cfg = make_config()
+    inc = IncrementalRound(
+        cfg, "default", make_nodes(16), QUEUES, [],
+        [job(i, queue="q-a" if i % 2 else "q-b") for i in range(400)],
+    )
+    resident = ResidentRound()
+    resident.device_round(inc)
+    reset_bytes = resident.last_sync["bytes_up"]
+    inc.add_jobs([job(9000)])
+    inc.set_round_params(global_rate_tokens=1e9)
+    resident.device_round(inc)
+    assert resident.last_sync["mode"] == "delta"
+    assert resident.last_sync["bytes_up"] < reset_bytes / 4
+
+
+def test_slot_overflow_regrows_and_resets():
+    """A burst past the padded pow2 capacity changes the padded shapes:
+    the residency must reset (full re-upload into regrown buffers) and
+    stay bit-exact, then resume delta cycles on the new shapes."""
+    cfg = make_config()
+    inc = IncrementalRound(
+        cfg, "default", make_nodes(8), QUEUES, [],
+        [job(i) for i in range(40)],
+    )
+    resident = ResidentRound()
+    dev0 = resident.device_round(inc)
+    J0 = int(np.asarray(dev0.job_req).shape[0])
+
+    # Overflow: enough new jobs to cross the pow2 job/slot boundary.
+    inc.add_jobs([job(1000 + i) for i in range(J0)])
+    inc.set_round_params(global_rate_tokens=1e9)
+    with round_ledger() as led:
+        dev1 = resident.device_round(inc)
+    assert int(np.asarray(dev1.job_req).shape[0]) > J0
+    assert resident.last_sync["mode"] == "reset"
+    assert led.as_dict()["bytes_up"] == resident.last_sync["bytes_up"]
+    assert_same_bits(resident, inc)
+
+    # Delta cycles resume on the regrown buffers.
+    inc.add_jobs([job(5000)])
+    inc.set_round_params(global_rate_tokens=1e9)
+    resident.device_round(inc)
+    assert resident.last_sync["mode"] == "delta"
+    assert_same_bits(resident, inc)
+
+
+def test_drift_detection_and_reset():
+    """A corrupted device buffer is caught by check_drift; reset()
+    drops the resident state so the next sync is a fresh upload."""
+    import jax
+
+    cfg = make_config()
+    inc = IncrementalRound(
+        cfg, "default", make_nodes(4), QUEUES, [], [job(i) for i in range(8)]
+    )
+    resident = ResidentRound()
+    resident.device_round(inc)
+    assert resident.check_drift() == []
+    poisoned = np.asarray(resident._dev.job_prio).copy()
+    poisoned[0] += 1
+    resident._dev.job_prio = jax.device_put(poisoned)
+    assert resident.check_drift() == ["job_prio"]
+    resident.reset()
+    resident.device_round(inc)
+    assert resident.last_sync["mode"] == "reset"
+    assert resident.check_drift() == []
+
+
+def test_ledger_books_zero_upload_for_resident_tree():
+    """kernel.solve_round must count only true host->device transfers:
+    an already-device-resident tree books ZERO bytes_up through BOTH
+    the fused and the host-driven (budgeted) paths, on repeat solves
+    too — the headline residency number depends on it."""
+    import jax
+
+    cfg = make_config()
+    inc = IncrementalRound(
+        cfg, "default", make_nodes(4), QUEUES, [], [job(i) for i in range(8)]
+    )
+    dev_host = pad_device_round(inc.device_round())
+    dev_jax = jax.device_put(dev_host)
+    jax.block_until_ready(jax.tree_util.tree_leaves(dev_jax))
+
+    # Host tree: the dispatch upload books.
+    with round_ledger() as led:
+        solve_round(dev_host)
+    assert led.as_dict()["bytes_up"] > 0
+
+    for _ in range(2):  # fused path, repeat solves
+        with round_ledger() as led:
+            solve_round(dev_jax)
+        books = led.as_dict()
+        assert books["bytes_up"] == 0, books
+        assert books["bytes_down"] > 0  # results still book
+    with round_ledger() as led:  # host-driven (budgeted) path
+        solve_round(dev_jax, budget_s=60.0)
+    assert led.as_dict()["bytes_up"] == 0
+
+    # And the ResidentRound tree IS such a tree.
+    resident = ResidentRound()
+    dev = resident.device_round(inc)
+    with round_ledger() as led:
+        out = solve_round(dev)
+    assert led.as_dict()["bytes_up"] == 0
+    out_fresh = solve_round(dev_host)
+    for k in DECISION_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(out[k]), np.asarray(out_fresh[k]), err_msg=k
+        )
+
+
+# ---------------------------------------------------------------------------
+# system-level: chaos sim differential + what-if during residency
+# ---------------------------------------------------------------------------
+
+SIM_CFG = SchedulingConfig(
+    priority_classes={
+        "high": PriorityClass("high", 30000, preemptible=False),
+        "low": PriorityClass("low", 1000, preemptible=True),
+    },
+    default_priority_class="low",
+    protected_fraction_of_fair_share=0.5,
+)
+
+
+def _chaos_sim(snapshot_mode, trace_path):
+    """One chaos run: crash + partition fault windows from a seeded
+    plan, a deterministic queue-cordon window, and a staged executor
+    drain — all on the virtual clock, so both runs see identical
+    sequences. Returns the SimResult-derived history."""
+    from armada_tpu.services.chaos import FaultPlan, FaultSpec
+    from armada_tpu.sim import (
+        ClusterSpec,
+        JobTemplate,
+        QueueSpecSim,
+        Simulator,
+        WorkloadSpec,
+    )
+    from armada_tpu.sim.simulator import NodeTemplate, ShiftedExponential
+
+    plan = FaultPlan(
+        [
+            FaultSpec("executor_crash", "c2", start=400.0, duration=300.0),
+            FaultSpec("network_partition", "c1", start=900.0, duration=250.0),
+            FaultSpec("lease_timeout", "c2", start=1400.0, duration=200.0),
+        ],
+        seed=11,
+    )
+    sim = Simulator(
+        [
+            ClusterSpec(
+                "c1",
+                node_templates=(
+                    NodeTemplate(count=4, cpu="16", memory="64Gi",
+                                 labels={"zone": "a"}),
+                ),
+            ),
+            ClusterSpec(
+                "c2",
+                node_templates=(
+                    NodeTemplate(count=4, cpu="16", memory="64Gi",
+                                 labels={"zone": "b"}),
+                ),
+            ),
+        ],
+        WorkloadSpec(
+            queues=(
+                QueueSpecSim(
+                    "steady",
+                    job_templates=(
+                        JobTemplate(id="long", number=24, cpu="2",
+                                    memory="4Gi",
+                                    runtime=ShiftedExponential(minimum=300.0)),
+                    ),
+                ),
+                QueueSpecSim(
+                    "bursty",
+                    priority_factor=2.0,
+                    job_templates=(
+                        JobTemplate(id="gangs", number=16, cpu="4",
+                                    memory="4Gi", gang_cardinality=4,
+                                    submit_time=50.0,
+                                    runtime=ShiftedExponential(minimum=120.0)),
+                        JobTemplate(id="urgent", number=8, cpu="2",
+                                    memory="2Gi", priority_class="high",
+                                    submit_time=100.0,
+                                    runtime=ShiftedExponential(minimum=60.0)),
+                    ),
+                ),
+            )
+        ),
+        config=SIM_CFG,
+        backend="kernel",
+        snapshot_mode=snapshot_mode,
+        seed=0,
+        fault_plan=plan,
+        trace_path=trace_path,
+        max_time=4000.0,
+    )
+
+    # Deterministic operator actions on the virtual clock: a queue
+    # cordon window and a staged drain of executor c2, injected through
+    # the same cycle seam both runs share.
+    orig_cycle = sim.scheduler.cycle
+    started = {"drain": False}
+
+    def cycle(now):
+        if 600.0 <= now < 1000.0:
+            sim.scheduler.cordoned_queues.add("steady")
+        else:
+            sim.scheduler.cordoned_queues.discard("steady")
+        if now >= 1800.0 and not started["drain"]:
+            sim.scheduler.drains.start("c2", deadline_s=400.0)
+            started["drain"] = True
+        return orig_cycle(now=now)
+
+    sim.scheduler.cycle = cycle
+    res = sim.run()
+    return {
+        "states": {k: v.value for k, v in res.events_by_job.items()},
+        "placements": res.placements,
+        "preemptions": res.preemptions,
+        "finished": res.finished_jobs,
+        "cycles": res.cycles,
+    }
+
+
+def test_chaos_sim_differential_resident_vs_rebuild(tmp_path):
+    """The headline correctness gate: a long chaos sim (crashes,
+    partitions, a cordon window, a staged drain) run with rebuilt
+    snapshots and with device-resident delta rounds must produce the
+    SAME fleet history, and the recorded flight-trace bundles must be
+    bit-identical round by round — solver inputs, decision streams and
+    fairness ledgers (diff_traces' resident_drift kind stays empty)."""
+    from armada_tpu.trace.replayer import diff_traces, load_trace
+
+    trace_a = str(tmp_path / "incremental.atrace")
+    trace_b = str(tmp_path / "resident.atrace")
+    rebuild = _chaos_sim("rebuild", None)
+    incremental = _chaos_sim("incremental", trace_a)
+    resident = _chaos_sim("resident", trace_b)
+
+    # End-to-end: all three snapshot paths agree on the fleet history.
+    for other in (incremental, resident):
+        assert rebuild["finished"] == other["finished"]
+        assert rebuild["preemptions"] == other["preemptions"]
+        assert rebuild["states"] == other["states"]
+        assert rebuild["placements"] == other["placements"]
+    # sanity: the chaos actually landed and work still finished
+    assert rebuild["finished"] >= 40
+
+    # Bit-exactness: the delta-scattered resident rounds vs the SAME
+    # incremental lifecycle re-uploaded fresh each cycle. (A rebuilt
+    # round orders rows canonically, so it is only comparable at the
+    # decision level above, not byte level.)
+    report = diff_traces(load_trace(trace_a), load_trace(trace_b))
+    assert report["pairs"] > 10
+    assert report["unmatched"] == []
+    assert report["divergences"] == {}, report["results"]
+    assert report["ok"]
+
+
+def test_diff_traces_flags_injected_drift(tmp_path):
+    """diff_traces is a real gate, not a rubber stamp: a perturbed
+    decision stream in one bundle classifies as resident_drift."""
+    from armada_tpu.trace import TraceRecorder
+    from armada_tpu.trace.replayer import diff_traces, load_trace
+
+    cfg = make_config()
+    inc = IncrementalRound(
+        cfg, "default", make_nodes(4), QUEUES, [], [job(i) for i in range(8)]
+    )
+    snap = inc.snapshot()
+    dev = pad_device_round(inc.device_round())
+    out = {
+        k: np.asarray(v)
+        for k, v in solve_round(dev).items()
+        if k not in ("profile", "truncated")
+    }
+    paths = []
+    for tag, mutate in (("a", False), ("b", True)):
+        decisions = {k: v.copy() for k, v in out.items()}
+        if mutate:
+            decisions["scheduled_mask"] = decisions["scheduled_mask"].copy()
+            decisions["scheduled_mask"][0] = ~decisions["scheduled_mask"][0]
+        path = str(tmp_path / f"{tag}.atrace")
+        with TraceRecorder(path, source="test", config=cfg) as rec:
+            rec.record_round(
+                pool="default", dev=dev, decisions=decisions,
+                num_jobs=snap.num_jobs, num_queues=snap.num_queues,
+                config=cfg, cycle=1,
+            )
+        paths.append(path)
+    report = diff_traces(load_trace(paths[0]), load_trace(paths[1]))
+    assert not report["ok"]
+    assert report["divergences"] == {"resident_drift": 1}
+    (div,) = report["results"][0]["divergences"]
+    assert div["key"] == "scheduled_mask"
+
+
+def test_whatif_plan_during_residency():
+    """Fork-during-residency parity: with rounds running device-resident
+    (incremental), the round seam skips ForkCapture and the planner
+    falls back to a jobdb fork — plans must still work and predict the
+    same placements a rebuild-mode scheduler predicts from the same
+    state."""
+    from armada_tpu.core.types import QueueSpec as QS
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes as mk
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+    from armada_tpu.whatif import WhatIfService, mutations_from_dicts
+
+    def build(snapshot_mode):
+        log = InMemoryEventLog()
+        sched = SchedulerService(
+            SIM_CFG, log, backend="kernel", snapshot_mode=snapshot_mode
+        )
+        submit = SubmitService(SIM_CFG, log, scheduler=sched)
+        submit.create_queue(QS("team"))
+        ex = FakeExecutor("ex-a", log, sched, nodes=mk("ex-a", count=2, cpu="8"))
+        jobs = [
+            JobSpec(id=f"j{i}", queue="team", jobset="s",
+                    requests={"cpu": "4", "memory": "1Gi"},
+                    submitted_ts=float(i))
+            for i in range(4)
+        ]
+        submit.submit("team", "s", jobs, now=0.0)
+        wi = WhatIfService(sched)
+        sched.attach_whatif(wi)
+        for t in (0.0, 1.0, 2.0):
+            ex.tick(t)
+            sched.cycle(now=t)
+            ex.tick(t)
+        return sched, wi
+
+    sched_r, wi_r = build("resident")
+    # Rounds ran resident: the capture seam must have skipped them.
+    assert sched_r.fork_capture is not None
+    assert sched_r.fork_capture.latest("pool") is None
+
+    plans = {}
+    for name, wi in (("resident", wi_r), ("rebuild", build("rebuild")[1])):
+        plan = wi.plan(
+            mutations_from_dicts(
+                [{"kind": "inject_gang", "queue": "team",
+                  "gang_cardinality": 2, "cpu": "4", "memory": "1Gi"}]
+            ),
+            rounds=4,
+        )
+        (gang,) = plan.injected
+        plans[name] = {
+            "feasible": gang["feasible"],
+            "eta": gang["eta_rounds"],
+            # "cycle" differs by fork source (captured round vs live
+            # jobdb fork) — the state the plan saw must not.
+            "baseline": {k: v for k, v in plan.baseline.items()
+                         if k != "cycle"},
+            "free": plan.headroom["pool"]["free"],
+        }
+    assert plans["resident"] == plans["rebuild"]
+
+
+def test_scheduler_engages_resident_and_counts_modes():
+    """snapshot_mode="auto" engages residency on kernel pools: the
+    per-pool ResidentRound appears, warm cycles book delta-sized
+    uploads, and scheduler_snapshot_mode_total counts the mode used."""
+    from armada_tpu.core.types import QueueSpec as QS
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes as mk
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+
+    log = InMemoryEventLog()
+    sched = SchedulerService(SIM_CFG, log, backend="kernel")
+    submit = SubmitService(SIM_CFG, log, scheduler=sched)
+    submit.create_queue(QS("team"))
+    ex = FakeExecutor("ex-a", log, sched, nodes=mk("ex-a", count=2, cpu="8"))
+    submit.submit(
+        "team", "s",
+        [JobSpec(id=f"j{i}", queue="team", jobset="s",
+                 requests={"cpu": "2", "memory": "1Gi"}, submitted_ts=float(i))
+         for i in range(6)],
+        now=0.0,
+    )
+    for t in (0.0, 1.0, 2.0, 3.0):
+        ex.tick(t)
+        sched.cycle(now=t)
+        ex.tick(t)
+    assert "default" in sched._resident
+    resident = sched._resident["default"]
+    assert resident.last_sync["mode"] in ("reset", "delta")
+    assert resident.check_drift() == []
+    if sched.metrics is not None and sched.metrics.registry is not None:
+        counts = {}
+        for metric in sched.metrics.registry.collect():
+            if metric.name == "scheduler_snapshot_mode_total":
+                for s in metric.samples:
+                    if s.name.endswith("_total"):
+                        counts[s.labels["mode"]] = s.value
+        assert counts.get("resident", 0) >= 1

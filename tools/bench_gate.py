@@ -22,12 +22,17 @@ the threshold. A metric regresses when current > baseline * threshold;
 a metric missing on either side is reported but never gates (old
 artifacts predate burst_50k and the segment profile).
 
-One gate is ABSOLUTE (needs no baseline): the round admission
+Two gates are ABSOLUTE (need no baseline): the round admission
 firewall's host-side invariant sweep (extra.validate_s, timed by
 bench.py outside the measured cycle) must cost under 5% of the
 headline solve time — the firewall runs before every committed round,
-so its cost taxes the whole control loop. Exits 1 on regression, 2
-when no comparable baseline exists, 0 otherwise.
+so its cost taxes the whole control loop — and, when
+--residency-budget-mb is passed, the warm headline cycle's booked
+upload (extra.transfer.bytes_up) must stay under that many MB: with
+the round device-resident (snapshot/residency.py) a warm cycle uploads
+only the delta, so blowing the budget means residency silently
+disengaged or the delta path fell back to full re-uploads. Exits 1 on
+regression, 2 when no comparable baseline exists, 0 otherwise.
 """
 
 from __future__ import annotations
@@ -181,6 +186,37 @@ def absolute_gate(result: dict | None) -> tuple[list, list]:
     return regressions, notes
 
 
+def residency_gate(result: dict | None, budget_mb: float | None) -> tuple[list, list]:
+    """(regressions, notes) for the absolute residency budget. Only
+    active when --residency-budget-mb is passed; then a current artifact
+    MISSING extra.transfer.bytes_up gates too — the flag is an explicit
+    assertion that the warm upload is measured and delta-sized, so an
+    artifact that cannot prove it must not read as green."""
+    regressions, notes = [], []
+    if budget_mb is None:
+        return regressions, notes
+    extra = result.get("extra") if isinstance(result, dict) else None
+    transfer = extra.get("transfer") if isinstance(extra, dict) else None
+    up = transfer.get("bytes_up") if isinstance(transfer, dict) else None
+    residency = extra.get("residency") if isinstance(extra, dict) else None
+    mode = residency.get("mode") if isinstance(residency, dict) else None
+    if not isinstance(up, (int, float)):
+        regressions.append(
+            "residency: current artifact has no extra.transfer.bytes_up "
+            f"(budget {budget_mb:g} MB asserted)"
+        )
+        return regressions, notes
+    line = (
+        f"residency: warm bytes_up {up / 1e6:.1f}MB vs budget "
+        f"{budget_mb:g}MB" + (f" (mode={mode})" if mode else "")
+    )
+    if up > budget_mb * 1e6:
+        regressions.append(line)
+    else:
+        notes.append("OK " + line)
+    return regressions, notes
+
+
 def _round_num(path: str) -> int:
     m = re.search(r"BENCH_r(\d+)\.json$", path)
     return int(m.group(1)) if m else -1
@@ -212,6 +248,10 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline-dir", default=REPO)
     ap.add_argument("--threshold", type=float, default=1.15,
                     help="regression factor (1.15 = allow 15%% slower)")
+    ap.add_argument("--residency-budget-mb", type=float, default=None,
+                    help="absolute ceiling (MB) on the warm headline "
+                    "cycle's extra.transfer.bytes_up — asserts the "
+                    "device-resident delta path carried the round")
     args = ap.parse_args(argv)
 
     raw = (
@@ -238,6 +278,11 @@ def main(argv=None) -> int:
     abs_regressions, abs_notes = absolute_gate(parse_artifact(doc))
     regressions += abs_regressions
     notes += abs_notes
+    res_regressions, res_notes = residency_gate(
+        parse_artifact(doc), args.residency_budget_mb
+    )
+    regressions += res_regressions
+    notes += res_notes
     print(f"baseline: {os.path.basename(base_path)}")
     for line in notes:
         print(line)

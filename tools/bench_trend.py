@@ -3,9 +3,11 @@
     python tools/bench_trend.py [--dir REPO]
 
 One row per artifact — warm headline, tracking_100k and burst_50k cycle
-times, the solve share of the warm cycle, and the effective solver
+times, the solve share of the warm cycle, the effective solver
 parameters (hot window / chunk, starred when a BENCH_TUNED profile
-supplied them) — tolerant of every historical schema (BENCH_r03.json has no `parsed` block; burst_50k only
+supplied them), and the residency column (snapshot mode that carried
+the warm cycle + the MB it uploaded) — tolerant of every historical
+schema (BENCH_r03.json has no `parsed` block; burst_50k only
 exists from r05): a metric an artifact does not carry prints as "-",
 and an artifact nothing can be recovered from still gets a row.
 """
@@ -43,7 +45,8 @@ def rows(search_dir: str) -> list[dict]:
         row = {"round": os.path.basename(path), "warm": None,
                "tracking": None, "burst": None, "solve": None,
                "trace": False, "params": None, "whatif": None,
-               "frontdoor": None, "transfer": None, "fairness": None}
+               "frontdoor": None, "transfer": None, "fairness": None,
+               "residency": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -113,6 +116,20 @@ def rows(search_dir: str) -> list[dict]:
                 )
             else:
                 row["transfer"] = "yes"
+        residency = extra.get("residency") if isinstance(extra, dict) else None
+        if isinstance(residency, dict):
+            # Device-resident round state (armada_tpu/snapshot/residency):
+            # which snapshot path carried the headline warm cycle (delta
+            # scatter sync vs full reset upload) + the MB it uploaded,
+            # as one token mode@MBup. Older artifacts (and BENCH_RESIDENT=0
+            # runs) simply lack the block and print "-".
+            mode = residency.get("mode")
+            up = residency.get("bytes_up")
+            row["residency"] = (
+                f"{mode}@{float(up) / 1e6:.1f}MB"
+                if isinstance(mode, str) and isinstance(up, (int, float))
+                else (mode or "yes")
+            )
         fairness = extra.get("fairness") if isinstance(extra, dict) else None
         if isinstance(fairness, dict):
             # Fairness-observatory block (armada_tpu/observe/fairness.py):
@@ -152,7 +169,8 @@ def main(argv=None) -> int:
     header = (
         f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} "
         f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6} {'whatif':>9} "
-        f"{'frontdoor':>10} {'transfer':>16} {'fairness':>15}"
+        f"{'frontdoor':>10} {'transfer':>16} {'residency':>14} "
+        f"{'fairness':>15}"
     )
     print(header)
     print("-" * len(header))
@@ -165,6 +183,7 @@ def main(argv=None) -> int:
             f"{r.get('whatif') or '-':>9} "
             f"{r.get('frontdoor') or '-':>10} "
             f"{r.get('transfer') or '-':>16} "
+            f"{r.get('residency') or '-':>14} "
             f"{r.get('fairness') or '-':>15}"
         )
     return 0
