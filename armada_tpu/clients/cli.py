@@ -271,8 +271,10 @@ def cmd_fairness(args):
     for pool in sorted(pools):
         pdoc = pools[pool] or {}
         ledger = pdoc.get("ledger") or {}
+        policy = pdoc.get("policy") or ledger.get("policy") or "drf"
         print(
-            f"pool {pool}: jain {ledger.get('jain', 1.0):.4f}  "
+            f"pool {pool}: policy {policy}  "
+            f"jain {ledger.get('jain', 1.0):.4f}  "
             f"max regret {ledger.get('max_regret', 0.0):.4f}  "
             f"round {pdoc.get('rounds', 0)}"
         )
@@ -302,6 +304,60 @@ def cmd_fairness(args):
             f"ALERT pool {a['pool']} queue {a['queue']}: starved "
             f"{a['starved_rounds']} consecutive rounds"
         )
+
+
+def cmd_policy(args):
+    """Fairness-policy control plane (solver/policy.py): `show` the
+    active policy per pool, `set`/clear a pool's policy at runtime
+    (event-sourced, gated on a shadow scorecard), `ab` replay a
+    recorded corpus under candidate policies side by side."""
+    if args.policy_cmd == "ab":
+        # Local replay, no server needed: the same harness as
+        # tools/policy_ab.py.
+        from ..utils.platform import ensure_healthy_backend
+
+        ensure_healthy_backend()
+
+        from ..trace.policy_ab import (
+            DEFAULT_CANDIDATES,
+            ab_compare,
+            render_ab,
+        )
+
+        result = ab_compare(
+            args.traces,
+            args.policy or DEFAULT_CANDIDATES,
+            solver=args.solver or "LOCAL",
+            allow_foreign=args.allow_foreign,
+            max_rounds=args.rounds or None,
+        )
+        _print(result) if args.json else print(render_ab(result))
+        return
+    client = connect(args.server, ca_cert=args.ca_cert or None)
+    if args.policy_cmd == "set":
+        if not args.policy and not args.clear:
+            raise SystemExit("policy set wants a POLICY or --clear")
+        scorecard = None
+        if args.scorecard:
+            with open(args.scorecard) as f:
+                scorecard = json.load(f)
+        out = client.policy_set(
+            args.pool,
+            None if args.clear else args.policy,
+            force=args.force,
+            scorecard=scorecard,
+        )
+        print(f"pool {out['pool']}: policy {out['policy']}")
+        return
+    doc = client.policy_show(pool=args.pool or None)
+    if args.json:
+        _print(doc)
+        return
+    print(f"default: {doc.get('default', 'drf')}")
+    overrides = doc.get("overrides") or {}
+    for pool in sorted(doc.get("pools") or {}):
+        src = " (runtime override)" if pool in overrides else ""
+        print(f"pool {pool}: {doc['pools'][pool]}{src}")
 
 
 def _whatif_mutations(args) -> list[dict]:
@@ -367,6 +423,8 @@ def _whatif_mutations(args) -> list[dict]:
             raise SystemExit(
                 f"--scale-queue wants NAME=WEIGHT, got {spec!r}"
             ) from None
+    if getattr(args, "policy", None):
+        mutations.append({"kind": "policy", "policy": args.policy})
     return mutations
 
 
@@ -593,6 +651,48 @@ def build_parser():
     fair.add_argument("--json", action="store_true")
     fair.set_defaults(fn=cmd_fairness)
 
+    pol = sub.add_parser(
+        "policy",
+        help="fairness-policy control plane: show/set the per-pool "
+        "policy, or A/B candidate policies over a recorded corpus",
+    )
+    pol_sub = pol.add_subparsers(dest="policy_cmd", required=True)
+    ps = pol_sub.add_parser("show", help="active policy per pool")
+    ps.add_argument("--pool", default="")
+    ps.add_argument("--json", action="store_true")
+    pset = pol_sub.add_parser(
+        "set",
+        help="flip a pool's fairness policy at runtime (needs a shadow "
+        "scorecard from `policy ab` unless --force)",
+    )
+    pset.add_argument("pool")
+    pset.add_argument(
+        "policy", nargs="?", default="",
+        help="drf | proportional | priority | deadline",
+    )
+    pset.add_argument("--clear", action="store_true",
+                      help="clear the runtime override (file config rules)")
+    pset.add_argument("--force", action="store_true",
+                      help="bypass the shadow-scorecard divergence gate")
+    pset.add_argument(
+        "--scorecard", default="",
+        help="JSON scorecard file from `policy ab --json` to register "
+        "as the flip's shadow evidence",
+    )
+    pab = pol_sub.add_parser(
+        "ab",
+        help="replay .atrace bundle(s) under candidate policies and "
+        "print the scorecards side by side (local, no server)",
+    )
+    pab.add_argument("traces", nargs="+")
+    pab.add_argument("--policy", action="append", metavar="POLICY")
+    pab.add_argument("--solver", default="",
+                     help="LOCAL | hotwindow[:W] | 2x4 (default LOCAL)")
+    pab.add_argument("--rounds", type=int, default=0)
+    pab.add_argument("--allow-foreign", action="store_true")
+    pab.add_argument("--json", action="store_true")
+    pol.set_defaults(fn=cmd_policy)
+
     wi = sub.add_parser(
         "whatif",
         help="shadow-solve hypothetical fleet edits (cordon/drain/"
@@ -616,6 +716,12 @@ def build_parser():
     wi.add_argument("--inject-gang", action="append",
                     metavar="QUEUE:CARD[:CPU[:MEM[:GPU]]]")
     wi.add_argument("--scale-queue", action="append", metavar="NAME=WEIGHT")
+    wi.add_argument(
+        "--policy", default="",
+        help="re-solve the fork under this fairness policy (drf | "
+        "proportional | priority | deadline); fairness_delta names "
+        "the payers",
+    )
     wi.set_defaults(fn=cmd_whatif)
 
     dr = sub.add_parser(

@@ -255,6 +255,19 @@ class SchedulingConfig:
     # so a fresh streak stays silent until starvation sustains to ~2x
     # this many rounds).
     fairness_starvation_rounds: int = 3
+    # Pluggable fairness policies (armada_tpu/solver/policy.py). The
+    # default objective for every pool, one of policy.POLICY_KINDS
+    # ("drf" | "proportional" | "priority" | "deadline"), overridable
+    # per pool via fairness_policy_pools {pool: kind}. Market-driven
+    # configs must stay on "drf" (bid order owns candidate ranking;
+    # validate_config enforces it). The deadline policy boosts a
+    # queue's effective weight by up to `fairness_deadline_boost`x as
+    # its most urgent job deadline approaches, decaying over
+    # `fairness_deadline_horizon_s` seconds of slack.
+    fairness_policy_default: str = "drf"
+    fairness_policy_pools: dict = field(default_factory=dict)
+    fairness_deadline_boost: float = 2.0
+    fairness_deadline_horizon_s: float = 3600.0
     executor_timeout_s: float = 600.0
     # Lease TTL advertised to executor agents in every lease reply: an
     # agent that cannot complete a lease exchange for this long must
@@ -512,6 +525,21 @@ class SchedulingConfig:
                 )
                 for s in d["slos"]
             )
+        if "fairnessPolicy" in d:
+            fp = d["fairnessPolicy"] or {}
+            if "default" in fp:
+                kwargs["fairness_policy_default"] = str(fp["default"])
+            if "pools" in fp:
+                kwargs["fairness_policy_pools"] = {
+                    str(pool): str(kind)
+                    for pool, kind in (fp["pools"] or {}).items()
+                }
+            if "deadlineBoost" in fp:
+                kwargs["fairness_deadline_boost"] = float(fp["deadlineBoost"])
+            if "deadlineHorizonSeconds" in fp:
+                kwargs["fairness_deadline_horizon_s"] = float(
+                    fp["deadlineHorizonSeconds"]
+                )
         if "dominantResourceFairnessResourcesToConsider" in d:
             kwargs["dominant_resource_fairness_resources"] = {
                 name: 1.0 for name in d["dominantResourceFairnessResourcesToConsider"]
@@ -790,5 +818,31 @@ def validate_config(config: SchedulingConfig):
     for name in config.dominant_resource_fairness_resources:
         if name not in known:
             problems.append(f"DRF resource {name!r} is not a supported type")
+    # Pluggable fairness policies: reject unknown kinds up front (a typo
+    # must not silently schedule a pool under the wrong objective), and
+    # pin market-driven configs to DRF — bid price owns candidate order
+    # there, so any other policy's ranking would never take effect.
+    from ..solver import policy as fairness_policy_mod
+
+    policy_entries = [("fairnessPolicy.default", config.fairness_policy_default)]
+    policy_entries += [
+        (f"fairnessPolicy.pools[{pool}]", kind)
+        for pool, kind in sorted((config.fairness_policy_pools or {}).items())
+    ]
+    for where, kind in policy_entries:
+        try:
+            spec = fairness_policy_mod.normalize_spec(kind)
+        except ValueError as e:
+            problems.append(f"{where}: {e}")
+            continue
+        if config.market_driven and spec[0] != "drf":
+            problems.append(
+                f"{where}: market-driven scheduling requires the drf "
+                f"policy, got {spec[0]!r}"
+            )
+    if config.fairness_deadline_boost < 0:
+        problems.append("fairnessPolicy.deadlineBoost must be >= 0")
+    if config.fairness_deadline_horizon_s <= 0:
+        problems.append("fairnessPolicy.deadlineHorizonSeconds must be > 0")
     if problems:
         raise ValueError("invalid scheduling config: " + "; ".join(problems))

@@ -200,6 +200,19 @@ class PriorityOverride(Event):
     cleared: bool = False
 
 
+@dataclass(frozen=True)
+class FairnessPolicyChange(Event):
+    """Control-plane event: a pool's fairness policy flipped (or was
+    cleared back to the config default). `policy` is the canonical
+    policy string (solver/policy.py spec_to_str); cleared=True removes
+    the runtime override. Event-sourced so a restarted or failed-over
+    scheduler solves the next round under the same objective."""
+
+    pool: str = ""
+    policy: str = ""
+    cleared: bool = False
+
+
 # Synthetic jobset key for control-plane (non-job) events: queue CRUD,
 # executor settings, priority overrides.
 CONTROL_PLANE_JOBSET = "__control-plane__"

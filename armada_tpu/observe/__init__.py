@@ -37,6 +37,7 @@ from .fairness import (  # noqa: F401
     jain_index,
     ledger_from_device_round,
     ledger_from_snapshot,
+    mechanism_phrase,
     resolve_names,
 )
 from .ledger import (  # noqa: F401

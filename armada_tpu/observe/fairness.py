@@ -58,6 +58,7 @@ from collections import deque
 
 import numpy as np
 
+from ..solver import policy as fairness_policy
 from ..solver.drf import unweighted_cost
 
 # Float slack for "delivered below entitlement": shares are O(1) floats,
@@ -68,11 +69,31 @@ MECHANISM_FAIRNESS = "fairness"
 MECHANISM_URGENCY = "urgency"
 
 # How preemption mechanisms render in event reasons / job timelines
-# ("preempted by queue B gang g-7 under DRF rebalance").
+# ("preempted by queue B gang g-7 under DRF rebalance"). Keyed by the
+# DEFAULT (DRF) policy; mechanism_phrase() renders the active policy.
 MECHANISM_PHRASE = {
     MECHANISM_FAIRNESS: "under DRF rebalance",
     MECHANISM_URGENCY: "under urgency preemption",
 }
+
+# Fairness-rebalance phrasing per policy kind: the preemption reason a
+# victim's timeline shows must name the objective that displaced it.
+_REBALANCE_PHRASE = {
+    "drf": "under DRF rebalance",
+    "proportional": "under proportional-fairness rebalance",
+    "priority": "under strict-priority rebalance",
+    "deadline": "under deadline-aware rebalance",
+}
+
+
+def mechanism_phrase(mechanism: str, policy: str | None = None) -> str:
+    """How a preemption mechanism renders under the ACTIVE policy:
+    urgency phrasing is policy-independent; fairness phrasing names the
+    objective whose rebalance displaced the victim."""
+    if mechanism == MECHANISM_FAIRNESS and policy:
+        kind = str(policy).split("(", 1)[0]
+        return _REBALANCE_PHRASE.get(kind, MECHANISM_PHRASE[mechanism])
+    return MECHANISM_PHRASE.get(mechanism, "")
 
 
 def jain_index(values) -> float:
@@ -104,14 +125,16 @@ def compute_ledger(
     num_jobs: int,
     num_queues: int,
     queue_names=None,
+    policy_spec=None,
 ) -> dict:
     """The per-round queue ledger from explicit arrays (sliced to the
     unpadded prefix here). Entitlements come from the solver's OWN
     decision stream (`fair_share` / `demand_capped` / `uncapped` —
-    drf.update_fair_shares' triple), so the ledger never re-derives what
+    the water-filling triple), so the ledger never re-derives what
     the solve already committed to; demand and delivered shares are the
-    DRF dominant-share costs of the queue demand / delivered allocation
-    under the same totals and multipliers the solve used."""
+    ACTIVE policy's costs of the queue demand / delivered allocation
+    under the same totals and multipliers the solve used (the DRF
+    dominant share under the default policy)."""
     J, Q = int(num_jobs), int(num_queues)
     job_queue = np.asarray(job_queue)[:J]
     job_req = np.asarray(job_req, dtype=np.float64)[:J]
@@ -141,11 +164,18 @@ def compute_ledger(
             delivered_alloc[:, r] = np.bincount(
                 qidx, weights=np.where(placed, job_req[:, r], 0.0), minlength=Q
             )[:Q]
+    spec = fairness_policy.normalize_spec(
+        policy_spec if policy_spec is not None else fairness_policy.DEFAULT_SPEC
+    )
     demand_share = (
-        unweighted_cost(demand_alloc, total, mult) if Q else np.zeros(0)
+        fairness_policy.policy_cost(spec, demand_alloc, total, mult)
+        if Q
+        else np.zeros(0)
     )
     delivered_share = (
-        unweighted_cost(delivered_alloc, total, mult) if Q else np.zeros(0)
+        fairness_policy.policy_cost(spec, delivered_alloc, total, mult)
+        if Q
+        else np.zeros(0)
     )
 
     queues = []
@@ -178,7 +208,7 @@ def compute_ledger(
     jain = jain_index(
         delivered_share[active] / weight[active] if active.any() else ()
     )
-    return {
+    out = {
         "queues": queues,
         "jain": float(jain),
         "max_regret": float(regrets.max()) if Q else 0.0,
@@ -186,6 +216,12 @@ def compute_ledger(
         if R
         else [],
     }
+    if fairness_policy.spec_kind(spec) != "drf":
+        # Only non-default policies stamp the ledger: a DRF ledger must
+        # stay byte-identical to pre-policy builds (old-bundle replay
+        # compares ledgers structurally).
+        out["policy"] = fairness_policy.spec_to_str(spec)
+    return out
 
 
 def attribute_preemptions(
@@ -202,6 +238,7 @@ def attribute_preemptions(
     multipliers,
     ledger: dict | None,
     num_jobs: int,
+    policy_spec=None,
 ) -> list:
     """One attribution entry per preempted job — index-based and fully
     deterministic, so live rounds, recorded rounds and replayed rounds
@@ -224,7 +261,12 @@ def attribute_preemptions(
     sched_idx = np.flatnonzero(scheduled)
     by_node: dict[int, list] = {}
     if len(sched_idx):
-        cost = unweighted_cost(job_req[sched_idx], total, mult)
+        spec = fairness_policy.normalize_spec(
+            policy_spec
+            if policy_spec is not None
+            else fairness_policy.DEFAULT_SPEC
+        )
+        cost = fairness_policy.policy_cost(spec, job_req[sched_idx], total, mult)
         order = np.lexsort(
             (sched_idx, -cost, -sched_prio[sched_idx].astype(np.int64))
         )
@@ -285,10 +327,12 @@ def round_fairness_from_arrays(
     num_jobs: int,
     num_queues: int,
     queue_names=None,
+    policy_spec=None,
 ) -> dict:
     """Ledger + attribution from one set of round arrays + the decision
     dict (any superset of the solver's output keys)."""
     ledger = compute_ledger(
+        policy_spec=policy_spec,
         job_queue=job_queue,
         job_req=job_req,
         assigned_node=decisions["assigned_node"],
@@ -315,6 +359,7 @@ def round_fairness_from_arrays(
         multipliers=multipliers,
         ledger=ledger,
         num_jobs=num_jobs,
+        policy_spec=policy_spec,
     )
     return {"ledger": ledger, "preemptions": preemptions}
 
@@ -337,6 +382,7 @@ def ledger_from_device_round(
         k: np.asarray(decisions[k]) for k in needed if k in decisions
     }
     return round_fairness_from_arrays(
+        policy_spec=getattr(dev, "fairness_policy", None),
         job_queue=dev.job_queue,
         job_req=dev.job_req,
         job_node=dev.job_node,
@@ -351,11 +397,12 @@ def ledger_from_device_round(
     )
 
 
-def ledger_from_snapshot(snap, result: dict) -> dict:
+def ledger_from_snapshot(snap, result: dict, policy_spec=None) -> dict:
     """Host-unit fallback for rounds with no DeviceRound in hand (the
     oracle backend with no recorder attached): same math over the
     RoundSnapshot's exact int64 arrays."""
     return round_fairness_from_arrays(
+        policy_spec=policy_spec,
         job_queue=snap.job_queue,
         job_req=snap.job_req,
         job_node=snap.job_node,
@@ -441,6 +488,7 @@ class FairnessTracker:
         self._alerting: set[tuple] = set()
         self._latest: dict[str, dict] = {}  # pool -> decorated doc
         self._rounds: dict[str, int] = {}
+        self._policy: dict[str, str] = {}  # pool -> last active policy
 
     def observe_round(
         self,
@@ -524,10 +572,14 @@ class FairnessTracker:
                     metrics.fairness_starvation_alerts.labels(
                         pool=pool, queue=str(row["queue"])
                     ).inc()
+            active_policy = str(ledger.get("policy") or "drf")
+            prev_policy = self._policy.get(pool)
+            self._policy[pool] = active_policy
             doc = {
                 "pool": pool,
                 "now": float(now),
                 "rounds": self._rounds[pool],
+                "policy": active_policy,
                 "ledger": ledger,
                 "preemptions": list(preemptions),
                 "alerts": alerts,
@@ -549,6 +601,16 @@ class FairnessTracker:
             metrics.fairness_jain.labels(pool=pool).set(
                 float(ledger.get("jain", 1.0))
             )
+            # Info-style active-policy gauge: live series reads 1; on a
+            # flip the previous policy's series drops to 0 instead of
+            # freezing (a dashboard keyed on ==1 must follow the flip).
+            if prev_policy is not None and prev_policy != active_policy:
+                metrics.fairness_policy_info.labels(
+                    pool=pool, policy=prev_policy
+                ).set(0.0)
+            metrics.fairness_policy_info.labels(
+                pool=pool, policy=active_policy
+            ).set(1.0)
             for row in ledger.get("queues", ()):
                 name = str(row["queue"])
                 metrics.fair_share_uncapped.labels(pool=pool, queue=name).set(
@@ -618,8 +680,10 @@ def aggregate_scorecard(rounds: list, queue_names=None) -> dict:
     per_queue: dict = {}
     trajectory = []
     attributed: dict = {}
+    policies: set = set()
     for i, block in enumerate(rounds):
         ledger = block.get("ledger") or {}
+        policies.add(str(ledger.get("policy") or "drf"))
         trajectory.append(
             {
                 "round": i,
@@ -678,6 +742,7 @@ def aggregate_scorecard(rounds: list, queue_names=None) -> dict:
     jains = [t["jain"] for t in trajectory]
     return {
         "rounds": len(rounds),
+        "policy": "+".join(sorted(policies)) if policies else "drf",
         "queues": queues,
         "jain_mean": float(np.mean(jains)) if jains else 1.0,
         "jain_min": float(min(jains)) if jains else 1.0,

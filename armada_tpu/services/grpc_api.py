@@ -453,7 +453,11 @@ class ApiServer:
             az.authorize_global(principal, A.CREATE_QUEUE)
         elif method == "DeleteQueue":
             az.authorize_global(principal, A.DELETE_QUEUE)
-        elif method in ("CordonNode", "CordonExecutor", "SetPriorityOverride"):
+        elif method in (
+            "CordonNode", "CordonExecutor", "SetPriorityOverride", "PolicySet"
+        ):
+            # A fairness-policy flip reshapes every queue's entitlement —
+            # the same operator privilege as cordon/override writes.
             az.authorize_global(principal, A.CORDON)
         elif method == "ExecuteDrain":
             # Draining cordons + preempts: the same privilege as cordon.
@@ -784,6 +788,44 @@ class ApiServer:
 
     def _list_priority_overrides(self, req):
         return {"overrides": dict(self.scheduler.priority_overrides)}
+
+    # ---- fairness policy control plane (solver/policy.py) ----
+
+    def _policy_show(self, req):
+        """Active fairness policy per pool: the file-config layer, the
+        runtime overrides, and the effective policy each pool solves
+        under. Optional req["pool"] narrows to one pool."""
+        cfg = self.scheduler.config
+        pools = {p.name for p in cfg.pools} | set(
+            cfg.fairness_policy_pools
+        ) | set(self.scheduler.fairness_policy_overrides)
+        want = req.get("pool") or None
+        if want is not None:
+            if want not in pools:
+                pools = pools | {want}
+            pools = {want}
+        return {
+            "default": str(cfg.fairness_policy_default),
+            "overrides": dict(self.scheduler.fairness_policy_overrides),
+            "pools": {
+                pool: self.scheduler.fairness_policy(pool)
+                for pool in sorted(pools)
+            },
+        }
+
+    def _policy_set(self, req):
+        """Flip (or clear, policy="") a pool's fairness policy. The
+        divergence gate applies unless force=True: a non-DRF flip needs
+        a registered shadow scorecard (see `armadactl policy ab`)."""
+        pool = req["pool"]
+        policy = req.get("policy") or None
+        scorecard = req.get("scorecard")
+        if scorecard and policy:
+            self.scheduler.note_policy_shadow(pool, policy, scorecard)
+        self.scheduler.set_fairness_policy(
+            pool, policy, force=bool(req.get("force"))
+        )
+        return {"pool": pool, "policy": self.scheduler.fairness_policy(pool)}
 
     def _cordon_executor(self, req):
         self.scheduler.set_executor_cordon(
@@ -1447,6 +1489,8 @@ class ApiServer:
             "CordonNode": self._cordon_node,
             "SetPriorityOverride": self._set_priority_override,
             "ListPriorityOverrides": self._list_priority_overrides,
+            "PolicyShow": self._policy_show,
+            "PolicySet": self._policy_set,
             "ExecutorLease": self._executor_lease,
             "ReportEvents": self._report_events,
             "ExecutorSync": self._executor_sync,
@@ -1810,6 +1854,25 @@ class ApiClient:
 
     def list_priority_overrides(self):
         return self._call("ListPriorityOverrides", {})["overrides"]
+
+    def policy_show(self, pool=None):
+        """Active fairness policy per pool: {"default", "overrides",
+        "pools": {pool: policy}} (solver/policy.py)."""
+        return self._call("PolicyShow", {"pool": pool or ""})
+
+    def policy_set(self, pool, policy, force=False, scorecard=None):
+        """Flip (policy string) or clear (policy None/"") a pool's
+        fairness policy. Non-DRF flips need a registered shadow
+        scorecard unless force=True (the divergence gate)."""
+        return self._call(
+            "PolicySet",
+            {
+                "pool": pool,
+                "policy": policy or "",
+                "force": bool(force),
+                "scorecard": scorecard,
+            },
+        )
 
     def get_job_logs(self, job_id, tail_lines=100):
         return self._call("GetJobLogs", {"job_id": job_id, "tail_lines": tail_lines})[

@@ -626,6 +626,14 @@ class SchedulerMetrics:
             ["pool", "queue"],
             registry=r,
         )
+        self.fairness_policy_info = Gauge(
+            "scheduler_fairness_policy_info",
+            "Active fairness policy per pool (info-style gauge: the "
+            "series labelled with the live policy reads 1, stale policy "
+            "series read 0 after a flip)",
+            ["pool", "policy"],
+            registry=r,
+        )
         self.preemption_attributed = Counter(
             "scheduler_preemption_attributed_total",
             "Round preemptions attributed to an aggressor queue, by "

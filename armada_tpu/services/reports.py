@@ -49,6 +49,9 @@ class RoundReport:
     num_jobs: int
     num_nodes: int
     termination_reason: str = ""
+    # Active fairness policy the round solved under (solver/policy.py) —
+    # the objective every share/regret figure below is measured against.
+    fairness_policy: str = "drf"
     spot_price: float | None = None  # market mode
     queues: dict = field(default_factory=dict)  # queue -> QueueReport
     job_reasons: dict = field(default_factory=dict)  # job_id -> reason
@@ -68,6 +71,7 @@ class RoundReport:
             f"duration: {self.finished - self.started:.3f}s",
             f"jobs considered: {self.num_jobs}, nodes: {self.num_nodes}",
             f"termination: {self.termination_reason}",
+            f"fairness policy: {self.fairness_policy or 'drf'}",
         ]
         if self.spot_price is not None:
             lines.append(f"spot price: {self.spot_price}")

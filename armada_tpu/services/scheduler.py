@@ -97,6 +97,19 @@ class SchedulerService:
         self._resident: dict = {}
         self.queues: dict[str, QueueSpec] = {q.name: q for q in (queues or [])}
         self.priority_overrides: dict[str, float] = {}
+        # Per-pool fairness-policy runtime overrides (solver/policy.py):
+        # pool -> canonical policy string, layered over the config's
+        # fairnessPolicy block. Event-sourced (FairnessPolicyChange) and
+        # checkpointed, like priority overrides. The BASE pools mapping
+        # is kept aside so clearing an override restores the file config.
+        self.fairness_policy_overrides: dict[str, str] = {}
+        self._base_policy_pools: dict[str, str] = dict(
+            config.fairness_policy_pools
+        )
+        # (pool, policy) -> shadow A/B scorecard registered before a
+        # flip; the set_fairness_policy divergence gate requires one
+        # unless force=True.
+        self._policy_shadow: dict[tuple, dict] = {}
         self.cordoned_queues: set[str] = set()
         self.cordoned_executors: set[str] = set()
         self.executors: dict[str, ExecutorHeartbeat] = {}
@@ -242,6 +255,12 @@ class SchedulerService:
             cursor, state = checkpoint
             self.jobdb.load(state["jobdb"])
             self.priority_overrides.update(state["priority_overrides"])
+            # Older checkpoints predate policy overrides: absent means
+            # every pool runs the file config's policy.
+            self.fairness_policy_overrides.update(
+                state.get("fairness_policy_overrides", {})
+            )
+            self._refresh_policy_config()
             self.cordoned_queues.update(state["cordoned_queues"])
             self.cordoned_executors.update(state["cordoned_executors"])
             # Older checkpoints predate fencing: absent means no fences.
@@ -264,6 +283,7 @@ class SchedulerService:
         return self.ingester.cursor, {
             "jobdb": self.jobdb.dump(),
             "priority_overrides": dict(self.priority_overrides),
+            "fairness_policy_overrides": dict(self.fairness_policy_overrides),
             "cordoned_queues": set(self.cordoned_queues),
             "cordoned_executors": set(self.cordoned_executors),
             "executor_fences": dict(self.executor_fences),
@@ -503,6 +523,100 @@ class SchedulerService:
             PriorityOverride(created=_time.time(), queue=queue, priority_factor=pf),
         ))
 
+    # ---- fairness policy control plane (solver/policy.py) ----
+
+    def fairness_policy(self, pool: str) -> str:
+        """The ACTIVE policy string for a pool: runtime override when
+        set, else the file config's fairnessPolicy block."""
+        from ..solver import policy as fp
+
+        return fp.spec_to_str(fp.spec_from_config(self.config, pool))
+
+    def note_policy_shadow(self, pool: str, policy: str, scorecard: dict):
+        """Register a shadow A/B scorecard (tools/policy_ab.py or a
+        what-if `policy=` plan) for a candidate flip — the evidence the
+        set_fairness_policy divergence gate requires."""
+        from ..solver import policy as fp
+
+        spec = fp.normalize_spec(policy)
+        self._policy_shadow[(pool, fp.spec_to_str(spec))] = dict(scorecard)
+
+    def set_fairness_policy(
+        self, pool: str, policy: str | None, *, force: bool = False
+    ):
+        """Flip a pool's fairness policy at runtime; None clears back to
+        the file config. Event-sourced (FairnessPolicyChange) so the
+        flip survives restarts and failovers; the next round solves
+        under the new objective (the policy is static jit metadata, so
+        the flip costs one recompile per solver rung).
+
+        Divergence gate: a non-default policy is only adopted after a
+        shadow scorecard for (pool, policy) was registered via
+        note_policy_shadow (replay the pool's recorded rounds through
+        tools/policy_ab.py, or run a what-if `policy=` plan), unless
+        force=True."""
+        from ..events.model import CONTROL_PLANE_JOBSET, FairnessPolicyChange
+        from ..solver import policy as fp
+
+        if policy is None:
+            if pool not in self.fairness_policy_overrides:
+                return
+            self.fairness_policy_overrides.pop(pool)
+            self._refresh_policy_config([pool])
+            self.log.publish(EventSequence.of(
+                "", CONTROL_PLANE_JOBSET,
+                FairnessPolicyChange(
+                    created=_time.time(), pool=pool, cleared=True
+                ),
+            ))
+            return
+        spec = fp.normalize_spec(policy)  # ValueError on unknown kinds
+        policy_str = fp.spec_to_str(spec)
+        if self.config.market_driven and fp.spec_kind(spec) != "drf":
+            raise ValueError(
+                "market-driven pools price off the DRF dominant share; "
+                f"cannot flip pool {pool!r} to {policy_str!r}"
+            )
+        if self.fairness_policy(pool) == policy_str:
+            return
+        if (
+            fp.spec_kind(spec) != "drf"
+            and not force
+            and (pool, policy_str) not in self._policy_shadow
+        ):
+            raise ValueError(
+                f"no shadow scorecard registered for pool {pool!r} under "
+                f"{policy_str!r}: replay the pool's recorded rounds with "
+                "tools/policy_ab.py (or a what-if policy= plan) and "
+                "register it via note_policy_shadow, or pass force=True"
+            )
+        self.fairness_policy_overrides[pool] = policy_str
+        self._refresh_policy_config([pool])
+        self.log.publish(EventSequence.of(
+            "", CONTROL_PLANE_JOBSET,
+            FairnessPolicyChange(
+                created=_time.time(), pool=pool, policy=policy_str
+            ),
+        ))
+
+    def _refresh_policy_config(self, pools_changed=None):
+        """Materialize base pools + runtime overrides into the config
+        every snapshot/prep/oracle seam reads, and drop warm solver
+        state for flipped pools: the policy is static jit metadata, so
+        a resident DeviceRound or incremental snapshot built under the
+        old objective must not serve another round."""
+        import dataclasses as _dc
+
+        pools = dict(self._base_policy_pools)
+        pools.update(self.fairness_policy_overrides)
+        if pools != self.config.fairness_policy_pools:
+            self.config = _dc.replace(
+                self.config, fairness_policy_pools=pools
+            )
+        for pool in pools_changed or ():
+            self._inc_state.pop(pool, None)
+            self._resident.pop(pool, None)
+
     def _effective_queue(self, name: str, overrides: dict | None = None) -> QueueSpec:
         overrides = overrides if overrides is not None else self.priority_overrides
         spec = self.queues.get(name, QueueSpec(name))
@@ -577,6 +691,7 @@ class SchedulerService:
         from ..events.model import (
             ExecutorCordon,
             ExecutorFenced,
+            FairnessPolicyChange,
             PriorityOverride,
         )
 
@@ -607,6 +722,12 @@ class SchedulerService:
                 self.priority_overrides.pop(event.queue, None)
             else:
                 self.priority_overrides[event.queue] = event.priority_factor
+        elif isinstance(event, FairnessPolicyChange):
+            if event.cleared:
+                self.fairness_policy_overrides.pop(event.pool, None)
+            else:
+                self.fairness_policy_overrides[event.pool] = event.policy
+            self._refresh_policy_config([event.pool])
 
     # ---- cycle ----
 
@@ -2661,10 +2782,13 @@ class SchedulerService:
         attached for the live surfaces: queue/node/job ids, the
         aggressor's gang identity, and the rendered preemption reason
         that JobRunPreempted events and job timelines carry."""
-        from ..observe.fairness import MECHANISM_PHRASE, resolve_names
+        from ..observe.fairness import mechanism_phrase, resolve_names
 
         resolved = resolve_names(
             fairness, queue_names=snap.queue_names, job_ids=snap.job_ids
+        )
+        active_policy = str(
+            (fairness.get("ledger") or {}).get("policy") or "drf"
         )
         preemptions = []
         for p in resolved["preemptions"]:
@@ -2688,7 +2812,7 @@ class SchedulerService:
                 if 0 <= agg < len(snap.job_gang_id)
                 else ""
             )
-            phrase = MECHANISM_PHRASE.get(p.get("mechanism", ""), "")
+            phrase = mechanism_phrase(p.get("mechanism", ""), active_policy)
             if p["aggressor_queue"]:
                 who = f"queue {p['aggressor_queue']}"
                 if p["aggressor_gang"]:
@@ -2716,8 +2840,12 @@ class SchedulerService:
             # live surfaces still get a host-unit ledger.
             try:
                 from ..observe.fairness import ledger_from_snapshot
+                from ..solver import policy as fp
 
-                fairness = ledger_from_snapshot(snap, result)
+                fairness = ledger_from_snapshot(
+                    snap, result,
+                    policy_spec=fp.spec_from_config(self.config, pool),
+                )
             except Exception as e:  # noqa: BLE001 - advisory path
                 self.log_.with_fields(pool=pool).error(
                     "fairness ledger fallback failed: %r", e
@@ -2736,6 +2864,7 @@ class SchedulerService:
             num_jobs=snap.num_jobs,
             num_nodes=snap.num_nodes,
             termination_reason=result.get("termination_reason", ""),
+            fairness_policy=self.fairness_policy(pool),
             spot_price=result.get("spot_price"),
             indicative_prices=dict(indicative or {}),
         )

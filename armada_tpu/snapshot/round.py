@@ -144,6 +144,13 @@ class RoundSnapshot:
     floating_mask: np.ndarray  # bool[R]
     floating_total: np.ndarray  # int64[R] (zero on non-floating columns)
 
+    # --- pluggable fairness (solver/policy.py) ---
+    # Earliest live-job deadline per queue row (unix seconds; +inf when no
+    # job carries the deadline annotation). Only populated when the pool's
+    # active policy consumes deadlines; None otherwise (prep substitutes
+    # all-+inf, which every other policy ignores).
+    queue_deadline: np.ndarray | None = None  # float64[Q]
+
     @property
     def num_nodes(self) -> int:
         return len(self.node_ids)
@@ -587,6 +594,28 @@ def build_round_snapshot(
     order_res_idx = np.asarray(order_idx, dtype=np.int32)
     order_res_resolution = np.asarray(order_res, dtype=np.int64)
 
+    # Pluggable fairness: the deadline policy folds each queue's most
+    # urgent job deadline into entitlement and candidate order. Only that
+    # policy pays the per-job annotation scan; phantom away rows carry no
+    # home demand and stay +inf. Lazy import: solver packages import this
+    # module at load time.
+    from ..solver import policy as fairness_policy_mod
+
+    queue_deadline = None
+    if fairness_policy_mod.spec_from_config(config, pool)[0] == "deadline":
+        queue_deadline = np.full(Q, np.inf, dtype=np.float64)
+        for j, job in enumerate(jobs):
+            raw = job.annotations.get(fairness_policy_mod.DEADLINE_ANNOTATION)
+            qi = job_queue[j]
+            if raw is None or qi < 0 or job_away[j]:
+                continue
+            try:
+                dl = float(raw)
+            except (TypeError, ValueError):
+                continue
+            if np.isfinite(dl) and dl < queue_deadline[qi]:
+                queue_deadline[qi] = dl
+
     return RoundSnapshot(
         config=config,
         factory=factory,
@@ -653,4 +682,5 @@ def build_round_snapshot(
         ),
         floating_mask=floating_mask,
         floating_total=floating_total,
+        queue_deadline=queue_deadline,
     )

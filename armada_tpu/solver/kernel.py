@@ -170,6 +170,101 @@ def _fair_shares(weights, demand_costs, total_is_zero):
     return fair_share, capped, uncapped
 
 
+# ---------------------------------------------------------------------------
+# Pluggable fairness policies (solver/policy.py holds the host mirrors).
+# dev.fairness_policy is STATIC meta — each helper is a Python branch, so
+# every policy gets its own jit specialization and the DRF branch emits
+# literally the pre-policy graph (bit-exactness with recorded traces by
+# construction). Keep these bit-matching with policy.py's numpy forms.
+# ---------------------------------------------------------------------------
+
+
+def _policy_cost(dev, alloc):
+    """The queue-cost measure candidate ordering runs on: DRF's dominant
+    resource, or the SUM of resource fractions under proportional
+    fairness. Monotone in the allocation either way (the fill paths'
+    closed-form key streams rely on that)."""
+    if dev.fairness_policy[0] == "proportional":
+        total = dev.total_resources
+        safe = jnp.where(total > 0, total, 1.0)
+        frac = jnp.where(total > 0, alloc / safe, 0.0) * dev.drf_multipliers
+        return jnp.maximum(jnp.sum(frac, axis=-1), 0.0)
+    return _drf_cost(alloc, dev.total_resources, dev.drf_multipliers)
+
+
+def _deadline_factors(dev, boost, horizon):
+    """Elementwise IEEE ops only — mirrors policy.deadline_factors
+    bit-for-bit (min is rounding-free, the rest is elementwise)."""
+    dl = _f(dev.queue_deadline)
+    fin = jnp.isfinite(dl)
+    dmin = jnp.min(jnp.where(fin, dl, jnp.inf))
+    rel = jnp.maximum(dl - jnp.where(jnp.any(fin), dmin, 0.0), 0.0)
+    factor = 1.0 + boost / (1.0 + rel / horizon)
+    return jnp.where(fin, factor, 1.0)
+
+
+def _policy_fair_shares(dev, demand_costs, total_is_zero):
+    """Entitlement under the round's policy — the ``_fair_shares`` seat
+    in ``_round_setup``. Returns (fair_share, capped, uncapped)."""
+    kind = dev.fairness_policy[0]
+    w = _f(dev.queue_weight)
+    if kind == "deadline":
+        boost, horizon = dev.fairness_policy[1], dev.fairness_policy[2]
+        return _fair_shares(
+            w * _deadline_factors(dev, boost, horizon),
+            demand_costs,
+            total_is_zero,
+        )
+    if kind == "priority":
+        Q = w.shape[0]
+        wsum = jnp.sum(w)
+        fair_share = jnp.where(
+            wsum > 0.0, w / jnp.where(wsum > 0.0, wsum, 1.0), 0.0
+        )
+        demand = jnp.where(total_is_zero, 1.0, demand_costs)
+        # Serve whole demands in descending-weight order (name-rank
+        # tiebreak); sequential single-accumulator loop matches the
+        # host mirror's float association exactly.
+        order = jnp.lexsort((dev.queue_name_rank, -w))
+
+        def body(i, state):
+            capped, uncapped, cum_prev = state
+            qi = order[i]
+            live = w[qi] > 0.0
+            unc = jnp.clip(1.0 - cum_prev, 0.0, 1.0)
+            capped = capped.at[qi].set(
+                jnp.where(live, jnp.minimum(demand[qi], unc), 0.0)
+            )
+            uncapped = uncapped.at[qi].set(jnp.where(live, unc, 0.0))
+            cum_prev = cum_prev + jnp.where(live, demand[qi], 0.0)
+            return capped, uncapped, cum_prev
+
+        capped, uncapped, _ = jax.lax.fori_loop(
+            0,
+            Q,
+            body,
+            (
+                jnp.zeros(Q, w.dtype),
+                jnp.zeros(Q, w.dtype),
+                jnp.zeros((), w.dtype),
+            ),
+        )
+        return fair_share, capped, uncapped
+    return _fair_shares(w, demand_costs, total_is_zero)
+
+
+def _policy_rank_key(dev):
+    """Optional leading candidate/preemption lex key (smaller wins):
+    None for drf/proportional — their key lists stay structurally
+    identical to the pre-policy kernel."""
+    kind = dev.fairness_policy[0]
+    if kind == "priority":
+        return -_f(dev.queue_weight)
+    if kind == "deadline":
+        return _f(dev.queue_deadline)
+    return None
+
+
 def _static_ok(dev, j, extra_sel, extra_tol=None):
     """StaticJobRequirementsMet over all nodes (nodematching.go:161-190).
     extra_sel: additional required label bits (gang uniformity value);
@@ -916,19 +1011,17 @@ def _pass_segment(
             + i_f[:, None] * req_full[None, :]
         )
         w_q = jnp.maximum(dev.queue_weight[qstar], 1e-12)
-        cur_i = _drf_cost(qa_i, dev.total_resources, dev.drf_multipliers) / w_q
-        prop_i = (
-            _drf_cost(
-                qa_i + req_full[None, :], dev.total_resources, dev.drf_multipliers
-            )
-            / w_q
-        )
+        cur_i = _policy_cost(dev, qa_i) / w_q
+        prop_i = _policy_cost(dev, qa_i + req_full[None, :]) / w_q
         my_keys = []
+        prk = _policy_rank_key(dev)
+        if prk is not None:
+            # Constant in i (the policy rank never moves during a fill),
+            # so the key stream stays monotone and zip-aligned with the
+            # body's qkeys.
+            my_keys.append(jnp.full(B, prk[qstar], dtype=prk.dtype))
         if prefer_large:
-            size = (
-                _drf_cost(req_full, dev.total_resources, dev.drf_multipliers)
-                * dev.queue_weight[qstar]
-            )
+            size = _policy_cost(dev, req_full) * dev.queue_weight[qstar]
             over_i = (prop_i > budgets[qstar]).astype(jnp.int32)
             my_keys += [
                 over_i,
@@ -1290,20 +1383,16 @@ def _pass_segment(
         qa = c.qalloc + _f(dev.queue_short_penalty)  # [Q, R]
         w = jnp.maximum(dev.queue_weight, 1e-12)
         qa_i = qa[:, None, :] + csum_prev
-        cur = (
-            _drf_cost(qa_i, dev.total_resources, dev.drf_multipliers)
-            / w[:, None]
-        )
-        prop = (
-            _drf_cost(qa_i + req_e, dev.total_resources, dev.drf_multipliers)
-            / w[:, None]
-        )
+        cur = _policy_cost(dev, qa_i) / w[:, None]
+        prop = _policy_cost(dev, qa_i + req_e) / w[:, None]
         ekeys = []
+        prk = _policy_rank_key(dev)
+        if prk is not None:
+            # Constant per queue — monotone within every window and
+            # zip-aligned with the body's qkeys for the barrier compare.
+            ekeys.append(jnp.broadcast_to(prk[:, None], (Q, W)))
         if prefer_large:
-            size = (
-                _drf_cost(req_e, dev.total_resources, dev.drf_multipliers)
-                * dev.queue_weight[:, None]
-            )  # [Q, W]
+            size = _policy_cost(dev, req_e) * dev.queue_weight[:, None]  # [Q, W]
             over = (prop > budgets[:, None]).astype(jnp.int32)
             ekeys += [
                 over,
@@ -1461,17 +1550,11 @@ def _pass_segment(
 
         req_h = _f(dev.slot_req[heads])  # [Q, R]
         qalloc_cost = c.qalloc + _f(dev.queue_short_penalty)
-        cur = _drf_cost(qalloc_cost, dev.total_resources, dev.drf_multipliers)
+        cur = _policy_cost(dev, qalloc_cost)
         w = jnp.maximum(dev.queue_weight, 1e-12)
         current = cur / w
-        proposed = (
-            _drf_cost(qalloc_cost + req_h, dev.total_resources, dev.drf_multipliers)
-            / w
-        )
-        size = (
-            _drf_cost(req_h, dev.total_resources, dev.drf_multipliers)
-            * dev.queue_weight
-        )
+        proposed = _policy_cost(dev, qalloc_cost + req_h) / w
+        size = _policy_cost(dev, req_h) * dev.queue_weight
         pcp = jax.vmap(lambda s: _slot_min_prio(dev, c, s))(heads)
 
         keys = []
@@ -1481,6 +1564,9 @@ def _pass_segment(
         elif consider_priority:
             keys.append(-pcp)
         if not dev.market_driven:
+            prk = _policy_rank_key(dev)
+            if prk is not None:
+                keys.append(prk)
             if prefer_large:
                 over = (proposed > budgets).astype(jnp.int32)
                 k1 = jnp.where(over == 1, proposed, current)
@@ -1718,7 +1804,7 @@ def _assign_evict_ranks(dev, carry: Carry, budgets, prefer_large: bool):
 
     w = jnp.maximum(dev.queue_weight, 1e-12)
     qalloc_cost = carry.qalloc + _f(dev.queue_short_penalty)
-    cur = _drf_cost(qalloc_cost, dev.total_resources, dev.drf_multipliers) / w
+    cur = _policy_cost(dev, qalloc_cost) / w
 
     def cond(state):
         _, _, remaining, i = state
@@ -1729,25 +1815,24 @@ def _assign_evict_ranks(dev, carry: Carry, budgets, prefer_large: bool):
         elig = eligible0 & ~done
         heads, has_head = _queue_heads(dev, elig)
         req_h = _f(dev.slot_req[heads])
-        proposed = (
-            _drf_cost(
-                qalloc_cost + req_h, dev.total_resources, dev.drf_multipliers
-            )
-            / w
-        )
-        size = (
-            _drf_cost(req_h, dev.total_resources, dev.drf_multipliers)
-            * dev.queue_weight
-        )
+        proposed = _policy_cost(dev, qalloc_cost + req_h) / w
+        size = _policy_cost(dev, req_h) * dev.queue_weight
         keys = []
         if dev.market_driven:
             keys.append(-dev.slot_price[heads])
-        elif prefer_large:
-            over = (proposed > budgets).astype(jnp.int32)
-            keys += [over, jnp.where(over == 1, proposed, cur),
-                     jnp.where(over == 1, 0.0, -size)]
         else:
-            keys.append(proposed)
+            prk = _policy_rank_key(dev)
+            if prk is not None:
+                # Same leading key as the scheduling passes: low-rank
+                # queues schedule later, so fair preemption (largest
+                # rank first) consumes them first.
+                keys.append(prk)
+            if prefer_large:
+                over = (proposed > budgets).astype(jnp.int32)
+                keys += [over, jnp.where(over == 1, proposed, cur),
+                         jnp.where(over == 1, 0.0, -size)]
+            else:
+                keys.append(proposed)
         keys.append(dev.queue_name_rank)
         qstar, any_head = lex_argmin(keys, has_head)
         sstar = heads[qstar]
@@ -1818,11 +1903,9 @@ def _round_setup(dev: DeviceRound, dist=LOCAL):
     )
     constrained = jnp.sum(demand_capped_pc, axis=1)  # [Q, R]
     total_is_zero = jnp.all(dev.total_resources == 0)
-    demand_costs = _drf_cost(
-        constrained, dev.total_resources, dev.drf_multipliers
-    )
-    fair_share, demand_capped, uncapped = _fair_shares(
-        _f(dev.queue_weight), demand_costs, total_is_zero
+    demand_costs = _policy_cost(dev, constrained)
+    fair_share, demand_capped, uncapped = _policy_fair_shares(
+        dev, demand_costs, total_is_zero
     )
     budgets = jnp.where(
         dev.queue_weight > 0, demand_capped / _f(dev.queue_weight), jnp.inf
@@ -1872,7 +1955,7 @@ def _round_setup(dev: DeviceRound, dist=LOCAL):
     carry = carry._replace(qpc_alloc=run_alloc)
 
     # 1. Balance eviction (NodeEvictor + gang completion).
-    actual_cost = _drf_cost(carry.qalloc, dev.total_resources, dev.drf_multipliers)
+    actual_cost = _policy_cost(dev, carry.qalloc)
     fs = jnp.maximum(demand_capped, fair_share)
     fraction = jnp.where(fs > 0, actual_cost / fs, jnp.inf)
     evict_queue = fraction > dev.protected_fraction

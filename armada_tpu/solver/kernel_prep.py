@@ -26,6 +26,7 @@ import jax
 import numpy as np
 
 from ..snapshot.round import RoundSnapshot
+from . import policy
 
 NO_NODE = -1
 
@@ -43,6 +44,7 @@ _META_FIELDS = (
     "fast_fill",
     "fill_groups",
     "order_key_bits",
+    "fairness_policy",
 )
 
 
@@ -167,6 +169,18 @@ class DeviceRound:
     spot_price_cutoff: np.ndarray  # float scalar
     job_bid: np.ndarray  # float64[J]
 
+    # Pluggable fairness (solver/policy.py). queue_deadline is the
+    # earliest job deadline per queue (+inf when absent; None is allowed
+    # when the policy ignores deadlines — only the deadline-specialized
+    # program reads it, and prep always materializes it). NO __post_init__
+    # may touch these: pytree unflattening reconstructs this dataclass
+    # with arbitrary placeholder leaves (PartitionSpecs, None templates).
+    # fairness_policy is the STATIC spec tuple — part of the jit
+    # signature, so each policy compiles its own program and the default
+    # ("drf",) emits the pre-policy graph unchanged.
+    queue_deadline: np.ndarray | None = None  # float64[Q]
+    fairness_policy: tuple = ("drf",)
+
 
 jax.tree_util.register_dataclass(
     DeviceRound,
@@ -272,6 +286,11 @@ def pad_device_round(dev: DeviceRound) -> DeviceRound:
         queue_demand_pc=pad(dev.queue_demand_pc, 0, Qp),
         queue_pc_limit=pad(dev.queue_pc_limit, 0, Qp, fill=np.inf),
         queue_tokens=pad(dev.queue_tokens, 0, Qp),
+        queue_deadline=(
+            pad(dev.queue_deadline, 0, Qp, fill=np.inf)
+            if dev.queue_deadline is not None
+            else None
+        ),
         num_key_groups=Gp,
     )
     _assert_pad_rows_inert(out, J, S)
@@ -909,4 +928,10 @@ def prep_device_round(
         ),
         spot_price_cutoff=np.float64(cfg.spot_price_cutoff),
         job_bid=snap.job_bid,
+        queue_deadline=(
+            np.asarray(snap.queue_deadline, dtype=np.float64)
+            if snap.queue_deadline is not None
+            else np.full(Q, np.inf, dtype=np.float64)
+        ),
+        fairness_policy=policy.spec_from_config(cfg, snap.pool),
     )

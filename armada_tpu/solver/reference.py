@@ -40,7 +40,7 @@ import numpy as np
 
 from ..core.priorities import EVICTED_PRIORITY, MIN_PRIORITY
 from ..snapshot.round import NO_NODE, RoundSnapshot
-from . import drf
+from . import drf, policy
 from .result import RoundResult
 
 # Unschedulable reasons (constraints/constraints.go:26-57).
@@ -131,6 +131,17 @@ class ReferenceSolver:
         self.mult = snap.drf_multipliers()
         self.total = snap.total_resources.astype(np.float64)
         self.total_is_zero = bool((snap.total_resources == 0).all())
+        # Pluggable fairness (solver/policy.py): the oracle mirrors the
+        # kernel's policy-specialized cost, entitlement and rank hooks.
+        self.policy_spec = policy.spec_from_config(cfg, snap.pool)
+        self.queue_deadline = (
+            np.asarray(snap.queue_deadline, dtype=np.float64)
+            if snap.queue_deadline is not None
+            else np.full(snap.num_queues, np.inf)
+        )
+        self.policy_rank = policy.policy_rank(
+            self.policy_spec, snap.queue_weight, self.queue_deadline
+        )
 
         # Per-round resource cap (calculatePerRoundLimits, constraints.go:200)
         self.max_round_resources = np.full(
@@ -459,9 +470,16 @@ class ReferenceSolver:
             limit = self.queue_pc_limits.get((q, pc_name))
             capped = np.minimum(demand, limit) if limit is not None else demand
             constrained[q] += capped
-        demand_costs = drf.unweighted_cost(constrained, self.total, self.mult)
-        return drf.update_fair_shares(
-            snap.queue_names, snap.queue_weight, demand_costs, self.total_is_zero
+        demand_costs = policy.policy_cost(
+            self.policy_spec, constrained, self.total, self.mult
+        )
+        return policy.policy_fair_shares(
+            self.policy_spec,
+            snap.queue_names,
+            snap.queue_weight,
+            demand_costs,
+            self.total_is_zero,
+            self.queue_deadline,
         )
 
     def _queue_cost(self, q: int, extra=None) -> float:
@@ -471,7 +489,7 @@ class ReferenceSolver:
         if extra is not None:
             alloc = alloc + extra
         return float(
-            drf.unweighted_cost(alloc, self.total, self.mult)
+            policy.policy_cost(self.policy_spec, alloc, self.total, self.mult)
             / self.snap.queue_weight[q]
         )
 
@@ -484,7 +502,9 @@ class ReferenceSolver:
         protected fair share. Decisions use round-start allocations (the
         context is only updated after the evictor finishes)."""
         snap = self.snap
-        actual_cost = drf.unweighted_cost(self.queue_alloc, self.total, self.mult)
+        actual_cost = policy.policy_cost(
+            self.policy_spec, self.queue_alloc, self.total, self.mult
+        )
         evict_queue = np.zeros(snap.num_queues, dtype=bool)
         for q in range(snap.num_queues):
             fs = max(demand_capped[q], fair_share[q])
@@ -607,7 +627,9 @@ class ReferenceSolver:
                 proposed = self._queue_cost(q, req)
                 current = self._queue_cost(q)
                 size = float(
-                    drf.unweighted_cost(req.astype(np.float64), self.total, self.mult)
+                    policy.policy_cost(
+                        self.policy_spec, req.astype(np.float64), self.total, self.mult
+                    )
                     * snap.queue_weight[q]
                 )
                 item = (q, members, True, proposed, current, size, 0)
@@ -797,8 +819,8 @@ class ReferenceSolver:
                 proposed = self._queue_cost(q, req)
                 current = self._queue_cost(q)
                 size = float(
-                    drf.unweighted_cost(
-                        req.astype(np.float64), self.total, self.mult
+                    policy.policy_cost(
+                        self.policy_spec, req.astype(np.float64), self.total, self.mult
                     )
                     * snap.queue_weight[q]
                 )
@@ -840,6 +862,12 @@ class ReferenceSolver:
             return self.snap.queue_names[qa] < self.snap.queue_names[qb]
         if consider_priority and pcp_a != pcp_b:
             return pcp_a > pcp_b
+        if self.policy_rank is not None:
+            # Policy-supplied leading rank (strict priority / deadline):
+            # smaller rank wins, mirroring _policy_rank_key in the kernel.
+            ra, rb = self.policy_rank[qa], self.policy_rank[qb]
+            if ra != rb:
+                return ra < rb
         if self.prefer_large:
             ba, bb = budgets[qa], budgets[qb]
             if prop_a <= ba and prop_b <= bb:

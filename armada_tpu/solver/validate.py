@@ -251,10 +251,17 @@ def _validate_capacity(dev, assigned, scheduled, preempted, J):
 
 def _validate_fairness(fairness) -> RoundViolation | None:
     """fairness_ledger: the share ledger is finite and deliveries sum to
-    at most the pool (each queue's delivered share is a fraction of
-    total resources; their sum cannot exceed 1)."""
+    at most the policy's cost ceiling. Under max-fraction costs (drf /
+    priority / deadline) each queue's delivered share is a fraction of
+    total resources, so the sum cannot exceed 1; under the proportional
+    policy the cost is the SUM of resource fractions, so the pool-wide
+    ceiling is the resource count instead."""
     ledger = (fairness or {}).get("ledger") or {}
     rows = ledger.get("queues") or ()
+    policy_kind = str(ledger.get("policy") or "drf").split("(", 1)[0]
+    bound = 1.0
+    if policy_kind == "proportional":
+        bound = float(max(1, len(ledger.get("delivered_total") or ())))
     delivered = []
     for q, row in enumerate(rows):
         for key in ("fair_share", "delivered_share", "regret"):
@@ -269,11 +276,12 @@ def _validate_fairness(fairness) -> RoundViolation | None:
             delivered.append(float(row["delivered_share"]))
     if delivered:
         tot = float(np.sum(delivered))
-        if tot > 1.0 + 1e-6:
+        if tot > bound + 1e-6:
             return RoundViolation(
                 "fairness_ledger",
-                f"delivered shares sum to {tot:.6f} > 1 (deliveries must "
-                "sum to at most the pool's placements)",
+                f"delivered shares sum to {tot:.6f} > {bound:g} "
+                f"(deliveries under the {policy_kind} policy must sum "
+                "to at most the pool's cost ceiling)",
             )
         if min(delivered) < -1e-9:
             return RoundViolation(

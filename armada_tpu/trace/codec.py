@@ -78,17 +78,36 @@ def encode_device_round(dev: DeviceRound) -> dict:
     }
 
 
+# Fields added after atrace/1 shipped, with the exact value every older
+# bundle's rounds ran under. Decoding substitutes ONLY these — anything
+# else missing is still a schema error. queue_deadline derives its Q
+# from the decoded queue_weight.
+_COMPAT_DEFAULTS = {
+    "fairness_policy": lambda doc: ("drf",),
+    "queue_deadline": lambda doc: np.full(
+        np.asarray(decode_field(doc["queue_weight"])).shape[0],
+        np.inf,
+        dtype=np.float64,
+    ),
+}
+
+
 def decode_device_round(doc: dict) -> DeviceRound:
     fields = {f.name for f in dataclasses.fields(DeviceRound)}
     missing = fields - doc.keys()
     unknown = doc.keys() - fields
+    defaulted = {k for k in missing if k in _COMPAT_DEFAULTS}
+    missing -= defaulted
     if missing or unknown:
         raise TraceFormatError(
             "trace DeviceRound schema mismatch vs this build: "
             f"missing={sorted(missing)} unknown={sorted(unknown)} — "
             "re-record the trace against the current kernel inputs"
         )
-    return DeviceRound(**{k: decode_field(v) for k, v in doc.items()})
+    out = {k: decode_field(v) for k, v in doc.items()}
+    for k in defaulted:
+        out[k] = _COMPAT_DEFAULTS[k](doc)
+    return DeviceRound(**out)
 
 
 def encode_record(record: dict) -> str:

@@ -142,6 +142,17 @@ class TraceRecorder:
                     getattr(cfg, "hot_window_min_slots", 0)
                 ),
                 "priority_classes": sorted(cfg.priority_classes),
+                # Fairness policy (solver/policy.py): default + per-pool
+                # map, so the replayer can refuse a cross-policy
+                # comparison up front (each round's DeviceRound also
+                # carries its own fairness_policy meta). Older bundles
+                # lack the keys (pre-policy == DRF everywhere).
+                "fairness_policy_default": str(
+                    getattr(cfg, "fairness_policy_default", "drf")
+                ),
+                "fairness_policy_pools": dict(
+                    getattr(cfg, "fairness_policy_pools", {})
+                ),
             }
         self._write(
             {

@@ -75,6 +75,28 @@ class TraceTargetMismatch(RuntimeError):
     """The bundle was recorded on a different target than this process."""
 
 
+class CrossPolicyMismatch(RuntimeError):
+    """Two bundles recorded under different fairness policies were
+    handed to a bit-exact differential: every share and most placements
+    legitimately differ, so a drift verdict would be meaningless. Only
+    an EXPLICIT policy A/B (allow_cross_policy=True, or
+    tools/policy_ab.py which compares scorecards rather than bits) may
+    compare across policies."""
+
+
+def trace_policies(trace: Trace) -> dict:
+    """The fairness-policy stamp from a bundle's header (recorder
+    config_summary). Pre-policy bundles read as all-DRF."""
+    summary = (trace.header or {}).get("config_summary") or {}
+    return {
+        "default": str(summary.get("fairness_policy_default") or "drf"),
+        "pools": {
+            str(k): str(v)
+            for k, v in (summary.get("fairness_policy_pools") or {}).items()
+        },
+    }
+
+
 @dataclasses.dataclass
 class RoundRecord:
     raw: dict
@@ -386,6 +408,7 @@ def diff_traces(
     trace_b: Trace,
     *,
     max_rounds: int | None = None,
+    allow_cross_policy: bool = False,
     log=None,
 ) -> dict:
     """Two-bundle differential: pair rounds of two recordings of the
@@ -402,8 +425,25 @@ def diff_traces(
     one mode but not the other is itself a divergence.
 
     Returns {"pairs", "unmatched", "results", "divergences", "ok"}.
+
+    Refuses bundles whose recorded fairness policies differ (header
+    pinning): a cross-policy diff legitimately diverges everywhere, so
+    the drift verdict means nothing. allow_cross_policy=True is the
+    explicit A/B escape hatch (the result then carries both policy
+    stamps); scorecard-level comparison lives in tools/policy_ab.py.
     """
     import json
+
+    pol_a, pol_b = trace_policies(trace_a), trace_policies(trace_b)
+    cross_policy = pol_a != pol_b
+    if cross_policy and not allow_cross_policy:
+        raise CrossPolicyMismatch(
+            f"bundle {trace_a.path} was recorded under fairness policies "
+            f"{pol_a} but {trace_b.path} under {pol_b}: a bit-exact "
+            "differential across policies is meaningless. Pass "
+            "allow_cross_policy=True only for an explicit policy A/B, "
+            "or compare scorecards with tools/policy_ab.py."
+        )
 
     def index(trace):
         by_key = {}
@@ -487,7 +527,7 @@ def diff_traces(
                 )
                 log(f"pool={key[0]} cycle={key[1]}: {status}")
     ok = not by_kind and not unmatched
-    return {
+    out = {
         "trace_a": trace_a.path,
         "trace_b": trace_b.path,
         "pairs": pairs,
@@ -496,6 +536,11 @@ def diff_traces(
         "divergences": by_kind,
         "ok": ok,
     }
+    if cross_policy:
+        out["cross_policy"] = True
+        out["policy_a"] = pol_a
+        out["policy_b"] = pol_b
+    return out
 
 
 def replay_trace(

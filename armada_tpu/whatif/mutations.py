@@ -22,6 +22,10 @@ single decoder, so every surface accepts the same vocabulary:
                                  "priority_class": ..., "requests": {...}}
   scale_queue                   {"name": q, "weight": w} or
                                 {"name": q, "priority_factor": pf}
+  policy                        {"policy": "proportional"} — flip the
+                                forked pool's fairness policy
+                                (solver/policy.py) and re-solve; the
+                                plan's fairness_delta names the payers
 
 Injected jobs are normalized through the SAME snapshot-build helper the
 SubmitChecker uses (`services/submit_check.static_check`), so checker
@@ -250,6 +254,32 @@ class ScaleQueue(Mutation):
             state.queues.append(QueueSpec(self.name, float(pf)))
 
 
+@dataclass
+class SetPolicy(Mutation):
+    """Hypothetical fairness-policy flip for the forked pool: the
+    rollout re-solves under the candidate objective (solver/policy.py),
+    and the plan's fairness_delta names which queues pay for the flip.
+    The live analogue is SchedulerService.set_fairness_policy."""
+
+    kind = "policy"
+    policy: str = ""
+
+    def apply(self, state: ForkState) -> None:
+        from ..solver import policy as fp
+
+        spec = fp.normalize_spec(self.policy)  # ValueError on unknown
+        if getattr(state.config, "market_driven", False) and (
+            fp.spec_kind(spec) != "drf"
+        ):
+            raise ValueError(
+                "market-driven pools price off the DRF dominant share; "
+                f"cannot simulate policy {self.policy!r}"
+            )
+        pools = dict(getattr(state.config, "fairness_policy_pools", {}) or {})
+        pools[state.pool] = fp.spec_to_str(spec)
+        state.config = dc_replace(state.config, fairness_policy_pools=pools)
+
+
 _KINDS = {
     "cordon_node": lambda d: CordonNode(name=d.get("name", d.get("node_id", ""))),
     "uncordon_node": lambda d: CordonNode(
@@ -293,6 +323,9 @@ _KINDS = {
         priority_factor=(
             float(d["priority_factor"]) if d.get("priority_factor") else None
         ),
+    ),
+    "policy": lambda d: SetPolicy(
+        policy=str(d.get("policy", d.get("name", "")))
     ),
 }
 _KINDS["inject_jobs"] = _KINDS["inject_gang"]

@@ -471,6 +471,7 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
             "jain": block["ledger"]["jain"],
             "max_regret": block["ledger"]["max_regret"],
             "preemptions_attributed": len(block["preemptions"]),
+            "policy": block["ledger"].get("policy", "drf"),
         }
     except Exception as e:  # noqa: BLE001 - advisory, never fails the bench
         fairness_extra["fairness"] = {"error": f"{e.__class__.__name__}: {e}"}

@@ -418,3 +418,35 @@ def test_frontdoor_families_live_after_short_soak():
     assert not dead, (
         f"frontdoor_* families never set by the soak: {dead}"
     )
+
+
+def test_fairness_policy_info_gauge_follows_flip():
+    """scheduler_fairness_policy_info is an info-style gauge: the active
+    policy's (pool, policy) series reads 1 and, on a flip, the previous
+    policy's series drops to 0 instead of freezing — a dashboard keyed
+    on ==1 must follow the flip."""
+    from armada_tpu.observe.fairness import FairnessTracker
+
+    m = SchedulerMetrics()
+    tracker = FairnessTracker()
+
+    def value(policy):
+        for fam in m.fairness_policy_info.collect():
+            for s in fam.samples:
+                if s.labels.get("pool") == "p" and (
+                    s.labels.get("policy") == policy
+                ):
+                    return s.value
+        return None
+
+    tracker.observe_round("p", {"ledger": {"queues": [], "jain": 1.0}},
+                          metrics=m)
+    assert value("drf") == 1.0
+
+    tracker.observe_round(
+        "p",
+        {"ledger": {"queues": [], "jain": 1.0, "policy": "proportional"}},
+        metrics=m,
+    )
+    assert value("proportional") == 1.0
+    assert value("drf") == 0.0

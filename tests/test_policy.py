@@ -1,0 +1,599 @@
+"""Pluggable fairness policies (solver/policy.py): spec/config round
+trips, host/device entitlement parity (extreme-weight ULP bounds,
+weight monotonicity, zero-weight/zero-total guards), kernel-vs-oracle
+parity under every policy, DRF bit-exactness against a pre-policy
+recorded fixture, header policy pinning in the replayer, the policy
+A/B harness, and the control-plane flip path (divergence gate,
+event sourcing, checkpoint restore, what-if payers)."""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from armada_tpu.core.config import (
+    PriorityClass,
+    SchedulingConfig,
+    validate_config,
+)
+from armada_tpu.core.types import JobSpec, NodeSpec, QueueSpec
+from armada_tpu.snapshot.round import build_round_snapshot
+from armada_tpu.solver import policy
+from armada_tpu.solver.kernel import solve_round
+from armada_tpu.solver.kernel_prep import pad_device_round, prep_device_round
+from armada_tpu.solver.reference import ReferenceSolver
+
+from test_kernel_parity import PREEMPT_CFG, assert_parity, rand_scenario
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "sim_steady.atrace"
+)
+
+NON_DRF = ("proportional", "priority", "deadline")
+
+
+def _cfg(kind, base=PREEMPT_CFG, **kw):
+    return dataclasses.replace(base, fairness_policy_default=kind, **kw)
+
+
+# ---------------------------------------------------------------------------
+# spec + config round trips
+# ---------------------------------------------------------------------------
+
+
+def test_spec_normalization_round_trips():
+    assert policy.normalize_spec("drf") == ("drf",)
+    assert policy.normalize_spec(["proportional"]) == ("proportional",)
+    assert policy.normalize_spec("deadline") == ("deadline", 2.0, 3600.0)
+    assert policy.normalize_spec(("deadline", 1, 60)) == ("deadline", 1.0, 60.0)
+    assert policy.spec_to_str(("deadline", 1.0, 60.0)) == (
+        "deadline(boost=1,horizon=60)"
+    )
+    for s in ("drf", "proportional", "priority"):
+        assert policy.spec_to_str(policy.normalize_spec(s)) == s
+    with pytest.raises(ValueError, match="unknown fairness policy"):
+        policy.normalize_spec("lottery")
+    with pytest.raises(ValueError, match="boost"):
+        policy.normalize_spec(("deadline", -1.0))
+    with pytest.raises(ValueError, match="horizon"):
+        policy.normalize_spec(("deadline", 1.0, 0.0))
+    with pytest.raises(ValueError, match="takes no parameters"):
+        policy.normalize_spec(("priority", 3.0))
+
+
+def test_config_block_round_trip_and_rejection():
+    d = {
+        "priorityClasses": {"d": {"priority": 1000, "preemptible": True}},
+        "defaultPriorityClassName": "d",
+        "fairnessPolicy": {
+            "default": "proportional",
+            "pools": {"gpu": "deadline", "cpu": "drf"},
+            "deadlineBoost": 3.0,
+            "deadlineHorizonSeconds": 120.0,
+        },
+    }
+    cfg = SchedulingConfig.from_dict(d)
+    assert cfg.fairness_policy_default == "proportional"
+    assert cfg.fairness_policy_pools == {"gpu": "deadline", "cpu": "drf"}
+    assert cfg.fairness_deadline_boost == 3.0
+    assert cfg.fairness_deadline_horizon_s == 120.0
+    validate_config(cfg)
+    assert policy.spec_from_config(cfg, "gpu") == ("deadline", 3.0, 120.0)
+    assert policy.spec_from_config(cfg, "cpu") == ("drf",)
+    assert policy.spec_from_config(cfg, "other") == ("proportional",)
+
+    # A typo must not silently schedule under the wrong objective.
+    bad = dataclasses.replace(cfg, fairness_policy_pools={"gpu": "lottery"})
+    with pytest.raises(ValueError, match="unknown fairness policy"):
+        validate_config(bad)
+    # Market pools price off the DRF dominant share: pinned to drf.
+    market = dataclasses.replace(cfg, market_driven=True)
+    with pytest.raises(ValueError, match="market-driven"):
+        validate_config(market)
+
+
+# ---------------------------------------------------------------------------
+# entitlement math: ULP parity, monotonicity, degenerate guards
+# ---------------------------------------------------------------------------
+
+
+def _ulp_close(a, b, ulps=4):
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    tol = ulps * np.spacing(np.maximum(np.abs(a), np.abs(b)))
+    return (np.abs(a - b) <= tol).all()
+
+
+@pytest.mark.parametrize(
+    # drf (the bit-exactness anchor) and one weight-driven policy run
+    # tier-1; the remaining kinds ride the exhaustive sweep.
+    "kind",
+    ["drf", "proportional"]
+    + [pytest.param(k, marks=pytest.mark.slow)
+       for k in ("priority", "deadline")],
+)
+def test_extreme_weight_waterfill_kernel_ulp(kind):
+    """Extreme weight spreads (1e-6 .. 1e6) through the REAL solve: the
+    jit entitlement (fair/capped/uncapped) must stay within 4 ULP of
+    the host mirror under every policy."""
+    cfg = _cfg(kind)
+    nodes = [
+        NodeSpec(id=f"n{i}", pool="default",
+                 total_resources={"cpu": "16", "memory": "64Gi"})
+        for i in range(3)
+    ]
+    # weight = 1/priority_factor: spread entitlement across 12 orders of
+    # magnitude, the accumulation regime where a reordered float sum
+    # would blow far past a few ULP.
+    factors = [1e6, 1e3, 1.0, 1e-3, 1e-6]
+    queues = [QueueSpec(f"q{i}", f) for i, f in enumerate(factors)]
+    queued = [
+        JobSpec(
+            id=f"j{i:03d}", queue=f"q{i % len(queues)}",
+            requests={"cpu": "2", "memory": "2Gi"},
+            submitted_ts=float(i),
+            annotations={policy.DEADLINE_ANNOTATION: str(100.0 + 31.0 * i)},
+        )
+        for i in range(15)
+    ]
+    snap = build_round_snapshot(cfg, "default", nodes, queues, [], queued)
+    oracle = ReferenceSolver(snap).solve()
+    out = solve_round(pad_device_round(prep_device_round(snap)))
+    Q = snap.num_queues
+    # The decision-driving entitlements (fair share, demand-capped —
+    # they set budgets and protected fractions) must stay within 4 ULP.
+    # The uncapped diagnostic accumulates `share * (unallocated -
+    # spare)` per waterfill pass, where the host mirror sums weights in
+    # name order but the jit form uses jnp.sum's reduction order: at a
+    # 1e12 weight spread the low bits legitimately drift a few more ULP
+    # (replay bit-exactness is unaffected — it compares device against
+    # device).
+    for key, ulps in (
+        ("fair_share", 4),
+        ("demand_capped_fair_share", 4),
+        ("uncapped_fair_share", 16),
+    ):
+        dev_v = np.asarray(out[key])[:Q]
+        host_v = np.asarray(getattr(oracle, key))
+        assert _ulp_close(dev_v, host_v, ulps=ulps), (
+            f"{kind}/{key}: {dev_v} vs {host_v}"
+        )
+
+
+@pytest.mark.parametrize("kind", ("drf",) + NON_DRF)
+def test_entitlement_weight_monotonicity(kind):
+    """Raising one queue's weight must never lower its uncapped
+    entitlement, under every policy."""
+    rng = np.random.default_rng(7)
+    names = [f"q{i}" for i in range(6)]
+    deadlines = np.array([50.0, np.inf, 10.0, 400.0, np.inf, 90.0])
+    for _ in range(20):
+        weights = rng.uniform(0.01, 10.0, size=6)
+        demand = rng.uniform(0.0, 0.7, size=6)
+        spec = policy.normalize_spec(kind)
+        _, _, unc_before = policy.policy_fair_shares(
+            spec, names, weights, demand, queue_deadline=deadlines
+        )
+        for i in range(6):
+            bumped = weights.copy()
+            bumped[i] *= 4.0
+            _, _, unc_after = policy.policy_fair_shares(
+                spec, names, bumped, demand, queue_deadline=deadlines
+            )
+            assert unc_after[i] >= unc_before[i] - 1e-12, (
+                f"{kind}: queue {i} entitlement fell "
+                f"{unc_before[i]} -> {unc_after[i]} on a weight raise"
+            )
+
+
+@pytest.mark.parametrize("kind", ("drf",) + NON_DRF)
+def test_zero_weight_and_zero_total_guards(kind):
+    """All-zero weights yield all-zero (finite) shares; a zero-resource
+    pool (total_is_zero) treats every demand as 1.0; an individual
+    zero-weight queue holds no entitlement — under every policy."""
+    names = ["a", "b", "c"]
+    spec = policy.normalize_spec(kind)
+    dl = np.array([10.0, np.inf, 30.0])
+
+    fs, capped, unc = policy.policy_fair_shares(
+        spec, names, np.zeros(3), np.full(3, 0.5), queue_deadline=dl
+    )
+    for v in (fs, capped, unc):
+        assert np.isfinite(v).all() and (v == 0.0).all(), (kind, v)
+
+    fs, capped, unc = policy.policy_fair_shares(
+        spec, names, np.array([1.0, 0.0, 1.0]), np.full(3, 0.9),
+        total_is_zero=True, queue_deadline=dl,
+    )
+    assert np.isfinite(fs).all() and np.isfinite(capped).all()
+    assert fs[1] == 0.0 and unc[1] == 0.0, (
+        f"{kind}: zero-weight queue holds entitlement {unc[1]}"
+    )
+
+
+def test_proportional_cost_sums_resource_fractions():
+    total = np.array([10.0, 20.0])
+    mult = np.ones(2)
+    alloc = np.array([[5.0, 10.0], [0.0, 0.0]])
+    drf_cost = policy.policy_cost(("drf",), alloc, total, mult)
+    prop_cost = policy.policy_cost(("proportional",), alloc, total, mult)
+    np.testing.assert_allclose(drf_cost, [0.5, 0.0])
+    np.testing.assert_allclose(prop_cost, [1.0, 0.0])
+
+
+# ---------------------------------------------------------------------------
+# kernel vs oracle parity under every policy
+# ---------------------------------------------------------------------------
+
+
+def _stamp_deadlines(queued):
+    return [
+        dataclasses.replace(
+            j,
+            annotations={policy.DEADLINE_ANNOTATION: str(100.0 + 37.0 * i)},
+        )
+        if i % 3 != 2
+        else j
+        for i, j in enumerate(queued)
+    ]
+
+
+@pytest.mark.parametrize("kind", NON_DRF)
+@pytest.mark.parametrize(
+    # Seed 0 for every policy stays tier-1 (each policy spec is its own
+    # compiled program, so one seed already exercises the full solve);
+    # the remaining seeds are the exhaustive sweep.
+    "seed",
+    [0] + [pytest.param(s, marks=pytest.mark.slow) for s in range(1, 4)],
+)
+def test_kernel_oracle_parity_under_policy(kind, seed):
+    rng = np.random.default_rng(1000 + seed)
+    nodes, queues, running, queued = rand_scenario(rng, with_running=True)
+    if kind == "deadline":
+        queued = _stamp_deadlines(queued)
+    assert_parity(
+        _cfg(kind), nodes, queues, running, queued,
+        label=f"policy={kind} seed={seed}",
+    )
+
+
+# ---------------------------------------------------------------------------
+# DRF bit-exactness vs a pre-policy recorded corpus
+# ---------------------------------------------------------------------------
+
+
+def test_drf_replay_bit_exact_on_prepolicy_fixture():
+    """The DRF spec adds no key and keeps the original cost measure, so
+    solving a PRE-policy recorded round reproduces its decision stream
+    bit for bit (the replay-gate invariant, in-suite)."""
+    from armada_tpu.trace.recorder import DECISION_KEYS
+    from armada_tpu.trace.replayer import load_trace
+
+    trace = load_trace(FIXTURE)
+    checked = 0
+    for rec in trace.rounds[:3]:
+        if rec.truncated:
+            continue
+        dev = rec.device_round()
+        # Compat decode: a pre-policy bundle reads as the DRF spec.
+        assert dev.fairness_policy == ("drf",)
+        out = solve_round(dev)
+        recorded = rec.decisions()
+        for key in DECISION_KEYS:
+            if key not in recorded:
+                continue
+            got = np.asarray(out[key])
+            want = np.asarray(recorded[key])
+            assert got.shape == want.shape, key
+            # Byte comparison: bit-exact including NaN payloads (the
+            # spot_price scalar is NaN on non-market pools).
+            assert got.tobytes() == want.tobytes(), (
+                f"round {rec.raw['i']} {key}: DRF replay diverged"
+            )
+            checked += 1
+    assert checked > 0
+
+
+# ---------------------------------------------------------------------------
+# replayer header pinning + the A/B escape hatch
+# ---------------------------------------------------------------------------
+
+
+def _record_tiny_bundle(path, cfg, pool="default"):
+    from armada_tpu.trace.recorder import TraceRecorder
+
+    nodes = [NodeSpec(id="n0", pool=pool,
+                      total_resources={"cpu": "8", "memory": "16Gi"})]
+    queues = [QueueSpec("q")]
+    queued = [
+        JobSpec(id=f"j{i}", queue="q",
+                requests={"cpu": "2", "memory": "1Gi"}, submitted_ts=float(i))
+        for i in range(3)
+    ]
+    snap = build_round_snapshot(cfg, pool, nodes, queues, [], queued)
+    dev = pad_device_round(prep_device_round(snap))
+    out = {k: np.asarray(v) for k, v in solve_round(dev).items()}
+    with TraceRecorder(str(path), config=cfg) as rec:
+        rec.record_round(
+            pool=pool, dev=dev, decisions=out,
+            num_jobs=snap.num_jobs, num_queues=snap.num_queues,
+            config=cfg, cycle=0,
+        )
+    return str(path)
+
+
+def test_replayer_refuses_cross_policy_unless_explicit_ab(tmp_path):
+    from armada_tpu.trace.replayer import (
+        CrossPolicyMismatch,
+        diff_traces,
+        load_trace,
+        trace_policies,
+    )
+
+    # Pre-policy bundles read as all-DRF (satellite: header pinning).
+    assert trace_policies(load_trace(FIXTURE)) == {
+        "default": "drf", "pools": {},
+    }
+
+    a = _record_tiny_bundle(tmp_path / "a.atrace", _cfg("drf"))
+    b = _record_tiny_bundle(
+        tmp_path / "b.atrace",
+        dataclasses.replace(
+            PREEMPT_CFG, fairness_policy_pools={"default": "proportional"}
+        ),
+    )
+    ta, tb = load_trace(a), load_trace(b)
+    assert trace_policies(tb)["pools"] == {"default": "proportional"}
+    with pytest.raises(CrossPolicyMismatch, match="policy_ab"):
+        diff_traces(ta, tb)
+    # The explicit A/B escape hatch stamps both policies on the result.
+    result = diff_traces(ta, tb, allow_cross_policy=True)
+    assert result["cross_policy"] is True
+    assert result["policy_a"] != result["policy_b"]
+    # Same-policy bundles diff normally (and bit-exactly with selves).
+    self_diff = diff_traces(ta, load_trace(a))
+    assert self_diff["ok"] and not self_diff.get("cross_policy")
+
+
+# ---------------------------------------------------------------------------
+# the policy A/B harness (tier-1 smoke on the recorded fixture)
+# ---------------------------------------------------------------------------
+
+
+def test_policy_ab_smoke_on_steady_fixture():
+    from armada_tpu.trace.policy_ab import (
+        DEFAULT_CANDIDATES,
+        ab_compare,
+        render_ab,
+    )
+
+    result = ab_compare(
+        [FIXTURE], DEFAULT_CANDIDATES,
+        solver="LOCAL", allow_foreign=True, max_rounds=3,
+    )
+    cards = result["policies"]
+    assert set(cards) == {
+        "drf", "proportional", "priority", "deadline(boost=2,horizon=3600)",
+    }
+    for name, card in cards.items():
+        assert card["rounds"] == 3, name
+        assert 0.0 <= card["jain_min"] <= card["jain_mean"] <= 1.0, name
+        assert card["queues"], name
+    # Proportional prices the SUM of resource fractions: delivered
+    # shares must differ from the DRF scorecard on this corpus.
+    drf_delivered = {
+        q: s["mean_delivered"] for q, s in cards["drf"]["queues"].items()
+    }
+    prop_delivered = {
+        q: s["mean_delivered"]
+        for q, s in cards["proportional"]["queues"].items()
+    }
+    assert drf_delivered != prop_delivered
+    rendered = render_ab(result)
+    assert "proportional" in rendered and "per-queue delivered" in rendered
+
+
+# ---------------------------------------------------------------------------
+# control plane: divergence gate, event sourcing, checkpoint restore
+# ---------------------------------------------------------------------------
+
+
+def _scheduler(cfg=None, log=None, checkpoint=None):
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.scheduler import SchedulerService
+
+    cfg = cfg or SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+    )
+    log = log if log is not None else InMemoryEventLog()
+    return SchedulerService(cfg, log, checkpoint=checkpoint), log
+
+
+def test_policy_flip_gate_event_and_checkpoint_restore():
+    sched, log = _scheduler()
+    assert sched.fairness_policy("default") == "drf"
+
+    # Divergence gate: a non-DRF flip without shadow evidence refuses.
+    with pytest.raises(ValueError, match="shadow scorecard"):
+        sched.set_fairness_policy("default", "proportional")
+    with pytest.raises(ValueError, match="unknown fairness policy"):
+        sched.set_fairness_policy("default", "lottery", force=True)
+
+    sched.note_policy_shadow("default", "proportional", {"jain_mean": 0.99})
+    sched.set_fairness_policy("default", "proportional")
+    assert sched.fairness_policy("default") == "proportional"
+    # The flip materializes into the config every snapshot/prep seam
+    # reads, and is event-sourced as a control-plane event.
+    assert sched.config.fairness_policy_pools["default"] == "proportional"
+    from armada_tpu.events.model import FairnessPolicyChange
+
+    events = [
+        ev
+        for e in log.read(0, 10**6)
+        for ev in e.sequence.events
+        if isinstance(ev, FairnessPolicyChange)
+    ]
+    assert events and events[-1].policy == "proportional"
+
+    # Checkpoint restore: a bounded restart keeps the flipped pool.
+    cursor, state = sched.checkpoint_state()
+    assert state["fairness_policy_overrides"] == {"default": "proportional"}
+    from armada_tpu.events import InMemoryEventLog
+
+    sched2, _ = _scheduler(log=InMemoryEventLog(), checkpoint=(cursor, state))
+    assert sched2.fairness_policy("default") == "proportional"
+    assert sched2.config.fairness_policy_pools["default"] == "proportional"
+    # Pre-policy checkpoints (no key) restore to the file config.
+    old_state = {k: v for k, v in state.items()
+                 if k != "fairness_policy_overrides"}
+    sched3, _ = _scheduler(
+        log=InMemoryEventLog(), checkpoint=(cursor, old_state)
+    )
+    assert sched3.fairness_policy("default") == "drf"
+
+    # Clearing reverts to the file config and is itself event-sourced.
+    sched.set_fairness_policy("default", None)
+    assert sched.fairness_policy("default") == "drf"
+    assert "default" not in sched.fairness_policy_overrides
+
+
+def test_policy_change_event_applies_on_replica_sync():
+    """A follower materializes the flip from the event log alone (the
+    leader's in-process setter never ran there)."""
+    from armada_tpu.events import EventSequence
+    from armada_tpu.events.model import (
+        CONTROL_PLANE_JOBSET,
+        FairnessPolicyChange,
+    )
+
+    sched, log = _scheduler()
+    log.publish(EventSequence.of(
+        "", CONTROL_PLANE_JOBSET,
+        FairnessPolicyChange(created=1.0, pool="default", policy="priority"),
+    ))
+    sched.ingester.sync()
+    assert sched.fairness_policy("default") == "priority"
+    log.publish(EventSequence.of(
+        "", CONTROL_PLANE_JOBSET,
+        FairnessPolicyChange(created=2.0, pool="default", cleared=True),
+    ))
+    sched.ingester.sync()
+    assert sched.fairness_policy("default") == "drf"
+
+
+def test_market_pool_refuses_non_drf_flip():
+    cfg = SchedulingConfig(
+        priority_classes={"d": PriorityClass("d", 1000, preemptible=True)},
+        default_priority_class="d",
+        market_driven=True,
+    )
+    sched, _ = _scheduler(cfg=cfg)
+    with pytest.raises(ValueError, match="market-driven"):
+        sched.set_fairness_policy("default", "proportional", force=True)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: report string, mechanism phrases, whatif payers
+# ---------------------------------------------------------------------------
+
+
+def test_report_string_names_active_policy():
+    from armada_tpu.services.reports import RoundReport
+
+    rep = RoundReport(pool="p", started=0.0, finished=1.0, num_jobs=0,
+                      num_nodes=0, fairness_policy="proportional")
+    assert "fairness policy: proportional" in rep.report_string()
+
+
+def test_mechanism_phrase_names_active_policy():
+    from armada_tpu.observe import mechanism_phrase
+
+    assert "DRF rebalance" in mechanism_phrase("fairness")
+    assert "proportional-fairness rebalance" in mechanism_phrase(
+        "fairness", "proportional"
+    )
+    assert "deadline-aware rebalance" in mechanism_phrase(
+        "fairness", "deadline(boost=2,horizon=3600)"
+    )
+    # Non-fairness mechanisms keep their phrases regardless of policy.
+    assert mechanism_phrase("urgency", "priority") == mechanism_phrase(
+        "urgency"
+    )
+
+
+def test_whatif_policy_flip_fairness_delta_names_payers():
+    """A what-if `policy=priority` rollout on a contended pool must
+    re-solve under the candidate objective and name which queues pay
+    (Plan.fairness_delta)."""
+    from armada_tpu.core.types import QueueSpec as QS
+    from armada_tpu.events import InMemoryEventLog
+    from armada_tpu.services.fake_executor import FakeExecutor, make_nodes
+    from armada_tpu.services.scheduler import SchedulerService
+    from armada_tpu.services.submit import SubmitService
+    from armada_tpu.whatif import WhatIfService, mutations_from_dicts
+
+    cfg = SchedulingConfig(
+        priority_classes={
+            "low": PriorityClass("low", 1000, preemptible=True),
+        },
+        default_priority_class="low",
+        protected_fraction_of_fair_share=0.0,
+    )
+    log = InMemoryEventLog()
+    sched = SchedulerService(cfg, log)
+    submit = SubmitService(cfg, log, scheduler=sched)
+    # Weights close enough that the DRF waterfill gives BOTH queues
+    # capacity at baseline (heavy 2/3, light 1/3 of 4 slots) — strict
+    # priority then hands the whole pool to the heavier queue, so the
+    # flip has a payer to name.
+    submit.create_queue(QS("heavy"))           # weight 1
+    submit.create_queue(QS("light", 2.0))      # weight 0.5: pays first
+    ex = FakeExecutor("ex", log, sched,
+                      nodes=make_nodes("ex", count=2, cpu="8"),
+                      runtime_for=lambda jid: 1e9)
+    jobs = []
+    for i in range(6):
+        jobs.append(JobSpec(
+            id=f"h{i}", queue="heavy", jobset="s",
+            requests={"cpu": "4", "memory": "1Gi"}, submitted_ts=float(i),
+        ))
+    submit.submit("heavy", "s", jobs, now=0.0)
+    light = [JobSpec(
+        id=f"l{i}", queue="light", jobset="s",
+        requests={"cpu": "4", "memory": "1Gi"}, submitted_ts=float(10 + i),
+    ) for i in range(6)]
+    submit.submit("light", "s", light, now=0.0)
+
+    def cycle(t):
+        ex.tick(t)
+        sched.cycle(now=t)
+        ex.tick(t)
+
+    cycle(0.0)
+    wi = WhatIfService(sched)
+    sched.attach_whatif(wi)
+    cycle(1.0)  # capture the fork seam with both queues live
+
+    plan = wi.plan(
+        mutations_from_dicts([{"kind": "policy", "policy": "priority"}]),
+        rounds=3,
+    )
+    delta = plan.fairness_delta
+    assert delta, "contended pool must produce a fairness delta"
+    assert "light" in delta["queues"] and "heavy" in delta["queues"]
+    # Strict priority hands the pool to the heavier queue: the
+    # low-weight queue pays for the flip.
+    assert "light" in delta["payers"], delta
+    assert (
+        delta["queues"]["heavy"]["delta_delivered"]
+        >= -1e-9
+    ), delta
+
+    # Unknown candidate policies refuse at mutation decode time.
+    with pytest.raises(ValueError, match="unknown fairness policy"):
+        wi.plan(
+            mutations_from_dicts([{"kind": "policy", "policy": "lottery"}]),
+            rounds=1,
+        )

@@ -46,7 +46,7 @@ def rows(search_dir: str) -> list[dict]:
                "tracking": None, "burst": None, "solve": None,
                "trace": False, "params": None, "whatif": None,
                "frontdoor": None, "transfer": None, "fairness": None,
-               "residency": None}
+               "policy": None, "residency": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -144,6 +144,13 @@ def rows(search_dir: str) -> list[dict]:
                 and isinstance(regret, (int, float))
                 else "yes"
             )
+            # Active fairness policy (pre-policy artifacts lack the key
+            # and print "-"): a trend break across a flip must be
+            # attributable to the objective change, not read as a
+            # regression.
+            pol = fairness.get("policy")
+            if isinstance(pol, str) and pol:
+                row["policy"] = pol
         params = extra.get("params") if isinstance(extra, dict) else None
         if isinstance(params, dict):
             # Effective headline solver parameters (window/chunk, "*"
@@ -170,7 +177,7 @@ def main(argv=None) -> int:
         f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} "
         f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6} {'whatif':>9} "
         f"{'frontdoor':>10} {'transfer':>16} {'residency':>14} "
-        f"{'fairness':>15}"
+        f"{'fairness':>15} {'policy':>12}"
     )
     print(header)
     print("-" * len(header))
@@ -184,7 +191,8 @@ def main(argv=None) -> int:
             f"{r.get('frontdoor') or '-':>10} "
             f"{r.get('transfer') or '-':>16} "
             f"{r.get('residency') or '-':>14} "
-            f"{r.get('fairness') or '-':>15}"
+            f"{r.get('fairness') or '-':>15} "
+            f"{r.get('policy') or '-':>12}"
         )
     return 0
 
