@@ -266,6 +266,15 @@ class SchedulingConfig:
     # `fairness_deadline_horizon_s` seconds of slack.
     fairness_policy_default: str = "drf"
     fairness_policy_pools: dict = field(default_factory=dict)
+    # Solve kernel path (armada_tpu/ops/pallas_kernels.py): "lax" is the
+    # pre-pallas graph; "blocked" fuses the pass-1 scoring chain and
+    # swaps the fill sort for the radix-threshold top-B (the CPU-fast
+    # path); "pallas" runs the same scoring body as an interpret-mode
+    # pallas kernel (bit-exact, parity-gated); "native" compiles it for
+    # an attached TPU behind the relay preflight probe, demoting to
+    # "pallas" anywhere that probe fails. The ARMADA_TPU_KERNEL_PATH env
+    # var overrides this for one process (bench A/Bs, the pallas probe).
+    solve_kernel_path: str = "lax"
     fairness_deadline_boost: float = 2.0
     fairness_deadline_horizon_s: float = 3600.0
     executor_timeout_s: float = 600.0
@@ -643,6 +652,7 @@ class SchedulingConfig:
             ("autotuneMinWindowSlots", "autotune_min_window_slots", int),
             ("autotuneMaxWindowSlots", "autotune_max_window_slots", int),
             ("enableFastFill", "enable_fast_fill", bool),
+            ("solveKernelPath", "solve_kernel_path", str),
             ("fillGroupMax", "fill_group_max", int),
             ("frontdoorShards", "frontdoor_shards", int),
             ("frontdoorTenantRate", "frontdoor_tenant_rate", float),
@@ -733,6 +743,10 @@ def validate_config(config: SchedulingConfig):
         problems.append("hotWindowSlots must be >= 0")
     if config.hot_window_min_slots < 0:
         problems.append("hotWindowMinSlots must be >= 0")
+    if config.solve_kernel_path not in ("lax", "blocked", "pallas", "native"):
+        problems.append(
+            "solveKernelPath must be one of lax|blocked|pallas|native"
+        )
     if config.hot_window_slots > 0 and config.hot_window_min_slots > 0:
         # Compaction engages only when the padded slot axis S clears
         # BOTH hotWindowMinSlots and 2*Q*Ws (the window must actually

@@ -62,19 +62,28 @@ def make_host_mesh(n_hosts: int, n_chips: int, devices=None) -> Mesh:
     return Mesh(grid, (HOST_AXIS, CHIP_AXIS))
 
 
-def hierarchical_sharded_solve(mesh: Mesh):
+def hierarchical_sharded_solve(mesh: Mesh, kernel_path: str = "lax"):
     """Jitted round solve over a 2D (hosts, chips) mesh through the
     two-level HierarchicalDist seam. Same contract as
     mesh.node_sharded_solve: pad the node axis to a multiple of
     hosts*chips first; outputs are replicated and bit-identical to the
-    single-device solve."""
+    single-device solve.
+
+    kernel_path "pallas"/"native" swaps in PallasHierarchicalDist
+    (solver/dist_pallas.py): the host-level winner exchange runs as the
+    pallas tree/ring kernel — bit-exact by construction, so the runner
+    stays interchangeable with the lax one rung-for-rung."""
     if mesh.devices.ndim != 2 or mesh.axis_names != (HOST_AXIS, CHIP_AXIS):
         raise ValueError(
             f"expected a ({HOST_AXIS}, {CHIP_AXIS}) mesh, got "
             f"{mesh.axis_names} with shape {mesh.devices.shape}"
         )
     n_hosts, n_chips = mesh.devices.shape
-    dist = HierarchicalDist(
+    if kernel_path in ("pallas", "native"):
+        from ..solver.dist_pallas import PallasHierarchicalDist as _Dist
+    else:
+        _Dist = HierarchicalDist
+    dist = _Dist(
         HOST_AXIS, CHIP_AXIS, n_hosts, n_chips, stats=CollectiveStats()
     )
     return sharded_solve(mesh, dist, _NODE_SHARDED_2D)
@@ -122,18 +131,20 @@ def parse_mesh_spec(spec) -> MeshSpec:
     return MeshSpec(1, int(spec))
 
 
-def resolve_solver(spec):
+def resolve_solver(spec, kernel_path: str = "lax"):
     """Mesh spec -> solve runner, end to end: the seam
     services/scheduler.py, sim/simulator.py and bench.py share.
 
     A jax Mesh passes through as-is; anything else builds a mesh over
     the first hosts*chips jax devices. hosts == 1 uses the 1D
     single-fabric path; hosts > 1 the two-level hierarchy. The returned
-    callable carries `.stats`, `.n_shards` and `.mesh_shape`."""
+    callable carries `.stats`, `.n_shards` and `.mesh_shape`.
+    `kernel_path` selects the winner-exchange dist on 2D meshes (see
+    hierarchical_sharded_solve); 1D meshes have no host level to swap."""
     if isinstance(spec, Mesh):
         parse_mesh_spec(spec)  # reject rank != 1, 2 at the seam
         if spec.devices.ndim == 2:
-            return hierarchical_sharded_solve(spec)
+            return hierarchical_sharded_solve(spec, kernel_path)
         if spec.axis_names != ("nodes",):
             # ShardDist hard-codes the "nodes" axis; fail here, not as
             # an unbound-axis-name error at first solve.
@@ -152,5 +163,5 @@ def resolve_solver(spec):
     if ms.hosts == 1:
         return node_sharded_solve(make_node_mesh(devices[: ms.n_shards]))
     return hierarchical_sharded_solve(
-        make_host_mesh(ms.hosts, ms.chips, devices)
+        make_host_mesh(ms.hosts, ms.chips, devices), kernel_path
     )

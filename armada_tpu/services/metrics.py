@@ -634,6 +634,15 @@ class SchedulerMetrics:
             ["pool", "policy"],
             registry=r,
         )
+        self.solve_kernel_info = Gauge(
+            "scheduler_solve_kernel_info",
+            "Active solve kernel path per pool (info-style gauge: the "
+            "series labelled with the path the last committed round "
+            "actually ran — lax/blocked/pallas/native — reads 1; stale "
+            "path series read 0 after a failover demotion or flip)",
+            ["pool", "path"],
+            registry=r,
+        )
         self.preemption_attributed = Counter(
             "scheduler_preemption_attributed_total",
             "Round preemptions attributed to an aggressor queue, by "

@@ -1719,16 +1719,20 @@ class SchedulerService:
             )
         return sequences
 
-    def _resolve_sharded_run(self):
+    def _resolve_sharded_run(self, kernel_path: str = "lax"):
         """Lazily build the sharded solve runner for self.mesh: an int or
         1D jax Mesh selects the single-host node-sharded path, an "HxC"
         string / (hosts, chips) tuple / 2D Mesh the two-level
         ICI-within-host + DCN-across-hosts hierarchy
-        (parallel/multihost.py)."""
+        (parallel/multihost.py). kernel_path (the first pool's configured
+        solve kernel; the runner is built once and shared) selects the
+        pallas winner-reduce variant of the hierarchy when non-lax."""
         if self._sharded_run is None:
             from ..parallel.multihost import resolve_solver
 
-            self._sharded_run = resolve_solver(self.mesh)
+            self._sharded_run = resolve_solver(
+                self.mesh, kernel_path=kernel_path
+            )
             self._mesh_size = self._sharded_run.n_shards
         return self._sharded_run
 
@@ -1767,6 +1771,25 @@ class SchedulerService:
                 stats.per_select_dcn_scalars
             )
         self.metrics.shard_solve_time.labels(pool=pool).observe(solve_s)
+
+    def _note_solve_kernel(self, pool: str, path: str):
+        """Info-style active-kernel gauge (mirrors fairness_policy_info):
+        the series for the path the pool's last committed round actually
+        ran reads 1; on a flip — config change or a failover demotion
+        off a poisoned pallas/blocked executable — the stale path's
+        series drops to 0 instead of freezing at 1."""
+        if self.metrics is None or self.metrics.registry is None:
+            return
+        live = getattr(self, "_solve_kernel_live", None)
+        if live is None:
+            live = self._solve_kernel_live = {}
+        prev = live.get(pool)
+        if prev is not None and prev != path:
+            self.metrics.solve_kernel_info.labels(pool=pool, path=prev).set(
+                0.0
+            )
+        self.metrics.solve_kernel_info.labels(pool=pool, path=path).set(1.0)
+        live[pool] = path
 
     def _note_transfer(self, pool: str, transfer: dict | None,
                        compiles: dict | None = None):
@@ -2506,7 +2529,10 @@ class SchedulerService:
                     # single-device for now).
                     from ..parallel.mesh import pad_nodes
 
-                    run = self._resolve_sharded_run()
+                    run = self._resolve_sharded_run(
+                        str(getattr(snap.config, "solve_kernel_path", "lax")
+                            or "lax")
+                    )
                     t0 = _t.monotonic()
                     out = run(pad_nodes(dev, self._mesh_size))
                     # jit dispatch is asynchronous: force execution so the
@@ -2526,7 +2552,11 @@ class SchedulerService:
                         )
                     shape = run.mesh_shape
                     hosts, chips = shape if len(shape) == 2 else (1, shape[0])
-                    solver_info = {"backend": "kernel", "mesh": f"{hosts}x{chips}"}
+                    solver_info = {
+                        "backend": "kernel",
+                        "mesh": f"{hosts}x{chips}",
+                        "kernel": getattr(dev, "kernel_path", "lax"),
+                    }
                 else:
                     tuned = (
                         self.autotune.params_for(snap.pool)
@@ -2548,17 +2578,35 @@ class SchedulerService:
                         window = snap.config.hot_window_slots or None
                         window_min_slots = snap.config.hot_window_min_slots
                         chunk_loops = 1
+                    # Solve-kernel selection (ops/pallas_kernels.py): the
+                    # RUNG decides the path — a "local:<path>" rung runs
+                    # the configured blocked/pallas program while plain
+                    # LOCAL and hotwindow rungs force the lax graph.
+                    # kernel_path is static jit meta, so each path is a
+                    # distinct compiled program the failover ladder can
+                    # demote between when one executable is poisoned.
+                    want = (
+                        str(rung.param)
+                        if rung.kind == "local" and rung.param
+                        else "lax"
+                    )
+                    if getattr(dev, "kernel_path", "lax") != want:
+                        import dataclasses as _dcls
+
+                        dev = _dcls.replace(dev, kernel_path=want)
                     out = solve_round(
                         dev,
                         budget_s=budget_s,
                         chunk_loops=chunk_loops,
                         window=window,
                         window_min_slots=window_min_slots,
+                        readback_rows=snap.num_jobs,
                     )
                     solver_info = {
                         "backend": "kernel",
                         "mesh": None,
                         "rung": rung.label,
+                        "kernel": want,
                         "window": int(window or 0),
                         "budget": bool(budget_s),
                         "autotuned": tuned is not None,
@@ -2630,6 +2678,9 @@ class SchedulerService:
                 out["profile"] = cost_profile
             if not shadow:
                 self._note_transfer(snap.pool, transfer, compiles)
+                self._note_solve_kernel(
+                    snap.pool, str(solver_info.get("kernel") or "lax")
+                )
                 if self.trace_recorder is not None:
                     self._trace_round(
                         snap,

@@ -63,6 +63,16 @@ class CollectiveStats:
     dcn_bytes: int = 0
     per_select_dcn_scalars: int = 0
     per_select_ici_scalars: int = 0
+    # Pallas kernel accounting (ops/pallas_kernels.py): call sites, node
+    # blocks and VMEM-resident bytes of the fused scoring kernel, plus
+    # the winner exchange's tree/ring step count and DMA payload bytes —
+    # booked at trace time like every other counter here, so the fabric
+    # cost model is asserted on CPU interpret runs too.
+    pallas_calls: int = 0
+    pallas_blocks: int = 0
+    pallas_vmem_bytes: int = 0
+    ring_steps: int = 0
+    ring_bytes: int = 0
 
     def begin_trace(self) -> None:
         """Zero the per-program accounting. Called at the START of each
@@ -74,6 +84,8 @@ class CollectiveStats:
         self.ici_scalars = self.dcn_scalars = 0
         self.ici_bytes = self.dcn_bytes = 0
         self.per_select_dcn_scalars = self.per_select_ici_scalars = 0
+        self.pallas_calls = self.pallas_blocks = self.pallas_vmem_bytes = 0
+        self.ring_steps = self.ring_bytes = 0
 
     def note(self, level: str, arrays) -> None:
         fanin = self.n_chips if level == "ici" else self.n_hosts
@@ -93,9 +105,16 @@ class CollectiveStats:
         return dataclasses.asdict(self)
 
 
-def _fill_sort(keys, mask, B):
+def _fill_sort(keys, mask, B, path="lax", nbits=None):
     """Indices of the B lexicographically-smallest masked entries (sorted).
-    Masked-out entries sort last (shared sentinel keys, ops/select.py)."""
+    Masked-out entries sort last (shared sentinel keys, ops/select.py).
+    A non-lax `path` routes the fused single-int64 key through the
+    blocked top-B selection (ops/pallas_kernels.fill_sort_path), which is
+    lexsort-exact index-for-index; everything else keeps the lax sort."""
+    if path != "lax":
+        from ..ops.pallas_kernels import fill_sort_path
+
+        return fill_sort_path(keys, mask, B, path, nbits)
     mk = masked_keys(keys, mask)
     # jnp.lexsort: LAST key is primary -> reverse (ours is first-primary).
     order = jnp.lexsort(tuple(reversed(mk)))
@@ -106,6 +125,7 @@ class LocalDist:
     """Single-device execution: all ops are plain indexing."""
 
     n_shards = 1
+    stats = None
 
     def num_nodes(self, alloc):
         """Global node count, given the (locally visible) alloc[P, n, R]."""
@@ -149,11 +169,11 @@ class LocalDist:
             contrib, jnp.clip(nodes, 0, ln - 1), num_segments=ln
         )
 
-    def fill_candidates(self, keys, mask, caps, gids, B):
+    def fill_candidates(self, keys, mask, caps, gids, B, path="lax", nbits=None):
         """The globally best (lex-smallest-key) <=B candidate nodes, in fill
         order: (caps[B'], gids[B']) with caps 0 for masked-out entries. A
         batch of <=B jobs needs at most B nodes, so B candidates suffice."""
-        take, _ = _fill_sort(keys, mask, B)
+        take, _ = _fill_sort(keys, mask, B, path, nbits)
         return jnp.where(mask[take], caps[take], 0), gids[take]
 
 
@@ -245,11 +265,11 @@ class ShardDist:
             num_segments=ln,
         )
 
-    def fill_candidates(self, keys, mask, caps, gids, B):
+    def fill_candidates(self, keys, mask, caps, gids, B, path="lax", nbits=None):
         """Per-shard top-B by local sort, then an all_gather of the K*B
         shard winners and a small merge sort — the fill analogue of the
         per-select argmin reduction. Results are shard-invariant."""
-        take, mk = _fill_sort(keys, mask, B)
+        take, mk = _fill_sort(keys, mask, B, path, nbits)
         lkeys = [k[take] for k in mk]
         lcaps = jnp.where(mask[take], caps[take], 0)
         lgids = gids[take]
@@ -361,13 +381,13 @@ class HierarchicalDist(ShardDist):
         widx, wfound = lex_argmin(gkeys, gfound)
         return jnp.where(wfound, ggid[widx], 0).astype(jnp.int32), wfound
 
-    def fill_candidates(self, keys, mask, caps, gids, B):
+    def fill_candidates(self, keys, mask, caps, gids, B, path="lax", nbits=None):
         """Hierarchical top-B merge: chips' top-Bs -> host top-B over ICI,
         hosts' top-Bs -> global top-B over DCN. The global top-B is a
         subset of the union of per-host top-Bs, so the two-level merge is
         exact; entry keys end in the globally-unique node id rank, so the
         merged ORDER matches the flat sort too."""
-        take, mk = _fill_sort(keys, mask, B)
+        take, mk = _fill_sort(keys, mask, B, path, nbits)
         lkeys = [k[take] for k in mk]
         lcaps = jnp.where(mask[take], caps[take], 0)
         lgids = gids[take]

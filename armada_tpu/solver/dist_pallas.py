@@ -1,0 +1,65 @@
+"""Pallas-backed hierarchical dist: the winner exchange as a kernel.
+
+`HierarchicalDist` (dist.py) closes every candidate selection with an
+`all_gather` of one winner tuple per host followed by a lex-argmin over
+the gathered [hosts, keys] block. This subclass keeps the chip-level ICI
+stage verbatim — the per-host winner is still produced by gather+argmin
+inside a host, where XLA already fuses it — and replaces the host-level
+finish with `ops/pallas_kernels.winner_reduce`: a pallas tree-reduction
+over the gathered tuples that runs interpreted (bit-exact, CPU tier-1)
+everywhere a TPU isn't attached, and compiles natively behind the
+`native_available()` probe. On hardware the same tuple exchange can run
+as an ICI ring of `make_async_remote_copy` steps
+(`pallas_kernels.ring_winner_exchange`), overlapping each DMA hop with
+the comparison of the previous arrival; the tree kernel is its bit-exact
+stand-in everywhere else, and `CollectiveStats.ring_steps`/`ring_bytes`
+book the exchange's fabric cost either way.
+
+Selection semantics are unchanged by construction: the reduction's last
+compare key is the globally unique node id rank, so the found-row
+minimum is unique however the reduce associates (tree, ring, or flat
+argmin), and not-found rows carry sentinel keys that lose to any real
+winner. tests/test_pallas_parity.py pins 2x4 rounds bit-exact against
+the single-device solve through this dist.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import pallas_kernels as pk
+from ..ops.select import lex_argmin
+from .dist import HierarchicalDist
+
+
+class PallasHierarchicalDist(HierarchicalDist):
+    """HierarchicalDist with the host-level winner exchange reduced by
+    the pallas tree kernel (ring on native TPU)."""
+
+    def lex_argmin_nodes(self, keys, mask, gids):
+        lidx, lfound = lex_argmin(keys, mask)
+        if self.stats is not None:
+            self.stats.selects += 1
+            self.stats.note("ici", [k[lidx] for k in keys] + [lfound, lidx])
+            self.stats.note("dcn", [k[lidx] for k in keys] + [lfound, lidx])
+            if not self.stats.per_select_dcn_scalars:
+                self.stats.per_select_dcn_scalars = self.n_hosts * (
+                    len(keys) + 2
+                )
+                self.stats.per_select_ici_scalars = self.n_chips * (
+                    len(keys) + 2
+                )
+        # ICI: the chips' winners, reduced to one winner per host.
+        import jax
+
+        ckeys = [jax.lax.all_gather(k[lidx], self.chip_axis) for k in keys]
+        cfound = jax.lax.all_gather(lfound, self.chip_axis)
+        cgid = jax.lax.all_gather(gids[lidx], self.chip_axis)
+        hidx, hfound = lex_argmin(ckeys, cfound)
+        # DCN: one winner tuple per host, reduced by the pallas tree
+        # kernel instead of argmin over the gathered block.
+        gkeys = [jax.lax.all_gather(k[hidx], self.host_axis) for k in ckeys]
+        gfound = jax.lax.all_gather(hfound, self.host_axis)
+        ggid = jax.lax.all_gather(cgid[hidx], self.host_axis)
+        wgid, wfound = pk.winner_reduce(gkeys, gfound, ggid, dist=self)
+        return jnp.where(wfound, wgid, 0).astype(jnp.int32), wfound

@@ -46,6 +46,15 @@ def build_ladder(backend: str, mesh, config) -> tuple:
     if backend == "kernel":
         if mesh is not None:
             rungs.append(Rung("mesh", f"mesh:{mesh}", mesh))
+        # A configured non-lax solve kernel (ops/pallas_kernels.py) is
+        # its own rung ABOVE plain LOCAL: kernel_path is static jit
+        # meta, so "local:pallas" and LOCAL are distinct compiled
+        # programs — failing off a poisoned pallas/blocked executable
+        # degrades to the lax graph exactly like any other rung demotion
+        # (and the plain LOCAL / hotwindow rungs below force lax).
+        kpath = str(getattr(config, "solve_kernel_path", "lax") or "lax")
+        if kpath != "lax":
+            rungs.append(Rung("local", f"local:{kpath}", kpath))
         rungs.append(Rung("local", "LOCAL"))
         # A degraded retry on a DIFFERENT compiled program: a forced
         # small hot window (fixed, independent of the configured/tuned

@@ -891,12 +891,31 @@ def _pass_segment(
         heads, has = _queue_heads(dev, valid)
         return jnp.where(has, heads, dev.queue_slot_end)
 
+    # Solve-kernel path (ops/pallas_kernels.py): static meta on the
+    # round, so each path is its own compiled program. The fused scoring
+    # + blocked selection engage only where the int64 key pack would
+    # have engaged too (pack_plan mirrors _pack_fill_keys' gate); any
+    # ineligible round silently keeps the lax graph, bit-for-bit.
+    kpath = getattr(dev, "kernel_path", "lax")
+    kbits = None
+    if kpath != "lax":
+        from ..ops import pallas_kernels as _pk
+
+        kbits = _pk.pack_plan(dev, dist.n_shards)
+        if kbits is None:
+            kpath = "lax"
+    knbits = sum(kbits) if kbits else None
+
     def f0_chain(alloc0, j):
         """Best-fit candidate-chain inputs for one job key against row-0
         capacity: (fit0 mask, per-node placement caps, node order keys).
         Shared by the serial fill and the heterogeneous window fill so the
         two paths can never drift apart (set parity depends on identical
         node ordering)."""
+        if kbits is not None:
+            from ..ops import pallas_kernels as _pk
+
+            return _pk.fill_score(dev, dist, alloc0, j, kpath, kbits)
         B = dev.batch_window
         req_fit = dev.job_req_fit[j]
         static_ok = _static_ok(dev, j, jnp.zeros_like(dev.uni_value_bits[0]))
@@ -934,7 +953,7 @@ def _pass_segment(
 
         fit0, caps, nkeys = f0_chain(c.alloc[0], j)
         cand_caps, cand_gids = dist.fill_candidates(
-            nkeys, fit0, caps, dev.node_gid, B
+            nkeys, fit0, caps, dev.node_gid, B, kpath, knbits
         )
         prefix = jnp.cumsum(cand_caps)
         total_cap = prefix[-1]
@@ -1111,7 +1130,7 @@ def _pass_segment(
 
             def do(used):
                 cand_caps, cand_gids = dist.fill_candidates(
-                    nkeys, fit0, caps, dev.node_gid, W
+                    nkeys, fit0, caps, dev.node_gid, W, kpath, knbits
                 )
                 prefix = jnp.cumsum(cand_caps)
                 placed = jnp.minimum(cnt_g[g], prefix[-1]).astype(jnp.int32)
@@ -2267,6 +2286,64 @@ def _window_plan(dev: DeviceRound, carry, pre):
     return Ws, Ep, la
 
 
+# Round readback trim (solve_round(readback_rows=...)): the per-job
+# decision arrays whose padded tail is inert by construction — pad rows
+# are impossible jobs bound nowhere (kernel_prep.pad_device_round), so
+# the solve can never move them off these fills.
+_JOB_READBACK = {
+    "assigned_node": NO_NODE,
+    "scheduled_priority": 0,
+    "scheduled_mask": False,
+    "preempted_mask": False,
+}
+# Device-slice lengths are bucketed (sticky upward, per padded-J shape)
+# so a slowly growing live-job count reuses one compiled slice program
+# instead of recompiling per round — warm cycles must stay at 0 compiles
+# (bench_gate GATED_TRANSFER pins that).
+_READBACK_CHUNK = 16384
+_readback_buckets: dict = {}
+
+
+def _readback_bucket(padded_j: int, rows: int) -> int:
+    need = min(padded_j, -(-max(int(rows), 1) // _READBACK_CHUNK) * _READBACK_CHUNK)
+    cur = _readback_buckets.get(padded_j, 0)
+    if need > cur:
+        _readback_buckets[padded_j] = need
+        cur = need
+    return cur
+
+
+def _materialize_out(out, dev, readback_rows):
+    """Device outputs -> numpy, reading back only the unpadded prefix of
+    the per-job decision arrays when the caller told us the live row
+    count (schedulers know num_jobs; hot-window rounds their window).
+    Returns (np dict for the transfer ledger, re-expand callable) — the
+    ledger books the trimmed D2H traffic, then the caller re-expands to
+    the padded length with the inert pad fills so every downstream
+    consumer (validate_round, lease extraction, the fairness ledger)
+    still sees padded-shape arrays, byte-identical to a full readback."""
+    padded_j = int(dev.job_req.shape[0])
+    if readback_rows is None or int(readback_rows) >= padded_j:
+        return {k: np.asarray(v) for k, v in out.items()}, lambda o: o
+    bucket = _readback_bucket(padded_j, readback_rows)
+    np_out = {}
+    for k, v in out.items():
+        if k in _JOB_READBACK and getattr(v, "shape", ())[:1] == (padded_j,):
+            v = v[:bucket]
+        np_out[k] = np.asarray(v)
+
+    def expand(o):
+        for k, fill in _JOB_READBACK.items():
+            arr = o.get(k)
+            if arr is not None and arr.shape[:1] == (bucket,):
+                o[k] = np.pad(
+                    arr, (0, padded_j - bucket), constant_values=fill
+                )
+        return o
+
+    return np_out, expand
+
+
 def solve_round(
     dev: DeviceRound,
     *,
@@ -2275,6 +2352,7 @@ def solve_round(
     window: int | None = None,
     window_min_slots: int = HOT_WINDOW_MIN_SLOTS_DEFAULT,
     profile: bool = False,
+    readback_rows: int | None = None,
 ):
     """Run the round solve; returns numpy outputs (plus a `truncated`
     flag when budgeted and a `profile` dict on the host-driven paths).
@@ -2305,6 +2383,12 @@ def solve_round(
     segment (setup / pass-1 / gather+scatter / finish) and pass-1 loop
     counts by kind (gang / fill / merged-fill), plus rewindow counts.
 
+    readback_rows (the unpadded live-job count) trims the device->host
+    readback of the per-job decision arrays to that prefix — the padded
+    tail is inert by construction and is re-expanded host-side, so
+    callers see byte-identical padded outputs while the transfer ledger
+    books only the prefix (`_materialize_out`).
+
     Device-resident inputs (snapshot/residency.py): `dev` may arrive
     with leaves already on device. Both paths keep the ledger honest —
     `note_up` books host (numpy) leaves only, so an already-resident
@@ -2326,8 +2410,9 @@ def solve_round(
         # outputs into whatever round ledger the caller activated.
         _tledger.note_up(dev, site="solve.dispatch")
         out = _solve(dev)
-        out = {k: np.asarray(v) for k, v in out.items()}
+        out, _expand = _materialize_out(out, dev, readback_rows)
         _tledger.note_down(out, site="solve.d2h")
+        out = _expand(out)
         from .validate import maybe_assert_finite
 
         maybe_assert_finite(out, "kernel.solve_round[fused]")
@@ -2460,8 +2545,9 @@ def solve_round(
         jax.block_until_ready(out["num_loops"])
         finish_s = _time.monotonic() - t0
         seg_np = np.asarray(segc)
-        out = {k: np.asarray(v) for k, v in out.items()}
+        out, _expand = _materialize_out(out, dev, readback_rows)
         _tledger.note_down(out, site="solve.d2h")
+        out = _expand(out)
         # ARMADA_DEBUG_FINITE=1 debug net: name the first non-finite
         # output array at the seam it left the device, before any
         # downstream consumer can launder the NaN into a placement.

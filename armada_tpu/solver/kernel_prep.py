@@ -25,6 +25,7 @@ from dataclasses import dataclass
 import jax
 import numpy as np
 
+from ..ops import pallas_kernels
 from ..snapshot.round import RoundSnapshot
 from . import policy
 
@@ -45,6 +46,7 @@ _META_FIELDS = (
     "fill_groups",
     "order_key_bits",
     "fairness_policy",
+    "kernel_path",
 )
 
 
@@ -180,6 +182,12 @@ class DeviceRound:
     # ("drf",) emits the pre-policy graph unchanged.
     queue_deadline: np.ndarray | None = None  # float64[Q]
     fairness_policy: tuple = ("drf",)
+    # STATIC solve-kernel selection (ops/pallas_kernels.py): "lax" keeps
+    # the pre-pallas graph bit-for-bit; "blocked"/"pallas"/"native" fuse
+    # the pass-1 scoring chain and swap the fill sort for the blocked
+    # top-B selection. Part of the jit signature — each path compiles
+    # its own program, and replay/failover treat paths as distinct rungs.
+    kernel_path: str = "lax"
 
 
 jax.tree_util.register_dataclass(
@@ -934,4 +942,7 @@ def prep_device_round(
             else np.full(Q, np.inf, dtype=np.float64)
         ),
         fairness_policy=policy.spec_from_config(cfg, snap.pool),
+        kernel_path=pallas_kernels.resolve_kernel_path(
+            getattr(cfg, "solve_kernel_path", "lax")
+        ),
     )

@@ -84,6 +84,9 @@ def encode_device_round(dev: DeviceRound) -> dict:
 # from the decoded queue_weight.
 _COMPAT_DEFAULTS = {
     "fairness_policy": lambda doc: ("drf",),
+    # Solve-kernel selection (ops/pallas_kernels.py) postdates every
+    # pre-pallas bundle; those rounds all ran the lax graph.
+    "kernel_path": lambda doc: "lax",
     "queue_deadline": lambda doc: np.full(
         np.asarray(decode_field(doc["queue_weight"])).shape[0],
         np.inf,

@@ -210,6 +210,40 @@ def replay_solver(spec, header: dict | None = None):
     label = str(spec)
     if label.upper() == "LOCAL":
         return "LOCAL", lambda dev: solve_round(dev)
+    # Kernel paths are solver-spec dimensions of their own: each one is
+    # a distinct compiled program (kernel_path is static jit meta), so
+    # the replayer pins them separately — "blocked" / "pallas" / "lax"
+    # solve LOCAL under that path; "pallas:2x4" runs the mesh spelling
+    # through the pallas winner-exchange dist.
+    kl = label.lower()
+    if kl in ("lax", "blocked", "pallas", "native") or (
+        kl.startswith(("pallas:", "blocked:"))
+    ):
+        import dataclasses as _dc
+
+        path, _, meshspec = kl.partition(":")
+        if path == "native":
+            from ..ops.pallas_kernels import resolve_kernel_path
+
+            path = resolve_kernel_path("native")
+        if not meshspec:
+            return (
+                f"kernel:{path}",
+                lambda dev: solve_round(
+                    _dc.replace(dev, kernel_path=path)
+                ),
+            )
+        from ..parallel.mesh import pad_nodes as _pad
+        from ..parallel.multihost import resolve_solver as _rs
+
+        run = _rs(meshspec, kernel_path=path)
+
+        def solve_mesh_path(dev):
+            dev = _dc.replace(dev, kernel_path=path)
+            out = run(_pad(dev, run.n_shards))
+            return {k: np.asarray(v) for k, v in out.items()}
+
+        return f"kernel:{path}:mesh:{meshspec}", solve_mesh_path
     if label.lower().startswith("hotwindow"):
         if ":" in label:
             window = int(label.split(":", 1)[1])

@@ -269,6 +269,17 @@ def _force_cpu() -> None:
         import jax
         from jax._src import xla_bridge
 
+        # Import pallas BEFORE popping the tpu factory: its import-time
+        # lowering registrations name the "tpu" platform and raise
+        # NotImplementedError once the pop makes that platform unknown —
+        # which would take the interpret-mode CPU kernels
+        # (ops/pallas_kernels.py) down with it. Pre-imported here, later
+        # imports are module-cache hits and never re-register.
+        try:
+            import jax.experimental.pallas  # noqa: F401
+            import jax.experimental.pallas.tpu  # noqa: F401
+        except Exception:
+            pass
         for plugin in ("axon", "tpu"):
             xla_bridge._backend_factories.pop(plugin, None)
         jax.config.update("jax_platforms", "cpu")

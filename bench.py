@@ -35,6 +35,12 @@ round-deadline acceptance scenario; BENCH_HOT_WINDOW sets the per-queue
 hot-window compaction size (0 disables; default: 2x the fill window);
 BENCH_FILL_WINDOW sets batch_fill_window (wide windows amortize the
 per-group candidate sort, the dominant per-loop cost at 50k nodes);
+ARMADA_TPU_KERNEL_PATH picks the solve kernel path (default here:
+"blocked" — the fused scoring body + radix-threshold selection from
+armada_tpu/ops/pallas_kernels.py; =lax reproduces the pre-kernel bench
+for the A/B, =pallas runs the pallas interpret path, =native engages
+real-TPU pallas + the ICI ring winner exchange) and the resolved path
+lands under extra.kernels;
 BENCH_TUNED=<tuned.json> applies the tools/autotune.py profile matching
 this host's target signature (hot window + budgeted chunk stride) to
 every config — the A/B against the static defaults is just the same
@@ -285,9 +291,11 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
         from armada_tpu.parallel.mesh import pad_nodes
         from armada_tpu.parallel.multihost import resolve_solver
 
-        sharded = resolve_solver(mesh)
+        from armada_tpu.ops import pallas_kernels as _pk
 
-        def solve_round(dev):
+        sharded = resolve_solver(mesh, kernel_path=_pk.resolve_kernel_path())
+
+        def solve_round(dev, rows=None):
             return sharded(pad_nodes(dev, sharded.n_shards))
     else:
         # Single-device driver: hot-window compaction when the round is
@@ -297,11 +305,15 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
         # min-slots floor is 0 (window choice is per bench config)
         # UNLESS a BENCH_TUNED profile supplied the full vector, floor
         # included — the A/B must measure what production would run.
-        def solve_round(dev):
+        def solve_round(dev, rows=None):
+            # rows (the live-job count) trims the warm-cycle readback to
+            # the unpadded decision prefix; bench_gate holds the booked
+            # bytes_down under its transfer budget.
             return _single_solve(
                 dev, budget_s=budget_s, chunk_loops=chunk_loops,
                 window=hot_window or None,
                 window_min_slots=window_min_slots,
+                readback_rows=rows,
             )
 
     t_setup = time.time()
@@ -393,7 +405,7 @@ def run_config(n_jobs, n_nodes, burst=None, mesh=None, fill_window=None,
                 dev = _put(dev_h)
                 h2d_s = time.time() - t0
             t0 = time.time()
-            out = solve_round(dev)
+            out = solve_round(dev, rows=snap.num_jobs + len(new_jobs))
             solve_s = time.time() - t0
         # Round admission firewall (armada_tpu/solver/validate.py): time
         # the host-side invariant sweep the scheduler runs before every
@@ -643,6 +655,18 @@ def _run_matrix(partial=None):
 
     platform = jax.devices()[0].platform
 
+    # Solve-kernel path (armada_tpu/ops/pallas_kernels.py): the bench
+    # defaults to the blocked path — the fused scoring body plus the
+    # radix-threshold top-B selection that replaces the per-fill-loop
+    # lexsort, the measured CPU win. ARMADA_TPU_KERNEL_PATH is the A/B
+    # lever: =lax reproduces the pre-kernel bench exactly, =pallas runs
+    # the same body under pl.pallas_call (interpret mode off-TPU),
+    # =native adds the ICI ring winner exchange on real hardware.
+    from armada_tpu.ops import pallas_kernels as _pk
+
+    os.environ.setdefault(_pk.PATH_ENV, "blocked")
+    kernel_path = _pk.resolve_kernel_path("blocked")
+
     custom = any(
         k in os.environ
         for k in ("BENCH_JOBS", "BENCH_NODES", "BENCH_QUEUES", "BENCH_RUNNING")
@@ -711,6 +735,11 @@ def _run_matrix(partial=None):
     extra = dict(flag)
     cycle_s = extra.pop("cycle_s")
     extra["platform"] = platform
+    # Which kernel path produced the headline (artifacts are self-
+    # describing): resolved path + the block geometry the pallas path
+    # would run with at the headline node count. tools/bench_trend.py
+    # shows this as the kernels column.
+    extra["kernels"] = _pk.kernel_info(kernel_path, n_nodes)
     if mesh:
         extra["mesh_devices"] = n_mesh_devices
     extra["platform_probe"] = plat.last_probe_report.get("reason", "")

@@ -207,7 +207,7 @@ def test_trend_tolerates_and_shows_whatif_block(tmp_path):
     assert "whatif" in proc.stdout
     lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
     assert "3@0.42s" in lines["BENCH_r02.json"]
-    assert lines["BENCH_r03.json"].split()[-6] == "yes"  # whatif column
+    assert lines["BENCH_r03.json"].split()[-7] == "yes"  # whatif column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_whatif))["warm"] == 3.0
 
@@ -244,7 +244,7 @@ def test_trend_tolerates_and_shows_frontdoor_block(tmp_path):
     assert lines["BENCH_r01.json"].rstrip().endswith("-")
     assert "17ms/13" in lines["BENCH_r02.json"]
     assert "300ms/5000!" in lines["BENCH_r03.json"]
-    assert lines["BENCH_r04.json"].split()[-5] == "yes"  # frontdoor column
+    assert lines["BENCH_r04.json"].split()[-6] == "yes"  # frontdoor column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_fd))["warm"] == 3.0
 
@@ -276,7 +276,7 @@ def test_trend_tolerates_and_shows_fairness_block(tmp_path):
     lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
     assert lines["BENCH_r01.json"].rstrip().endswith("-")
     assert "j0.988/r0.125" in lines["BENCH_r02.json"]
-    assert lines["BENCH_r03.json"].split()[-2] == "yes"  # fairness column
+    assert lines["BENCH_r03.json"].split()[-3] == "yes"  # fairness column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(with_fair))["warm"] == 3.0
 
@@ -402,7 +402,7 @@ def test_trend_shows_residency_column(tmp_path):
     lines = {l.split()[0]: l for l in proc.stdout.splitlines() if "BENCH_" in l}
     assert lines["BENCH_r01.json"].rstrip().endswith("-")
     assert "delta@13.4MB" in lines["BENCH_r02.json"]
-    assert lines["BENCH_r03.json"].split()[-3] == "reset"  # residency column
+    assert lines["BENCH_r03.json"].split()[-4] == "reset"  # residency column
     # The gate's metric extraction is unaffected by the extra block.
     assert extract_metrics(parse_artifact(delta))["warm"] == 3.0
 

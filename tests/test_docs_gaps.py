@@ -97,6 +97,16 @@ def _feature_exists_window_autotune() -> bool:
         return "autotune" in f.read().lower()
 
 
+def _feature_exists_native_ring_test() -> bool:
+    # Closed once the native ICI ring runs on real hardware in a test:
+    # the promoted form of tools/pallas_probe.py's native smoke.
+    path = os.path.join(REPO, "tests", "test_pallas_parity.py")
+    if not os.path.exists(path):
+        return False
+    with open(path) as f:
+        return "def test_native_ring_on_hardware(" in f.read()
+
+
 DETECTORS = {
     "kubernetes": _feature_exists_kubernetes,
     "lookout-ui-surface": _feature_exists_rich_lookout_ui,
@@ -104,6 +114,7 @@ DETECTORS = {
     "scala-client": _feature_exists_scala_client,
     "sharded-round-budget": _feature_exists_sharded_budget,
     "hot-window-autotune": _feature_exists_window_autotune,
+    "pallas-ici-native": _feature_exists_native_ring_test,
 }
 
 

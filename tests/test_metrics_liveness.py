@@ -233,6 +233,11 @@ def test_every_labeled_family_live_after_short_sim(tmp_path):
             "compacted": True,
         },
     )
+    # Same contract for the solve-kernel info gauge: kernel-path
+    # selection (ops/pallas_kernels.py) only happens on the device solve
+    # path, so drive the wiring itself with the path string
+    # _attempt_round reports in solver_info["kernel"].
+    sim.scheduler._note_solve_kernel("default", "blocked")
     # Same contract for the autotune surface (armada_tpu/autotune): the
     # oracle sim never runs the kernel's host-driven driver, so drive
     # the controller wiring itself with a profile of the shape
@@ -450,3 +455,37 @@ def test_fairness_policy_info_gauge_follows_flip():
     )
     assert value("proportional") == 1.0
     assert value("drf") == 0.0
+
+
+def test_solve_kernel_info_gauge_follows_flip():
+    """scheduler_solve_kernel_info is an info-style gauge: the kernel
+    path the pool's last committed round ran reads 1 and, on a flip
+    (config change or a failover demotion off a poisoned pallas/blocked
+    executable), the stale path's series drops to 0 instead of
+    freezing — a dashboard keyed on ==1 must follow the demotion."""
+    from armada_tpu.services.scheduler import SchedulerService
+
+    m = SchedulerMetrics()
+
+    class Host:
+        metrics = m
+
+    host = Host()
+
+    def value(path):
+        for fam in m.solve_kernel_info.collect():
+            for s in fam.samples:
+                if s.labels.get("pool") == "p" and (
+                    s.labels.get("path") == path
+                ):
+                    return s.value
+        return None
+
+    SchedulerService._note_solve_kernel(host, "p", "pallas")
+    assert value("pallas") == 1.0
+
+    # Failover demotion: the ladder fell off the local:pallas rung onto
+    # plain LOCAL, which forces the lax graph.
+    SchedulerService._note_solve_kernel(host, "p", "lax")
+    assert value("lax") == 1.0
+    assert value("pallas") == 0.0

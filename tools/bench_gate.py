@@ -22,7 +22,7 @@ the threshold. A metric regresses when current > baseline * threshold;
 a metric missing on either side is reported but never gates (old
 artifacts predate burst_50k and the segment profile).
 
-Two gates are ABSOLUTE (need no baseline): the round admission
+Some gates are ABSOLUTE (need no baseline): the round admission
 firewall's host-side invariant sweep (extra.validate_s, timed by
 bench.py outside the measured cycle) must cost under 5% of the
 headline solve time — the firewall runs before every committed round,
@@ -31,7 +31,11 @@ so its cost taxes the whole control loop — and, when
 upload (extra.transfer.bytes_up) must stay under that many MB: with
 the round device-resident (snapshot/residency.py) a warm cycle uploads
 only the delta, so blowing the budget means residency silently
-disengaged or the delta path fell back to full re-uploads. Exits 1 on
+disengaged or the delta path fell back to full re-uploads. Symmetric
+on the download side, --readback-budget-mb caps the warm cycle's
+booked result readback (extra.transfer.bytes_down): with
+solve_round(readback_rows=...) trimming the d2h to the unpadded
+decision prefix, blowing it means the trim disengaged. Exits 1 on
 regression, 2 when no comparable baseline exists, 0 otherwise.
 """
 
@@ -217,6 +221,40 @@ def residency_gate(result: dict | None, budget_mb: float | None) -> tuple[list, 
     return regressions, notes
 
 
+def readback_gate(result: dict | None, budget_mb: float | None) -> tuple[list, list]:
+    """(regressions, notes) for the absolute round-readback budget.
+    Only active when --readback-budget-mb is passed; then the warm
+    headline cycle's booked result download (extra.transfer.bytes_down)
+    must stay under that many MB — with solve_round(readback_rows=...)
+    trimming the d2h to the unpadded decision prefix, blowing the budget
+    means the trim silently disengaged and warm cycles are paying the
+    full padded-J readback again. Like the residency gate, an artifact
+    MISSING the field gates too: the flag asserts the download is
+    measured and prefix-sized, so an artifact that cannot prove it must
+    not read as green."""
+    regressions, notes = [], []
+    if budget_mb is None:
+        return regressions, notes
+    extra = result.get("extra") if isinstance(result, dict) else None
+    transfer = extra.get("transfer") if isinstance(extra, dict) else None
+    down = transfer.get("bytes_down") if isinstance(transfer, dict) else None
+    if not isinstance(down, (int, float)):
+        regressions.append(
+            "readback: current artifact has no extra.transfer.bytes_down "
+            f"(budget {budget_mb:g} MB asserted)"
+        )
+        return regressions, notes
+    line = (
+        f"readback: warm bytes_down {down / 1e6:.1f}MB vs budget "
+        f"{budget_mb:g}MB"
+    )
+    if down > budget_mb * 1e6:
+        regressions.append(line)
+    else:
+        notes.append("OK " + line)
+    return regressions, notes
+
+
 def _round_num(path: str) -> int:
     m = re.search(r"BENCH_r(\d+)\.json$", path)
     return int(m.group(1)) if m else -1
@@ -252,6 +290,10 @@ def main(argv=None) -> int:
                     help="absolute ceiling (MB) on the warm headline "
                     "cycle's extra.transfer.bytes_up — asserts the "
                     "device-resident delta path carried the round")
+    ap.add_argument("--readback-budget-mb", type=float, default=None,
+                    help="absolute ceiling (MB) on the warm headline "
+                    "cycle's extra.transfer.bytes_down — asserts the "
+                    "readback_rows prefix trim carried the download")
     args = ap.parse_args(argv)
 
     raw = (
@@ -283,6 +325,11 @@ def main(argv=None) -> int:
     )
     regressions += res_regressions
     notes += res_notes
+    rb_regressions, rb_notes = readback_gate(
+        parse_artifact(doc), args.readback_budget_mb
+    )
+    regressions += rb_regressions
+    notes += rb_notes
     print(f"baseline: {os.path.basename(base_path)}")
     for line in notes:
         print(line)

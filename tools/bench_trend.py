@@ -46,7 +46,7 @@ def rows(search_dir: str) -> list[dict]:
                "tracking": None, "burst": None, "solve": None,
                "trace": False, "params": None, "whatif": None,
                "frontdoor": None, "transfer": None, "fairness": None,
-               "policy": None, "residency": None}
+               "policy": None, "residency": None, "kernels": None}
         try:
             with open(path) as f:
                 doc = json.load(f)
@@ -151,6 +151,19 @@ def rows(search_dir: str) -> list[dict]:
             pol = fairness.get("policy")
             if isinstance(pol, str) and pol:
                 row["policy"] = pol
+        kernels = extra.get("kernels") if isinstance(extra, dict) else None
+        if isinstance(kernels, dict):
+            # Solve-kernel block (armada_tpu/ops/pallas_kernels.py): the
+            # path that produced the headline, with the pallas block
+            # count when the path runs blocked ("pallas/64b" = 64 node
+            # blocks). Pre-kernel artifacts simply lack the block.
+            kpath = kernels.get("path")
+            blocks = kernels.get("blocks")
+            row["kernels"] = (
+                f"{kpath}/{blocks}b"
+                if isinstance(kpath, str) and isinstance(blocks, int)
+                else (kpath or "yes")
+            )
         params = extra.get("params") if isinstance(extra, dict) else None
         if isinstance(params, dict):
             # Effective headline solver parameters (window/chunk, "*"
@@ -177,7 +190,7 @@ def main(argv=None) -> int:
         f"{'artifact':<18} {'warm_s':>8} {'solve_s':>8} {'tracking_s':>10} "
         f"{'burst_s':>8} {'win/chunk':>10} {'trace':>6} {'whatif':>9} "
         f"{'frontdoor':>10} {'transfer':>16} {'residency':>14} "
-        f"{'fairness':>15} {'policy':>12}"
+        f"{'fairness':>15} {'policy':>12} {'kernels':>12}"
     )
     print(header)
     print("-" * len(header))
@@ -192,7 +205,8 @@ def main(argv=None) -> int:
             f"{r.get('transfer') or '-':>16} "
             f"{r.get('residency') or '-':>14} "
             f"{r.get('fairness') or '-':>15} "
-            f"{r.get('policy') or '-':>12}"
+            f"{r.get('policy') or '-':>12} "
+            f"{r.get('kernels') or '-':>12}"
         )
     return 0
 

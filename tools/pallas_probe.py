@@ -1,0 +1,166 @@
+"""Pallas kernel probe: preflight -> interpret smoke -> native smoke.
+
+Answers "can this host run the pallas solve kernels, and do they agree
+with the lax path?" in one machine-readable JSON line on stdout:
+
+  {"ok": true|false, "platform": "cpu", "native": false,
+   "preflight": {...}, "interpret": {...}, "native_smoke": {...}}
+
+Three stages, each recorded even when a later one is skipped:
+
+  1. preflight: platform + relay probe facts (utils/platform) and the
+     resolved kernel path for this process — whether `native` would
+     demote to interpret mode here and why.
+  2. interpret smoke (always): the pallas kernels under interpret=True
+     on whatever backend is attached — `fill_take` vs `jnp.lexsort`,
+     `winner_reduce` vs host argmin, and a full small mixed-fleet
+     solve_round parity sweep lax vs blocked vs pallas (bit-exact or
+     the probe fails).
+  3. native smoke (only when `native_available()`): the same sweep with
+     ARMADA_TPU_KERNEL_PATH=native, compiled for the attached TPU — the
+     hardware leg of the tests/test_pallas_parity.py contract.
+
+Exit code 0 iff ok.
+
+  python tools/pallas_probe.py [--nodes 64] [--jobs 256]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _interpret_smoke(n_nodes: int, n_jobs: int) -> dict:
+    import numpy as np
+    import jax.numpy as jnp
+
+    from armada_tpu.ops import pallas_kernels as pk
+    from armada_tpu.parallel.scenarios import mixed_fleet_rounds
+    from armada_tpu.solver.kernel import solve_round
+    from armada_tpu.solver.kernel_prep import (
+        pad_device_round,
+        prep_device_round,
+    )
+    import dataclasses
+
+    out: dict = {}
+
+    # fill_take vs the stable single-key lexsort it replaces.
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, 2**40, size=4096, dtype=np.int64))
+    want = 256
+    take, taken = pk.fill_take(keys, want, nbits=41)
+    ref = jnp.lexsort((keys,))[:want]
+    out["fill_take_exact"] = bool(np.array_equal(np.asarray(take), np.asarray(ref)))
+
+    # winner_reduce vs the host lexicographic argmin it replaces.
+    p = 8
+    wkeys = [jnp.asarray(rng.integers(0, 1000, size=p, dtype=np.int32))
+             for _ in range(3)]
+    found = jnp.asarray(rng.integers(0, 2, size=p, dtype=np.int32)).astype(bool)
+    gids = jnp.arange(p, dtype=jnp.int32) + 100
+    wgid, wfound = pk.winner_reduce(wkeys, found, gids)
+    rows = np.stack([np.asarray(k) for k in wkeys], axis=1)
+    alive = np.flatnonzero(np.asarray(found))
+    if alive.size:
+        # np.lexsort treats the LAST tuple entry as primary; it is
+        # stable, so first-index tie-break needs no explicit key.
+        order = np.lexsort(tuple(rows[alive].T[::-1]))
+        ref_gid = int(np.asarray(gids)[alive[order[0]]])
+        ok_w = bool(wfound) and int(wgid) == ref_gid
+    else:
+        ok_w = not bool(wfound)
+    out["winner_reduce_exact"] = ok_w
+
+    # Full-round parity: lax vs blocked vs pallas on the mixed fleet.
+    parity = []
+    for name, snap in mixed_fleet_rounds(n_nodes, n_jobs):
+        dev = pad_device_round(prep_device_round(snap))
+        base = {k: np.asarray(v) for k, v in solve_round(dev).items()
+                if k not in ("profile", "truncated")}
+        for path in ("blocked", "pallas"):
+            got = solve_round(dataclasses.replace(dev, kernel_path=path))
+            mismatch = [
+                k for k, v in base.items()
+                if not np.array_equal(np.asarray(got[k]), v, equal_nan=True)
+            ]
+            parity.append({"round": name, "path": path,
+                           "exact": not mismatch, "mismatch": mismatch})
+    out["rounds"] = parity
+    out["ok"] = (
+        out["fill_take_exact"]
+        and out["winner_reduce_exact"]
+        and all(r["exact"] for r in parity)
+    )
+    return out
+
+
+def _native_smoke(n_nodes: int, n_jobs: int) -> dict:
+    import numpy as np
+
+    from armada_tpu.parallel.scenarios import mixed_fleet_rounds
+    from armada_tpu.solver.kernel import solve_round
+    from armada_tpu.solver.kernel_prep import (
+        pad_device_round,
+        prep_device_round,
+    )
+    import dataclasses
+
+    parity = []
+    for name, snap in mixed_fleet_rounds(n_nodes, n_jobs):
+        dev = pad_device_round(prep_device_round(snap))
+        base = {k: np.asarray(v) for k, v in solve_round(dev).items()
+                if k not in ("profile", "truncated")}
+        got = solve_round(dataclasses.replace(dev, kernel_path="native"))
+        mismatch = [
+            k for k, v in base.items()
+            if not np.array_equal(np.asarray(got[k]), v, equal_nan=True)
+        ]
+        parity.append({"round": name, "exact": not mismatch,
+                       "mismatch": mismatch})
+    return {"rounds": parity, "ok": all(r["exact"] for r in parity)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=64)
+    ap.add_argument("--jobs", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    from armada_tpu.utils.platform import ensure_healthy_backend
+
+    ensure_healthy_backend()
+
+    import jax
+
+    from armada_tpu.ops import pallas_kernels as pk
+    from armada_tpu.utils import platform as plat
+
+    result: dict = {
+        "platform": jax.default_backend(),
+        "native": pk.native_available(),
+        "preflight": {
+            "probe": plat.last_probe_report,
+            "resolved_native": pk.resolve_kernel_path("native"),
+            "pallas_importable": pk.pl is not None,
+        },
+    }
+    try:
+        result["interpret"] = _interpret_smoke(args.nodes, args.jobs)
+        ok = result["interpret"]["ok"]
+        if result["native"]:
+            result["native_smoke"] = _native_smoke(args.nodes, args.jobs)
+            ok = ok and result["native_smoke"]["ok"]
+        result["ok"] = bool(ok)
+    except Exception as e:  # noqa: BLE001 - the JSON line IS the report
+        result["ok"] = False
+        result["error"] = f"{e.__class__.__name__}: {e}"
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
